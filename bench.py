@@ -1,0 +1,81 @@
+"""Headline benchmark: VGG-11 CIFAR-10 training throughput on one TPU chip.
+
+Protocol mirrors the reference's measurement fixture (reference
+part1/main.py:66,86-91; BASELINE.md): global batch 256, per-iteration wall
+time with iteration 0 discarded as compile/warm-up and iterations 1..39
+averaged, host->device transfer included in each iteration (the reference
+times its full loop body too).
+
+Baseline (BASELINE.md, derived throughput): the reference's best
+configuration — part3 torch-DDP on FOUR CPU nodes — reaches ~386 img/s
+aggregate. ``vs_baseline`` is our single-chip images/sec divided by that
+386 img/s.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def run_bench(batch_size: int = 256, timed_iters: int = 39) -> dict:
+    import jax
+
+    from tpu_ddp.data.cifar10 import normalize
+    from tpu_ddp.models import get_model
+    from tpu_ddp.parallel.mesh import make_mesh
+    from tpu_ddp.train.engine import Trainer
+    from tpu_ddp.utils.config import TrainConfig
+    from tpu_ddp.utils.timing import IterationTimer
+
+    cfg = TrainConfig()
+    model = get_model("VGG11")
+    # part3-equivalent (flagship) configuration: fused DP step, pinned to
+    # exactly ONE chip so the per-chip metric stays honest on multi-chip
+    # hosts (the pmean over a 1-slot axis degenerates gracefully).
+    mesh = make_mesh(jax.devices()[:1])
+    trainer = Trainer(model, cfg, strategy="fused", mesh=mesh)
+    state = trainer.init_state()
+
+    # Synthetic CIFAR-shaped batches (bench must run with zero egress);
+    # normalization on host per iteration, as in training.
+    rng = np.random.default_rng(0)
+    n_distinct = 8
+    raw = [rng.integers(0, 256, size=(batch_size, 32, 32, 3),
+                        ).astype(np.uint8) for _ in range(n_distinct)]
+    labels = [rng.integers(0, 10, size=batch_size).astype(np.int32)
+              for _ in range(n_distinct)]
+
+    timer = IterationTimer(first_iter=1, last_iter=timed_iters)
+    for it in range(timed_iters + 1):
+        timer.start()
+        x, y, w = trainer.put_batch(normalize(raw[it % n_distinct]),
+                                    labels[it % n_distinct])
+        state, loss = trainer.train_step(state, x, y, w)
+        jax.block_until_ready(loss)
+        timer.stop(it)
+
+    imgs_per_sec = batch_size / timer.average_s
+    return {
+        "metric": "cifar10_vgg11_images_per_sec_per_chip",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / 386.0, 2),
+        "extra": {
+            "avg_iter_s": round(timer.average_s, 6),
+            "batch_size": batch_size,
+            "timed_iters": timer.count,
+            "platform": jax.devices()[0].platform,
+            "baseline": "part3 torch-DDP, 4 CPU nodes, ~386 img/s aggregate "
+                        "(BASELINE.md)",
+        },
+    }
+
+
+if __name__ == "__main__":
+    result = run_bench()
+    print(json.dumps(result))

@@ -1,0 +1,132 @@
+"""Shared CLI wiring for the four parts.
+
+Preserves the reference's per-node launch contract (README.md:8-19):
+
+    python main.py --num-nodes N [--rank R --master-ip IP --master-port P]
+
+with the same defaults (master 10.10.1.1:4000, rank inferred from a
+``nodeN`` hostname — reference part2/part2a/main.py:20-39), the same batch
+math (per-node ``int(256/num_nodes)``, part2/part2b/main.py:177), the same
+seed (89395), loss-print cadence (every 20 iters) and the iteration-1..39
+timing harness.
+
+TPU-native extensions (no reference equivalent): one process automatically
+drives all of its local chips as dp slots, and env knobs
+(``TPU_DDP_MAX_ITERS``, ``TPU_DDP_GLOBAL_BATCH``, ``TPU_DDP_SYNTH_SIZE``)
+shrink a run for smoke tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def parse_arguments(argv=None, require_num_nodes: bool = False):
+    """The reference's flag surface (part2/part2a/main.py:20-32).
+
+    ``--master-port`` stays a string: the reference keeps it one because it
+    goes into an env var (SURVEY.md §1 L6); here it is concatenated into the
+    coordinator address. ``--num-nodes`` has no default in the reference and
+    omitting it crashes init (SURVEY.md §3.5) — we keep it required for the
+    distributed parts and default it to 1 for part1.
+    """
+    p = argparse.ArgumentParser()
+    p.add_argument("--master-ip", type=str, default="10.10.1.1",
+                   help="rendezvous coordinator IP (rank 0's)")
+    p.add_argument("--master-port", type=str, default="4000",
+                   help="rendezvous coordinator port")
+    p.add_argument("--num-nodes", type=int,
+                   required=require_num_nodes,
+                   default=None if require_num_nodes else 1,
+                   help="world size (number of processes)")
+    p.add_argument("--rank", type=int, default=None,
+                   help="process rank; default inferred from hostname "
+                        "nodeN (reference part2/part2a/main.py:35-39)")
+    p.add_argument("--data-root", type=str, default=None,
+                   help="CIFAR-10 root (default: search standard paths, "
+                        "fall back to synthetic)")
+    p.add_argument("--epochs", type=int, default=1)
+    return p.parse_args(argv)
+
+
+def run_part(part: str, argv=None):
+    """Wire L6..L1 for one part (the reference's ``main()``,
+    part2/part2b/main.py:169-195) and run train + eval."""
+    distributed = part != "part1"
+    args = parse_arguments(argv, require_num_nodes=distributed)
+
+    # Late imports keep `--help` fast and let env vars set by wrappers
+    # (e.g. XLA_FLAGS for simulated devices) take effect first.
+    import os
+
+    import jax
+
+    # Some environments pre-import jax via a site hook that overrides the
+    # platform list programmatically; re-assert the user's JAX_PLATFORMS so
+    # `JAX_PLATFORMS=cpu python parts/.../main.py` behaves as documented.
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
+
+    from tpu_ddp.data.loader import create_data_loaders
+    from tpu_ddp.models import get_model
+    from tpu_ddp.parallel.bootstrap import (
+        get_rank_from_hostname, init_distributed_setup, shutdown,
+        test_distributed_setup)
+    from tpu_ddp.parallel.mesh import make_mesh
+    from tpu_ddp.parallel.sync import PART_TO_STRATEGY
+    from tpu_ddp.train.engine import Trainer
+    from tpu_ddp.utils.config import TrainConfig
+
+    world_size = args.num_nodes or 1
+    # Hostname rank inference only applies to distributed launches; a
+    # single-process world is always rank 0 (the reference's part1 takes no
+    # args and never infers a rank — part1/main.py:114-130).
+    if world_size <= 1:
+        rank = 0
+    elif args.rank is not None:
+        rank = args.rank
+    else:
+        rank = get_rank_from_hostname()
+
+    ctx = init_distributed_setup(args.master_ip, args.master_port, rank,
+                                 world_size)
+    if distributed:
+        test_distributed_setup(ctx)
+
+    cfg = TrainConfig(epochs=args.epochs)
+    batch_size = cfg.per_node_batch_size(world_size)
+
+    # Replicas on the mesh = data-parallel slots. One process with D local
+    # devices contributes D slots; N single-device processes contribute N.
+    mesh = make_mesh() if distributed else None
+    dp_size = mesh.shape["dp"] if mesh is not None else 1
+
+    train_loader, test_loader = create_data_loaders(
+        rank=rank, world_size=world_size, batch_size=batch_size,
+        root=args.data_root, seed=cfg.seed)
+
+    model = get_model(cfg.model, num_classes=cfg.num_classes)
+    trainer = Trainer(model, cfg, strategy=PART_TO_STRATEGY[part], mesh=mesh)
+    state = trainer.init_state()
+
+    print(f"[{part}] strategy={PART_TO_STRATEGY[part]} world_size={world_size} "
+          f"rank={rank} dp_slots={dp_size} per-node batch={batch_size} "
+          f"platform={jax.devices()[0].platform}")
+
+    for epoch in range(cfg.epochs):
+        # Per-epoch reshuffle hook (reference part2/part2b/main.py:189).
+        train_loader.set_epoch(epoch)
+        state, stats = trainer.train_epoch(state, train_loader, epoch=epoch)
+        trainer.evaluate(state, test_loader)
+        print(f"[{part}] epoch {epoch}: avg iter "
+              f"{stats['avg_iter_s']:.4f}s over {stats['timed_iters']} timed "
+              f"iters; {stats['iters']} iters total")
+
+    shutdown(ctx)
+    return 0
+
+
+def main_for(part: str):
+    sys.exit(run_part(part))

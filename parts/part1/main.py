@@ -1,0 +1,20 @@
+"""Part 1 — single-device training (reference part1/main.py).
+
+No gradient synchronization: the whole step (forward, backward, SGD update)
+is one jit-compiled XLA program on one device. The reference takes no CLI
+args (SURVEY.md §1 L6, absent in part1); flags are accepted here for
+uniformity but default to a world of 1.
+
+Launch:  python parts/part1/main.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from common import run_part  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(run_part("part1"))

@@ -1,0 +1,23 @@
+"""Part 2a — manual gradient sync via root-centric gather/mean/scatter
+(reference part2/part2a/main.py:97-115).
+
+TPU-native: per-leaf ``all_gather`` + the root replica's mean broadcast via
+``psum`` over the dp mesh axis (tpu_ddp/parallel/sync.py:sync_gather_scatter).
+Note: the shipped reference file for this part does not even compile (stray
+``/`` at part2/part2a/main.py:70, SURVEY.md §3.5); this implements the
+intent — loaders identical to part2b.
+
+Launch (per node):
+  python parts/part2a/main.py --num-nodes N [--rank R --master-ip IP --master-port P]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from common import run_part  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(run_part("part2a"))

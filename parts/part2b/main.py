@@ -1,0 +1,21 @@
+"""Part 2b — manual gradient sync via all-reduce
+(reference part2/part2b/main.py:97-103: per-parameter all_reduce(SUM) then
+divide by world size).
+
+TPU-native: per-leaf ``lax.psum`` over the dp mesh axis, riding ICI instead
+of gloo's TCP ring (tpu_ddp/parallel/sync.py:sync_all_reduce).
+
+Launch (per node):
+  python parts/part2b/main.py --num-nodes N [--rank R --master-ip IP --master-port P]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from common import run_part  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(run_part("part2b"))

@@ -1,0 +1,22 @@
+"""Part 3 — framework data-parallel (reference part3/main.py: torch DDP with
+25 MB buckets overlapping the all-reduce with backward).
+
+TPU-native: the gradient ``pmean`` lives INSIDE the single jitted train
+step, so XLA's latency-hiding scheduler overlaps the ICI collective with the
+remaining backward pass — the compiler-native equivalent of DDP's bucketing
+(tpu_ddp/parallel/sync.py:sync_fused; SURVEY.md §2 row N2).
+
+Launch (per node):
+  python parts/part3/main.py --num-nodes N [--rank R --master-ip IP --master-port P]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from common import run_part  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(run_part("part3"))

@@ -1,0 +1,31 @@
+"""Test configuration: force a virtual 8-device CPU platform.
+
+The reference was verified on a real 4-node cluster and has no test suite
+(SURVEY.md §4); our strategy is the one §4/§7 prescribe: multi-device tests
+on the forced host platform.
+
+Note: this environment pre-imports jax at interpreter startup (site hook)
+with the TPU platform selected, so setting ``JAX_PLATFORMS`` via os.environ
+here is too late — we go through ``jax.config.update`` instead, which works
+as long as no backend has been initialized yet.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,106 @@
+"""Engine tests: epoch loop, instrumentation, eval semantics
+(reference train_model/test_model, part1/main.py:52-111)."""
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_ddp.data.loader import DataLoader
+from tpu_ddp.models.vgg import VGGModel
+from tpu_ddp.train.engine import Trainer
+from tpu_ddp.utils.config import TrainConfig
+from tpu_ddp.utils.timing import IterationTimer
+
+
+def tiny_trainer(**kw):
+    model = VGGModel(name="tiny", cfg=(8, "M", 16, "M"),
+                     compute_dtype=jnp.float32)
+    return Trainer(model, TrainConfig(**kw), strategy="none")
+
+
+def separable_batches(n_batches=8, bs=32, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        y = rng.integers(0, 10, size=bs).astype(np.int32)
+        x = rng.normal(0, 0.1, size=(bs, 4, 4, 3)).astype(np.float32)
+        x[np.arange(bs), y % 4, y // 4 % 4, :] += 3.0  # class-dependent spike
+        out.append((x, y))
+    return out
+
+
+def test_loss_decreases_on_learnable_data():
+    trainer = tiny_trainer(learning_rate=0.05)
+    state = trainer.init_state()
+    batches = separable_batches(n_batches=30)
+    first = last = None
+    for x, y in batches:
+        xb, yb, wb = trainer.put_batch(x, y)
+        state, loss = trainer.train_step(state, xb, yb, wb)
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    assert last < first, (first, last)
+
+
+def test_train_epoch_logging_cadence_and_timing():
+    trainer = tiny_trainer(log_every=2, timing_first_iter=1,
+                           timing_last_iter=3)
+    state = trainer.init_state()
+    lines = []
+    state, stats = trainer.train_epoch(state, separable_batches(6),
+                                       epoch=0, log=lines.append)
+    loss_lines = [l for l in lines if "loss:" in l]
+    assert len(loss_lines) == 3  # iters 2, 4, 6 with log_every=2
+    timing_lines = [l for l in lines if "timing over iterations" in l]
+    assert len(timing_lines) == 1
+    assert stats["timed_iters"] == 3
+    assert stats["avg_iter_ns"] > 0
+    assert stats["iters"] == 6
+
+
+def test_max_iters_caps_epoch():
+    trainer = tiny_trainer(max_iters=2)
+    state = trainer.init_state()
+    _, stats = trainer.train_epoch(state, separable_batches(6), log=lambda s: None)
+    assert stats["iters"] == 2
+
+
+def test_evaluate_reports_per_batch_avg_loss_and_accuracy():
+    trainer = tiny_trainer()
+    state = trainer.init_state()
+    batches = separable_batches(4, bs=16, seed=3)
+    lines = []
+    stats = trainer.evaluate(state, batches, log=lines.append)
+    assert stats["seen"] == 64
+    assert 0.0 <= stats["test_accuracy"] <= 1.0
+    # avg over batches, not samples (reference part1/main.py:108)
+    assert re.search(r"average loss", lines[0])
+
+
+def test_iteration_timer_window():
+    t = IterationTimer(first_iter=1, last_iter=3)
+    for it in range(5):
+        t.start()
+        t.stop(it)
+    assert t.count == 3
+    assert t.total_ns >= 0
+    assert "iterations 1-3" in t.report()
+
+
+def test_dataloader_shapes_and_determinism():
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(100, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, size=100).astype(np.int32)
+    dl = DataLoader(imgs, labels, batch_size=32, augment=True)
+    dl.set_epoch(0)
+    b1 = [x.copy() for x, _ in dl]
+    assert [x.shape[0] for x in b1] == [32, 32, 32, 4]  # drop_last=False
+    assert b1[0].dtype == np.float32
+    dl.set_epoch(0)
+    b2 = [x for x, _ in dl]
+    np.testing.assert_array_equal(b1[0], b2[0])  # same epoch -> same crops
+    dl.set_epoch(1)
+    b3 = [x for x, _ in dl]
+    assert not np.array_equal(b1[0], b3[0])  # reshuffled augmentation

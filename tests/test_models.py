@@ -1,0 +1,90 @@
+"""Model-zoo tests: VGG family parity with the reference architecture
+(reference part1/model.py:1-50)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.models import make_vgg, resnet50
+from tpu_ddp.models.vgg import VGG_CFG, batch_norm
+
+
+def torch_vgg_param_count(name: str) -> int:
+    """Parameter count of the reference torch model, built independently."""
+    import torch.nn as nn
+
+    cfg = VGG_CFG[name]
+    layers, c_in = [], 3
+    for w in cfg:
+        if w == "M":
+            layers.append(nn.MaxPool2d(2, 2))
+        else:
+            layers.append(nn.Conv2d(c_in, w, 3, 1, 1, bias=True))
+            layers.append(nn.BatchNorm2d(w, track_running_stats=False))
+            layers.append(nn.ReLU(inplace=True))
+            c_in = w
+    model = nn.Sequential(*layers, nn.Flatten(), nn.Linear(512, 10))
+    return sum(p.numel() for p in model.parameters())
+
+
+@pytest.mark.parametrize("name", list(VGG_CFG))
+def test_param_count_matches_torch_reference(name):
+    model = make_vgg(name)
+    assert model.num_params() == torch_vgg_param_count(name)
+
+
+def test_vgg11_forward_shape_and_dtype():
+    model = make_vgg("VGG11")
+    params = model.init(jax.random.key(0))
+    x = jnp.zeros((4, 32, 32, 3), jnp.float32)
+    logits = model.apply(params, x)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_vgg11_batch_independence_of_argmax_path():
+    # Same input twice in a batch -> identical logits rows (BN uses batch
+    # stats, so rows interact through stats, but identical rows stay equal).
+    model = make_vgg("VGG11", compute_dtype=jnp.float32)
+    params = model.init(jax.random.key(1))
+    x1 = jax.random.normal(jax.random.key(2), (2, 32, 32, 3))
+    x = jnp.concatenate([x1, x1], axis=0)
+    logits = model.apply(params, x)
+    np.testing.assert_allclose(logits[:2], logits[2:], rtol=1e-5, atol=1e-5)
+
+
+def test_batch_norm_uses_current_batch_stats():
+    # track_running_stats=False semantics (reference part1/model.py:24):
+    # normalized output has ~zero mean / unit var per channel.
+    x = jax.random.normal(jax.random.key(0), (8, 4, 4, 3)) * 5 + 3
+    y = batch_norm(x, jnp.ones(3), jnp.zeros(3))
+    np.testing.assert_allclose(np.mean(y, axis=(0, 1, 2)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.var(np.asarray(y), axis=(0, 1, 2)), 1.0,
+                               atol=1e-3)
+
+
+def test_vgg_init_deterministic():
+    model = make_vgg("VGG11")
+    p1 = model.init(jax.random.key(89395))
+    p2 = model.init(jax.random.key(89395))
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_resnet50_small_inputs_forward():
+    model = resnet50(num_classes=10, small_inputs=True,
+                     compute_dtype=jnp.float32)
+    params = model.init(jax.random.key(0))
+    logits = model.apply(params, jnp.zeros((2, 32, 32, 3)))
+    assert logits.shape == (2, 10)
+
+
+def test_resnet50_param_count_close_to_canonical():
+    # Canonical torchvision ResNet-50 (ImageNet) has 25,557,032 params;
+    # ours differs only by BN running-stat buffers (absent here) and
+    # stem/head details. Assert the same order of magnitude and exact conv
+    # structure via a tight band.
+    model = resnet50(num_classes=1000)
+    n = model.num_params()
+    assert 25_000_000 < n < 26_000_000, n

@@ -1,0 +1,50 @@
+"""Ops tests: cross-entropy and SGD vs the torch semantics the reference
+uses (CrossEntropyLoss, part1/main.py:119; SGD(0.1, 0.9, 1e-4),
+part1/main.py:124-125)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from tpu_ddp.ops import SGD, cross_entropy_loss, top1_correct
+
+
+def test_cross_entropy_matches_torch():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=16).astype(np.int64)
+    ours = float(cross_entropy_loss(jnp.asarray(logits),
+                                    jnp.asarray(labels.astype(np.int32))))
+    theirs = float(torch.nn.CrossEntropyLoss()(
+        torch.tensor(logits), torch.tensor(labels)))
+    assert abs(ours - theirs) < 1e-5
+
+
+def test_sgd_matches_torch_three_steps():
+    rng = np.random.default_rng(1)
+    w0 = rng.normal(size=(7, 5)).astype(np.float32)
+
+    # torch side
+    wt = torch.nn.Parameter(torch.tensor(w0.copy()))
+    opt = torch.optim.SGD([wt], lr=0.1, momentum=0.9, weight_decay=1e-4)
+    # ours
+    sgd = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
+    params = {"w": jnp.asarray(w0)}
+    state = sgd.init(params)
+
+    for step in range(3):
+        g = rng.normal(size=w0.shape).astype(np.float32)
+        opt.zero_grad()
+        wt.grad = torch.tensor(g.copy())
+        opt.step()
+        params, state = sgd.apply(params, {"w": jnp.asarray(g)}, state)
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   wt.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_top1_correct():
+    logits = jnp.asarray([[1.0, 2.0], [5.0, 0.0], [0.0, 1.0]])
+    labels = jnp.asarray([1, 0, 0])
+    assert int(top1_correct(logits, labels)) == 2

@@ -1,0 +1,63 @@
+"""DistributedShardSampler parity against torch.utils.data.DistributedSampler
+(the reference's sharding mechanism, part2/part2b/main.py:78-79)."""
+
+import numpy as np
+import pytest
+import torch
+from torch.utils.data import DistributedSampler
+
+from tpu_ddp.data.sampler import DistributedShardSampler
+
+
+class _FakeDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+
+@pytest.mark.parametrize("n,ws", [(50_000, 4), (10, 3), (7, 4), (16, 2),
+                                  (5, 5), (1, 2)])
+def test_no_shuffle_bit_parity_with_torch(n, ws):
+    for rank in range(ws):
+        torch_s = DistributedSampler(_FakeDataset(n), num_replicas=ws,
+                                     rank=rank, shuffle=False,
+                                     drop_last=False)
+        ours = DistributedShardSampler(n, num_replicas=ws, rank=rank,
+                                       shuffle=False, drop_last=False)
+        np.testing.assert_array_equal(np.fromiter(iter(torch_s), dtype=np.int64),
+                                      ours.indices())
+        assert len(torch_s) == len(ours)
+
+
+@pytest.mark.parametrize("n,ws", [(103, 4), (64, 8)])
+def test_drop_last_parity_with_torch(n, ws):
+    for rank in range(ws):
+        torch_s = DistributedSampler(_FakeDataset(n), num_replicas=ws,
+                                     rank=rank, shuffle=False, drop_last=True)
+        ours = DistributedShardSampler(n, num_replicas=ws, rank=rank,
+                                       shuffle=False, drop_last=True)
+        np.testing.assert_array_equal(np.fromiter(iter(torch_s), dtype=np.int64),
+                                      ours.indices())
+
+
+def test_shuffle_is_a_partition_and_epoch_dependent():
+    n, ws = 101, 4
+    shards0, shards1 = [], []
+    for rank in range(ws):
+        s = DistributedShardSampler(n, num_replicas=ws, rank=rank,
+                                    shuffle=True, seed=7)
+        s.set_epoch(0)
+        shards0.append(s.indices())
+        s.set_epoch(1)
+        shards1.append(s.indices())
+    # Union of shards covers the dataset (with wrap padding allowed).
+    assert set(np.concatenate(shards0)) == set(range(n))
+    # set_epoch changes the permutation.
+    assert any(not np.array_equal(a, b) for a, b in zip(shards0, shards1))
+    # Same epoch is deterministic.
+    s = DistributedShardSampler(n, num_replicas=ws, rank=2, shuffle=True,
+                                seed=7)
+    s.set_epoch(0)
+    np.testing.assert_array_equal(s.indices(), shards0[2])

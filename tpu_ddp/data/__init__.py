@@ -1,0 +1,19 @@
+"""Host-side data pipeline (reference L4, ``create_data_loaders``).
+
+Replaces torchvision's CIFAR-10 dataset + transforms + torch DataLoader +
+DistributedSampler (reference part1/main.py:19-50, part2/part2b/main.py:61-94)
+with a numpy pipeline feeding the device mesh: local CIFAR-10 batches (or a
+deterministic synthetic stand-in when the dataset isn't on disk — this
+environment has no network egress), vectorized crop/flip augmentation, and a
+sampler reproducing ``torch.utils.data.DistributedSampler`` semantics
+exactly (verified against torch in tests/test_sampler.py).
+"""
+
+from tpu_ddp.data.cifar10 import (  # noqa: F401
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    load_cifar10,
+    normalize,
+)
+from tpu_ddp.data.sampler import DistributedShardSampler  # noqa: F401
+from tpu_ddp.data.loader import DataLoader, create_data_loaders  # noqa: F401

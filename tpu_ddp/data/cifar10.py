@@ -1,0 +1,104 @@
+"""CIFAR-10 dataset: local load + normalization constants.
+
+The reference downloads via ``torchvision.datasets.CIFAR10(download=True)``
+(reference part1/main.py:34). This environment has no network egress, so we
+(1) load the standard ``cifar-10-batches-py`` pickle format from any of the
+usual on-disk locations, and (2) fall back to a deterministic synthetic
+stand-in with identical shapes/dtypes so every part, test and benchmark runs
+without the real data (clearly flagged in the returned metadata).
+
+Normalization constants are the reference's exactly
+(part1/main.py:20-21): mean [125.3, 123.0, 113.9]/255,
+std [63.0, 62.1, 66.7]/255.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+CIFAR10_MEAN = np.array([125.3, 123.0, 113.9], dtype=np.float32) / 255.0
+CIFAR10_STD = np.array([63.0, 62.1, 66.7], dtype=np.float32) / 255.0
+
+_TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
+_TEST_FILES = ["test_batch"]
+
+# Candidate roots, including the reference's relative roots
+# (part1/main.py:34 "./../data", part2b/main.py:76 "./../../data").
+_SEARCH_ROOTS = [
+    os.environ.get("CIFAR10_DIR", ""),
+    "./data", "../data", "../../data",
+    os.path.expanduser("~/data"),
+    "/root/data", "/data", "/tmp/data",
+]
+
+
+def _find_batches_dir(root: str | None = None):
+    roots = [root] if root else [r for r in _SEARCH_ROOTS if r]
+    for r in roots:
+        cand = os.path.join(r, "cifar-10-batches-py")
+        if os.path.isdir(cand):
+            return cand
+        if os.path.isdir(r) and os.path.exists(
+                os.path.join(r, "data_batch_1")):
+            return r
+        tgz = os.path.join(r, "cifar-10-python.tar.gz")
+        if os.path.isfile(tgz):
+            with tarfile.open(tgz) as tf:
+                tf.extractall(r)
+            return os.path.join(r, "cifar-10-batches-py")
+    return None
+
+
+def _load_pickled(batches_dir: str, files):
+    images, labels = [], []
+    for name in files:
+        with open(os.path.join(batches_dir, name), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        # CHW-flat uint8 -> NHWC uint8 (we are NHWC-native for the TPU).
+        arr = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        images.append(arr)
+        labels.append(np.asarray(d[b"labels"], dtype=np.int32))
+    return np.concatenate(images), np.concatenate(labels)
+
+
+def _synthetic(split: str, n: int | None):
+    """Deterministic stand-in: same shapes/dtypes/class balance as CIFAR-10.
+
+    Images are class-conditional noise (mean shifted per class) so training
+    CAN reduce loss, making convergence smoke-tests meaningful.
+    """
+    if n is None:
+        n = 50_000 if split == "train" else 10_000
+        n = int(os.environ.get("TPU_DDP_SYNTH_SIZE", n))
+    rng = np.random.default_rng(0xC1FA8 + (0 if split == "train" else 1))
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    base = rng.normal(0, 40, size=(10, 1, 1, 3))
+    images = rng.normal(128, 50, size=(n, 32, 32, 3))
+    images = np.clip(images + base[labels], 0, 255).astype(np.uint8)
+    return images, labels
+
+
+def load_cifar10(root: str | None = None, split: str = "train",
+                 synthetic_size: int | None = None):
+    """Returns ``(images_u8_nhwc, labels_i32, meta)``.
+
+    ``meta["synthetic"]`` tells callers whether the real dataset was found.
+    """
+    batches_dir = _find_batches_dir(root)
+    if batches_dir is not None:
+        files = _TRAIN_FILES if split == "train" else _TEST_FILES
+        images, labels = _load_pickled(batches_dir, files)
+        return images, labels, {"synthetic": False, "dir": batches_dir}
+    images, labels = _synthetic(split, synthetic_size)
+    return images, labels, {"synthetic": True, "dir": None}
+
+
+def normalize(images_u8: np.ndarray) -> np.ndarray:
+    """uint8 NHWC -> normalized float32 (ToTensor + Normalize,
+    reference part1/main.py:20-31)."""
+    x = images_u8.astype(np.float32) / 255.0
+    return (x - CIFAR10_MEAN) / CIFAR10_STD

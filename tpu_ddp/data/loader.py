@@ -1,0 +1,101 @@
+"""Batched data loader over numpy arrays + the create_data_loaders facade.
+
+The reference's ``create_data_loaders`` (part1/main.py:19-50 single;
+part2/part2b/main.py:61-94 sharded) returns ``(train_loader, test_loader)``
+with: global batch 256 (per-node ``int(256/ws)``), train sharded by a
+DistributedSampler (``shuffle=False, drop_last=False``), test NOT sharded,
+augmentation on train only. Same contract here, numpy end to end.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_ddp.data.augment import random_crop_flip
+from tpu_ddp.data.cifar10 import load_cifar10, normalize
+from tpu_ddp.data.sampler import DistributedShardSampler
+from tpu_ddp.utils.config import SEED
+
+
+class DataLoader:
+    """Iterates (normalized f32 NHWC images, i32 labels) batches.
+
+    Augmentation RNG is seeded per (seed, epoch) so every run — and every
+    replica, which matters because each replica loads only its own shard —
+    is deterministic; call :meth:`set_epoch` like the reference does with
+    ``train_loader.sampler.set_epoch(epoch)`` (part2/part2b/main.py:189).
+    """
+
+    def __init__(
+        self,
+        images_u8: np.ndarray,
+        labels: np.ndarray,
+        batch_size: int,
+        sampler: DistributedShardSampler | None = None,
+        augment: bool = False,
+        seed: int = SEED,
+    ):
+        self.images_u8 = images_u8
+        self.labels = np.asarray(labels, dtype=np.int32)
+        self.batch_size = batch_size
+        self.sampler = sampler
+        self.augment = augment
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        if self.sampler is not None:
+            self.sampler.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        n = len(self.sampler) if self.sampler is not None \
+            else len(self.labels)
+        # drop_last=False everywhere in the reference (part1/main.py:36-41):
+        # final short batch is kept.
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        idx = (self.sampler.indices() if self.sampler is not None
+               else np.arange(len(self.labels)))
+        rng = np.random.default_rng((self.seed, self.epoch))
+        for start in range(0, len(idx), self.batch_size):
+            sel = idx[start:start + self.batch_size]
+            imgs = self.images_u8[sel]
+            if self.augment:
+                imgs = random_crop_flip(imgs, rng)
+            yield normalize(imgs), self.labels[sel]
+
+
+def create_data_loaders(
+    rank: int = 0,
+    world_size: int = 1,
+    batch_size: int = 256,
+    root: str | None = None,
+    seed: int = SEED,
+    synthetic_size: int | None = None,
+):
+    """(train_loader, test_loader), the reference's L4 facade.
+
+    ``batch_size`` here is the PER-NODE batch, exactly as the reference
+    passes ``int(256/world_size)`` in (part2/part2b/main.py:177). Train is
+    sharded by rank with DistributedSampler semantics (``shuffle=False,
+    drop_last=False``, part2/part2b/main.py:78-79); test is unsharded so
+    every node evaluates the full set (part2/part2b/main.py:89-93).
+    """
+    train_x, train_y, meta = load_cifar10(root, "train", synthetic_size)
+    test_x, test_y, _ = load_cifar10(
+        root, "test",
+        None if synthetic_size is None else max(synthetic_size // 5, 10))
+    if meta["synthetic"]:
+        print("[tpu_ddp.data] CIFAR-10 not found on disk -> deterministic "
+              "synthetic stand-in (set CIFAR10_DIR to use the real data)")
+    sampler = None
+    if world_size > 1:
+        sampler = DistributedShardSampler(
+            len(train_y), num_replicas=world_size, rank=rank,
+            shuffle=False, drop_last=False)
+    train_loader = DataLoader(train_x, train_y, batch_size,
+                              sampler=sampler, augment=True, seed=seed)
+    test_loader = DataLoader(test_x, test_y, batch_size, augment=False)
+    return train_loader, test_loader

@@ -1,0 +1,36 @@
+"""Model zoo.
+
+Parity target: the reference's config-driven VGG builder
+(reference part1/model.py:1-50, byte-identical across all four parts) —
+VGG11/13/16/19 channel plans, only VGG11 exported by default, BatchNorm with
+``track_running_stats=False`` (eval uses batch statistics), 512 -> 10 head.
+"""
+
+from tpu_ddp.models.vgg import (  # noqa: F401
+    VGG_CFG,
+    VGGModel,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+    make_vgg,
+)
+from tpu_ddp.models.resnet import ResNetModel, resnet50, make_resnet  # noqa: F401
+
+_REGISTRY = {
+    "VGG11": vgg11,
+    "VGG13": vgg13,
+    "VGG16": vgg16,
+    "VGG19": vgg19,
+    "ResNet50": resnet50,
+}
+
+
+def get_model(name: str, **kwargs):
+    """Look up a model factory by name (e.g. ``get_model("VGG11")``)."""
+    try:
+        return _REGISTRY[name](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None

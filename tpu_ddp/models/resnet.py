@@ -1,0 +1,153 @@
+"""Functional ResNet (bottleneck) family — the BASELINE.json stretch config
+("ResNet-50 / ImageNet-1k scale-up", configs[4]). No reference counterpart
+exists (the reference ships only VGG, part1/model.py); this follows the same
+functional/NHWC/bf16 conventions as ``tpu_ddp.models.vgg``.
+
+BatchNorm uses current-batch statistics only, matching the framework-wide BN
+semantic chosen for parity with the reference (part1/model.py:24,
+``track_running_stats=False``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_ddp.models.vgg import batch_norm
+
+RESNET_CFG = {
+    # (blocks per stage); bottleneck width multiplier is 4.
+    "ResNet50": (3, 4, 6, 3),
+    "ResNet101": (3, 4, 23, 3),
+    "ResNet152": (3, 8, 36, 3),
+}
+
+_STAGE_WIDTHS = (64, 128, 256, 512)
+
+
+def _he_normal(key, shape, dtype):
+    fan_in = 1
+    for d in shape[:-1]:
+        fan_in *= d
+    std = (2.0 / fan_in) ** 0.5
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def _conv(x, kernel, stride, cd):
+    # bf16 in / bf16 out; MXU accumulates f32 internally, BN restores f32.
+    return lax.conv_general_dilated(
+        x.astype(cd), kernel.astype(cd),
+        window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetModel:
+    name: str
+    stage_blocks: tuple
+    num_classes: int = 1000
+    in_channels: int = 3
+    small_inputs: bool = False   # True: 3x3/1 stem, no stem pool (CIFAR)
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def _conv_bn(self, key, h, w, c_in, c_out):
+        k_w, = jax.random.split(key, 1)
+        return {
+            "kernel": _he_normal(k_w, (h, w, c_in, c_out), self.param_dtype),
+            "bn_scale": jnp.ones((c_out,), self.param_dtype),
+            "bn_bias": jnp.zeros((c_out,), self.param_dtype),
+        }
+
+    def init(self, key) -> dict:
+        keys = iter(jax.random.split(key, 4096))
+        stem_hw = 3 if self.small_inputs else 7
+        params = {"stem": self._conv_bn(next(keys), stem_hw, stem_hw,
+                                        self.in_channels, 64)}
+        c_in = 64
+        stages = []
+        for si, n_blocks in enumerate(self.stage_blocks):
+            width = _STAGE_WIDTHS[si]
+            blocks = []
+            for bi in range(n_blocks):
+                block = {
+                    "conv1": self._conv_bn(next(keys), 1, 1, c_in, width),
+                    "conv2": self._conv_bn(next(keys), 3, 3, width, width),
+                    "conv3": self._conv_bn(next(keys), 1, 1, width, width * 4),
+                }
+                if bi == 0 and c_in != width * 4:
+                    block["proj"] = self._conv_bn(next(keys), 1, 1, c_in,
+                                                  width * 4)
+                blocks.append(block)
+                c_in = width * 4
+            stages.append(tuple(blocks))
+        head_key = next(keys)
+        params["stages"] = tuple(stages)
+        params["head"] = {
+            "kernel": _he_normal(head_key, (c_in, self.num_classes),
+                                 self.param_dtype),
+            "bias": jnp.zeros((self.num_classes,), self.param_dtype),
+        }
+        return params
+
+    def _bn_relu(self, x, p, relu=True):
+        y = batch_norm(x, p["bn_scale"].astype(jnp.float32),
+                       p["bn_bias"].astype(jnp.float32))
+        if relu:
+            y = jnp.maximum(y, 0)
+        return y.astype(self.compute_dtype)
+
+    def apply(self, params, x):
+        cd = self.compute_dtype
+        stem_stride = 1 if self.small_inputs else 2
+        x = _conv(x, params["stem"]["kernel"], stem_stride, cd)
+        x = self._bn_relu(x, params["stem"])
+        if not self.small_inputs:
+            x = lax.reduce_window(
+                x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+        for si, stage in enumerate(params["stages"]):
+            for bi, block in enumerate(stage):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                shortcut = x
+                y = _conv(x, block["conv1"]["kernel"], 1, cd)
+                y = self._bn_relu(y, block["conv1"])
+                y = _conv(y, block["conv2"]["kernel"], stride, cd)
+                y = self._bn_relu(y, block["conv2"])
+                y = _conv(y, block["conv3"]["kernel"], 1, cd)
+                y = self._bn_relu(y, block["conv3"], relu=False)
+                if "proj" in block:
+                    shortcut = _conv(shortcut, block["proj"]["kernel"],
+                                     stride, cd)
+                    shortcut = self._bn_relu(shortcut, block["proj"],
+                                             relu=False)
+                elif stride != 1:
+                    shortcut = lax.reduce_window(
+                        shortcut, -jnp.inf, lax.max,
+                        (1, 1, 1, 1), (1, stride, stride, 1), "SAME")
+                x = jnp.maximum(y.astype(jnp.float32)
+                                + shortcut.astype(jnp.float32), 0).astype(cd)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        logits = jnp.dot(x.astype(cd), params["head"]["kernel"].astype(cd))
+        logits = logits.astype(jnp.float32) \
+            + params["head"]["bias"].astype(jnp.float32)
+        return logits
+
+    def num_params(self, params=None, key=None) -> int:
+        if params is None:
+            params = self.init(key if key is not None else jax.random.key(0))
+        return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+def make_resnet(name: str = "ResNet50", **kwargs) -> ResNetModel:
+    if name not in RESNET_CFG:
+        raise ValueError(f"unknown ResNet variant {name!r}")
+    return ResNetModel(name=name, stage_blocks=RESNET_CFG[name], **kwargs)
+
+
+def resnet50(**kw):
+    return make_resnet("ResNet50", **kw)

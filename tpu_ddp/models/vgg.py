@@ -1,0 +1,201 @@
+"""Functional VGG family for 32x32 inputs — TPU-native.
+
+Parity target: reference part1/model.py:1-50 (byte-identical across parts).
+Design differences (deliberate, TPU-first):
+
+- **Functional, not stateful**: ``init`` returns a parameter pytree;
+  ``apply(params, x)`` is a pure function, so the whole train step jits into
+  a single XLA program.
+- **NHWC layout** with ``HWIO`` kernels — the layout XLA:TPU tiles onto the
+  MXU without transposes (torch uses NCHW; reference part1/model.py:18-25).
+- **bf16 compute / f32 params**: convolutions and the final matmul run in
+  ``compute_dtype`` (bfloat16 by default) with float32 accumulation
+  (``preferred_element_type``); batch-norm statistics are always float32.
+- **BatchNorm semantics**: the reference constructs every BN with
+  ``track_running_stats=False`` (reference part1/model.py:24) so *both train
+  and eval use the current batch's statistics* — a deliberate fix for
+  cross-replica running-stat divergence (report §3.2). We reproduce exactly
+  that: BN here has only ``scale``/``bias`` parameters and no running state.
+
+Channel plans match reference part1/model.py:3-8: 3x3 conv (pad 1, bias) ->
+BN -> ReLU per entry, MaxPool 2x2/2 at ``'M'``, then flatten 512 -> Linear
+to ``num_classes``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Channel plans (reference part1/model.py:3-8). 'M' = 2x2/2 max-pool.
+VGG_CFG = {
+    "VGG11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "VGG13": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+              512, 512, "M"),
+    "VGG16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+              "M", 512, 512, 512, "M"),
+    "VGG19": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+BN_EPS = 1e-5  # torch BatchNorm2d default, matched for loss-curve parity
+
+
+def _uniform_fan_in(key, shape, fan_in, dtype):
+    """U(-1/sqrt(fan_in), 1/sqrt(fan_in)).
+
+    Same distribution as torch's default Conv2d/Linear init
+    (kaiming_uniform with a=sqrt(5) reduces to exactly this bound), so the
+    rebuilt model starts from a statistically equivalent point. Bit parity
+    with torch RNG is a non-goal (SURVEY.md §7 "hard parts").
+    """
+    bound = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def batch_norm(x, scale, bias, eps=BN_EPS):
+    """Batch normalisation over (N, H, W) using *current batch* statistics.
+
+    No running stats, in train and eval alike — the
+    ``track_running_stats=False`` semantic of reference part1/model.py:24.
+    Statistics are computed in float32 regardless of compute dtype.
+    """
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(0, 1, 2))
+    var = jnp.var(x32, axis=(0, 1, 2))
+    inv = lax.rsqrt(var + eps) * scale
+    return ((x32 - mean) * inv + bias).astype(x.dtype)
+
+
+def max_pool_2x2(x):
+    """2x2 stride-2 max pool, NHWC (reference part1/model.py:16)."""
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, 2, 2, 1),
+        window_strides=(1, 2, 2, 1),
+        padding="VALID",
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class VGGModel:
+    """A VGG variant as a (init, apply) pair over a parameter pytree.
+
+    ``cfg`` is a static tuple, so instances hash and the apply function can
+    be closed over by ``jax.jit`` without retracing per call.
+    """
+
+    name: str
+    cfg: tuple
+    num_classes: int = 10
+    in_channels: int = 3
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    # ---- parameters ----------------------------------------------------
+
+    def init(self, key) -> dict:
+        """Build the parameter pytree.
+
+        Layout::
+
+            {"features": ({"kernel","bias","bn_scale","bn_bias"}, ...),
+             "head": {"kernel", "bias"}}
+
+        with one features entry per conv block ('M' entries carry no
+        parameters), kernels HWIO.
+        """
+        feats = []
+        c_in = self.in_channels
+        for width in self.cfg:
+            if width == "M":
+                continue
+            key, k_w, k_b = jax.random.split(key, 3)
+            fan_in = c_in * 3 * 3
+            feats.append({
+                "kernel": _uniform_fan_in(
+                    k_w, (3, 3, c_in, width), fan_in, self.param_dtype),
+                "bias": _uniform_fan_in(k_b, (width,), fan_in, self.param_dtype),
+                "bn_scale": jnp.ones((width,), self.param_dtype),
+                "bn_bias": jnp.zeros((width,), self.param_dtype),
+            })
+            c_in = width
+        key, k_w, k_b = jax.random.split(key, 3)
+        head = {
+            "kernel": _uniform_fan_in(
+                k_w, (c_in, self.num_classes), c_in, self.param_dtype),
+            "bias": _uniform_fan_in(k_b, (self.num_classes,), c_in,
+                                    self.param_dtype),
+        }
+        return {"features": tuple(feats), "head": head}
+
+    # ---- forward -------------------------------------------------------
+
+    def apply(self, params, x):
+        """Forward pass: NHWC image batch -> logits (float32).
+
+        Mirrors reference part1/model.py:41-45: features -> flatten -> fc.
+        Convs and the head matmul run in ``compute_dtype`` with float32
+        accumulation so the MXU sees bf16 operands.
+        """
+        cd = self.compute_dtype
+        x = x.astype(cd)
+        conv_i = 0
+        for width in self.cfg:
+            if width == "M":
+                x = max_pool_2x2(x)
+                continue
+            p = params["features"][conv_i]
+            conv_i += 1
+            # bf16 in / bf16 out: XLA:TPU still accumulates the MXU matmul
+            # in f32 internally; BN below recomputes stats in f32.
+            y = lax.conv_general_dilated(
+                x, p["kernel"].astype(cd),
+                window_strides=(1, 1), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            y = y.astype(jnp.float32) + p["bias"].astype(jnp.float32)
+            y = batch_norm(y, p["bn_scale"].astype(jnp.float32),
+                           p["bn_bias"].astype(jnp.float32))
+            x = jnp.maximum(y, 0).astype(cd)
+        # After 5 pools a 32x32 input is 1x1x512 -> flatten to 512
+        # (reference part1/model.py:42-44).
+        x = x.reshape(x.shape[0], -1)
+        logits = jnp.dot(x, params["head"]["kernel"].astype(cd))
+        logits = logits.astype(jnp.float32) \
+            + params["head"]["bias"].astype(jnp.float32)
+        return logits
+
+    def num_params(self, params=None, key=None) -> int:
+        if params is None:
+            params = self.init(key if key is not None else jax.random.key(0))
+        return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+def make_vgg(name: str = "VGG11", **kwargs) -> VGGModel:
+    """Factory over the config table (reference part1/model.py:49-50 exports
+    only VGG11; we expose the full table like its ``_cfg``)."""
+    if name not in VGG_CFG:
+        raise ValueError(f"unknown VGG variant {name!r}")
+    return VGGModel(name=name, cfg=VGG_CFG[name], **kwargs)
+
+
+def vgg11(**kw):
+    return make_vgg("VGG11", **kw)
+
+
+def vgg13(**kw):
+    return make_vgg("VGG13", **kw)
+
+
+def vgg16(**kw):
+    return make_vgg("VGG16", **kw)
+
+
+def vgg19(**kw):
+    return make_vgg("VGG19", **kw)

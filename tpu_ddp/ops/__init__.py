@@ -1,0 +1,10 @@
+"""Numerical ops owned by the framework: loss, optimizer, metrics.
+
+These replace the reference's dependency surface (torch ``CrossEntropyLoss``,
+``optim.SGD`` — ATen C++ kernels, SURVEY.md §2 row N3) with jax.numpy/XLA
+implementations that fuse into the jitted train step.
+"""
+
+from tpu_ddp.ops.loss import cross_entropy_loss, softmax_cross_entropy  # noqa: F401
+from tpu_ddp.ops.optim import SGD, SGDState  # noqa: F401
+from tpu_ddp.ops.metrics import top1_correct  # noqa: F401

@@ -1,0 +1,24 @@
+"""Cross-entropy loss.
+
+Replaces the reference's ``torch.nn.CrossEntropyLoss()`` (reference
+part1/main.py:119, applied to logits + integer labels with mean reduction).
+Implemented directly over ``logsumexp`` so XLA fuses it into the train step.
+"""
+
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp
+
+
+def softmax_cross_entropy(logits, labels):
+    """Per-example CE of integer ``labels`` against ``logits`` (f32)."""
+    logits = logits.astype(jnp.float32)
+    lse = logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return lse - picked
+
+
+def cross_entropy_loss(logits, labels):
+    """Mean-reduced CE — the exact semantics of torch's default
+    ``CrossEntropyLoss`` used at reference part1/main.py:74-75."""
+    return jnp.mean(softmax_cross_entropy(logits, labels))

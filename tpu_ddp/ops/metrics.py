@@ -1,0 +1,9 @@
+"""Eval metrics (reference part1/main.py:96-111: summed loss + top-1)."""
+
+import jax.numpy as jnp
+
+
+def top1_correct(logits, labels):
+    """Number of argmax-correct predictions in the batch
+    (reference part1/main.py:104-106)."""
+    return jnp.sum(jnp.argmax(logits, axis=-1) == labels)

@@ -1,0 +1,23 @@
+"""Distributed runtime: bootstrap, device mesh, gradient-sync strategies.
+
+Replaces the reference's L5/L1/L0 stack (SURVEY.md §1): gloo process group +
+manual collectives + torch DDP become ``jax.distributed`` rendezvous + XLA
+collectives (``psum``/``all_gather``) over the device mesh (ICI/DCN).
+"""
+
+from tpu_ddp.parallel.bootstrap import (  # noqa: F401
+    DistributedContext,
+    get_rank_from_hostname,
+    init_distributed_setup,
+    shutdown,
+    test_distributed_setup,
+)
+from tpu_ddp.parallel.mesh import make_mesh, data_parallel_specs  # noqa: F401
+from tpu_ddp.parallel.sync import (  # noqa: F401
+    SYNC_STRATEGIES,
+    get_sync_strategy,
+    sync_none,
+    sync_gather_scatter,
+    sync_all_reduce,
+    sync_fused,
+)

@@ -1,0 +1,55 @@
+"""Device-mesh construction and sharding specs.
+
+The TPU-native replacement for the reference's notion of "world" (N gloo
+processes): a ``jax.sharding.Mesh`` over all devices with a ``dp`` axis.
+Data-parallel replicas are mesh slots; the batch is sharded over ``dp`` and
+parameters are replicated — XLA then lowers the gradient ``psum`` onto ICI
+(intra-slice) / DCN (cross-slice) automatically (SURVEY.md §2 row N1).
+
+A second, size-1-by-default ``mp`` axis is kept in the mesh shape so tensor/
+pipeline extensions can widen the mesh without touching callers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "dp"
+MODEL_AXIS = "mp"
+
+
+def make_mesh(devices=None, dp: int | None = None, mp: int = 1) -> Mesh:
+    """Build a (dp, mp) mesh over ``devices`` (default: all devices).
+
+    ``dp`` defaults to ``len(devices) // mp``. For pure data parallelism
+    (the reference's only mode) this is a 1-D dp mesh with a trivial mp
+    axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp is None:
+        if n % mp:
+            raise ValueError(f"{n} devices not divisible by mp={mp}")
+        dp = n // mp
+    if dp * mp != n:
+        raise ValueError(f"dp*mp = {dp}*{mp} != {n} devices")
+    arr = np.asarray(devices).reshape(dp, mp)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_parallel_specs():
+    """(batch_spec, replicated_spec) for classic DP: batch split over dp,
+    params/opt-state replicated."""
+    return P(DATA_AXIS), P()
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,118 @@
+"""The four gradient-synchronization strategies — the heart of the ladder.
+
+Parity map (SURVEY.md §1 L1):
+
+=========  =====================================================  ==========================
+strategy   reference implementation                               TPU-native implementation
+=========  =====================================================  ==========================
+none       part1: no sync calls (part1/main.py:52)                identity
+gather     part2a ``sync_gradients(model, rank, ws)``             per-leaf ``all_gather`` to
+scatter    (part2/part2a/main.py:97-115): rank 0 gathers every    every replica; the *root
+           param grad, means, scatters the mean back              replica's* mean is selected
+                                                                  and broadcast via ``psum``
+                                                                  so "who computes the mean"
+                                                                  matches the reference
+all_reduce part2b ``sync_gradients(model, ws)``                   per-leaf ``psum(SUM)`` then
+           (part2/part2b/main.py:97-103): per-param               divide by world size
+           ``all_reduce(SUM)`` then ``grad /= ws``
+fused      part3 ``DDP(model)`` (part3/main.py:174): bucketed     one tree-level ``pmean``
+           async all-reduce overlapped with backward by the       inside the jitted step —
+           C++ reducer (25 MB buckets)                            XLA's latency-hiding
+                                                                  scheduler overlaps the ICI
+                                                                  collective with the rest of
+                                                                  the backward pass (the
+                                                                  idiomatic analogue of
+                                                                  bucketing, SURVEY §2 N2)
+=========  =====================================================  ==========================
+
+All strategies are pure functions ``(grads, axis_name) -> grads`` applied
+inside the (shard_map'd, jitted) train step, so every strategy produces
+identical synchronized gradients — the ladder's correctness invariant
+(report §2.2) — and they are numerically interchangeable (tested in
+tests/test_sync.py).
+
+Note on part2a fidelity: XLA/SPMD has no asymmetric root-centric collective;
+the composition below preserves the *semantics* (the root's mean is what
+every replica applies) while the latency asymmetry of a TCP master
+bottleneck does not exist on ICI (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sync_none(grads, axis_name=None):
+    """part1: single device, no synchronization (reference part1/main.py:52)."""
+    return grads
+
+
+def _leafwise(fn, grads):
+    return jax.tree.map(fn, grads)
+
+
+def sync_gather_scatter(grads, axis_name):
+    """part2a: gather all replicas' grads at the root, mean there, scatter.
+
+    Reference part2/part2a/main.py:97-115 does, per parameter: rank 0
+    allocates ``world_size`` buffers, ``dist.gather(...)``, means the stack,
+    ``dist.scatter(...)`` the mean back; other ranks send/receive. Here each
+    leaf is ``all_gather``'d, the mean is computed, and the *root replica's*
+    copy of the mean is what gets broadcast (mask + ``psum``) — so the value
+    every replica applies is, as in the reference, the root's mean.
+    """
+    idx = lax.axis_index(axis_name)
+
+    def leaf(g):
+        stacked = lax.all_gather(g, axis_name)          # (world, ...)
+        mean = jnp.mean(stacked, axis=0)
+        root_only = jnp.where(idx == 0, mean, jnp.zeros_like(mean))
+        return lax.psum(root_only, axis_name)           # broadcast root's mean
+
+    return _leafwise(leaf, grads)
+
+
+def sync_all_reduce(grads, axis_name):
+    """part2b: per-parameter ring all-reduce(SUM), then divide by world size
+    (reference part2/part2b/main.py:97-103). Kept per-leaf for ladder
+    pedagogy; XLA may still fuse adjacent collectives."""
+    world = lax.psum(1, axis_name)
+    return _leafwise(lambda g: lax.psum(g, axis_name) / world, grads)
+
+
+def sync_fused(grads, axis_name):
+    """part3: the DDP equivalent — one tree-level ``pmean`` inside the jitted
+    step. XLA sees the whole backward + collective dataflow and overlaps the
+    ICI all-reduce with remaining backward compute, which is what torch DDP's
+    25 MB bucketing + autograd hooks achieve by hand (reference
+    part3/main.py:13,174; SURVEY.md §2 row N2)."""
+    return lax.pmean(grads, axis_name)
+
+
+SYNC_STRATEGIES = {
+    "none": sync_none,
+    "gather_scatter": sync_gather_scatter,
+    "all_reduce": sync_all_reduce,
+    "fused": sync_fused,
+}
+
+# The reference parts, by name.
+PART_TO_STRATEGY = {
+    "part1": "none",
+    "part2a": "gather_scatter",
+    "part2b": "all_reduce",
+    "part3": "fused",
+}
+
+
+def get_sync_strategy(name: str):
+    key = PART_TO_STRATEGY.get(name, name)
+    try:
+        return SYNC_STRATEGIES[key]
+    except KeyError:
+        raise ValueError(
+            f"unknown sync strategy {name!r}; available: "
+            f"{sorted(SYNC_STRATEGIES)} or parts {sorted(PART_TO_STRATEGY)}"
+        ) from None

@@ -1,0 +1,3 @@
+"""Training/eval engine (reference L2: ``train_model``/``test_model``)."""
+
+from tpu_ddp.train.engine import Trainer, TrainState  # noqa: F401

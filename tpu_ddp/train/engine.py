@@ -1,0 +1,291 @@
+"""Train/eval engine — the reference's L2 (``train_model``/``test_model``),
+rebuilt as jit-compiled XLA programs.
+
+The reference loop body (identical skeleton in all four parts,
+part2/part2b/main.py:124-132) is::
+
+    optimizer.zero_grad(); out = model(x); loss = CE(out, y)
+    loss.backward(); [sync_gradients(...)]; optimizer.step()
+
+Here the entire body — forward, backward, gradient sync (one of the four
+strategies), optimizer update — is ONE jitted function. On a device mesh the
+step is ``shard_map``'d: batch sharded over the ``dp`` axis, params and
+optimizer state replicated, the sync strategy's XLA collectives riding ICI.
+Instrumentation parity: running-loss print every 20 iterations and the
+iteration-1..39 ns timer (reference part1/main.py:82-91) both survive, with
+``block_until_ready`` before the clock stops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_ddp.ops.loss import cross_entropy_loss, softmax_cross_entropy
+from tpu_ddp.ops.metrics import top1_correct
+from tpu_ddp.ops.optim import SGD
+from tpu_ddp.parallel.mesh import DATA_AXIS
+from tpu_ddp.parallel.sync import get_sync_strategy
+from tpu_ddp.utils.config import TrainConfig
+from tpu_ddp.utils.timing import IterationTimer
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: int = 0
+
+
+class Trainer:
+    """Wires model + optimizer + sync strategy into jitted train/eval steps.
+
+    ``mesh=None`` is the part1 configuration (single device, plain ``jit``);
+    with a mesh, the step is ``shard_map``'d over it and ``strategy`` picks
+    which of the four ladder rungs synchronizes the gradients.
+    """
+
+    def __init__(
+        self,
+        model,
+        config: TrainConfig | None = None,
+        strategy: str = "none",
+        mesh: Mesh | None = None,
+    ):
+        self.model = model
+        self.config = config or TrainConfig()
+        self.strategy_name = strategy
+        self.sync_fn = get_sync_strategy(strategy)
+        self.mesh = mesh
+        self.optimizer = SGD(
+            learning_rate=self.config.learning_rate,
+            momentum=self.config.momentum,
+            weight_decay=self.config.weight_decay,
+        )
+        if mesh is not None:
+            self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+            self._repl_sharding = NamedSharding(mesh, P())
+        self._train_step = self._build_train_step()
+        self._eval_step = jax.jit(self._eval_step_impl)
+
+    # ---- state ---------------------------------------------------------
+
+    def init_state(self, seed: int | None = None) -> TrainState:
+        """Parameter init from the shared seed — correctness invariant (i)
+        of the reference (seed 89395 on every node, part1/main.py:115-117):
+        every replica deterministically builds identical parameters."""
+        seed = self.config.seed if seed is None else seed
+        params = self.model.init(jax.random.key(seed))
+        opt_state = self.optimizer.init(params)
+        if self.mesh is not None:
+            params = jax.device_put(params, self._repl_sharding)
+            opt_state = jax.device_put(opt_state, self._repl_sharding)
+        return TrainState(params=params, opt_state=opt_state)
+
+    # ---- train step ----------------------------------------------------
+
+    def _base_step(self, params, opt_state, images, labels, weights):
+        """One step over (possibly wrap-padded) local batch.
+
+        ``weights`` is 1.0 for real examples, 0.0 for padding added by
+        :meth:`put_batch` to satisfy even sharding. The differentiated loss
+        is scaled so that mean-of-replica-gradients == the gradient of the
+        GLOBAL batch-mean loss regardless of padding: per replica we use
+        ``R * sum(w*l) / total`` where ``total = psum(sum(w))`` — the mean
+        over R replicas then telescopes to ``sum_all(l)/total``. With equal
+        unpadded shards this reduces to the plain local batch mean, i.e. the
+        reference's semantics (part2/part2b/main.py:124-132) exactly.
+        """
+
+        def loss_fn(p):
+            logits = self.model.apply(p, images)
+            per_ex = softmax_cross_entropy(logits, labels)
+            wsum = jnp.sum(weights * per_ex)
+            n_local = jnp.sum(weights)
+            if self.mesh is not None:
+                n_total = lax.psum(n_local, DATA_AXIS)
+                n_replicas = lax.psum(1.0, DATA_AXIS)
+                loss_for_grad = n_replicas * wsum / n_total
+            else:
+                loss_for_grad = wsum / jnp.maximum(n_local, 1.0)
+            local_mean = wsum / jnp.maximum(n_local, 1.0)
+            return loss_for_grad, local_mean
+
+        (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = self.sync_fn(grads, DATA_AXIS) if self.mesh is not None \
+            else self.sync_fn(grads)
+        params, opt_state = self.optimizer.apply(params, grads, opt_state)
+        return params, opt_state, loss
+
+    def _build_train_step(self) -> Callable:
+        if self.mesh is None:
+            return jax.jit(self._base_step, donate_argnums=(0, 1))
+
+        def sharded_body(params, opt_state, images, labels, weights):
+            params, opt_state, loss = self._base_step(
+                params, opt_state, images, labels, weights)
+            # Per-replica scalar -> (1,) so out_spec P(dp) stacks to (dp,):
+            # each node keeps printing ITS shard's running loss, as in the
+            # reference (every node prints locally, part2b/main.py:134-139).
+            return params, opt_state, loss.reshape(1)
+
+        mapped = jax.shard_map(
+            sharded_body,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P(), P(DATA_AXIS)),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def train_step(self, state: TrainState, images, labels,
+                   weights=None) -> tuple:
+        """One optimization step; returns (state, loss).
+
+        With a mesh, ``loss`` is the per-replica loss vector (one entry per
+        dp slot); without, a scalar. ``weights`` defaults to all-ones (use
+        :meth:`put_batch`, which builds and shards them).
+        """
+        if weights is None:
+            weights = jnp.ones((images.shape[0],), jnp.float32)
+        params, opt_state, loss = self._train_step(
+            state.params, state.opt_state, images, labels, weights)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    # ---- data placement ------------------------------------------------
+
+    def put_batch(self, images, labels):
+        """Place a host batch onto the mesh: batch axis sharded over dp.
+
+        Returns ``(images, labels, weights)``. When the batch size is not
+        divisible by the number of dp slots (the ragged final batch of a
+        ``drop_last=False`` epoch, reference part1/main.py:36-41), the batch
+        is wrap-padded to divisibility and the padding rows get weight 0 —
+        the weighted loss in :meth:`_base_step` makes them exact no-ops.
+
+        Single process: ``images``/``labels`` are the global batch. Multi
+        process: they are this process's shard of the global batch (the L4
+        sampler already sharded them — shard sizes are symmetric across
+        ranks by DistributedSampler padding), assembled into a global array.
+        """
+        images = np.asarray(images)
+        labels = np.asarray(labels)
+        weights = np.ones((len(labels),), np.float32)
+        if self.mesh is None:
+            return jnp.asarray(images), jnp.asarray(labels), \
+                jnp.asarray(weights)
+        n_slots = self.mesh.shape[DATA_AXIS]
+        local_slots = max(n_slots // max(jax.process_count(), 1), 1)
+        if len(labels) % local_slots:
+            pad = local_slots - len(labels) % local_slots
+            sel = np.arange(pad) % len(labels)
+            images = np.concatenate([images, images[sel]])
+            labels = np.concatenate([labels, labels[sel]])
+            weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+        if jax.process_count() == 1:
+            return (jax.device_put(images, self._batch_sharding),
+                    jax.device_put(labels, self._batch_sharding),
+                    jax.device_put(weights, self._batch_sharding))
+        return (
+            jax.make_array_from_process_local_data(self._batch_sharding, images),
+            jax.make_array_from_process_local_data(self._batch_sharding, labels),
+            jax.make_array_from_process_local_data(self._batch_sharding, weights),
+        )
+
+    # ---- epoch loop (reference train_model, part1/main.py:52-93) -------
+
+    def train_epoch(
+        self,
+        state: TrainState,
+        batches,
+        epoch: int = 0,
+        log: Callable[[str], None] = print,
+    ) -> tuple[TrainState, dict]:
+        cfg = self.config
+        timer = IterationTimer(cfg.timing_first_iter, cfg.timing_last_iter)
+        running_loss = 0.0
+        last_loss = 0.0
+        n_iters = 0
+        for it, (images, labels) in enumerate(batches):
+            if cfg.max_iters is not None and it >= cfg.max_iters:
+                break
+            timer.start()
+            x, y, w = self.put_batch(images, labels)
+            state, loss = self.train_step(state, x, y, w)
+            # Force completion before stopping the clock — the JAX-correct
+            # analogue of the reference's synchronous CPU timing
+            # (part1/main.py:86-91).
+            loss = jax.block_until_ready(loss)
+            timer.stop(it)
+            if self.mesh is not None:
+                # THIS node's shard loss (first dp slot owned by this
+                # process), matching the reference where every node prints
+                # its local running loss (part2b/main.py:134-139).
+                local_loss = float(
+                    np.ravel(loss.addressable_shards[0].data)[0])
+            else:
+                local_loss = float(loss)
+            running_loss += local_loss
+            last_loss = local_loss
+            n_iters = it + 1
+            # Loss print cadence: every 20 mini-batches
+            # (reference part1/main.py:82-84).
+            if it % cfg.log_every == cfg.log_every - 1:
+                log(f"[epoch {epoch}, iter {it + 1}] "
+                    f"loss: {running_loss / cfg.log_every:.3f}")
+                running_loss = 0.0
+            if it == cfg.timing_last_iter:
+                log(timer.report(prefix=f"[epoch {epoch}] "))
+        return state, {
+            "avg_iter_ns": timer.average_ns,
+            "avg_iter_s": timer.average_s,
+            "timed_iters": timer.count,
+            "last_loss": last_loss,
+            "iters": n_iters,
+        }
+
+    # ---- eval (reference test_model, part1/main.py:96-111) -------------
+
+    def _eval_step_impl(self, params, images, labels):
+        logits = self.model.apply(params, images)
+        # Batch-mean loss (summed over batches by the caller, divided by
+        # number of batches — the reference's per-batch averaging semantics,
+        # part1/main.py:108) + top-1 correct count.
+        return cross_entropy_loss(logits, labels), top1_correct(logits, labels)
+
+    def evaluate(
+        self,
+        state: TrainState,
+        batches,
+        log: Callable[[str], None] = print,
+    ) -> dict:
+        """Full test-set pass. Like the reference, the test set is NOT
+        sharded — every node evaluates the full set redundantly
+        (part2/part2b/main.py:89-93; SURVEY.md §3.4)."""
+        total_loss = 0.0
+        correct = 0
+        seen = 0
+        n_batches = 0
+        for images, labels in batches:
+            if self.mesh is not None:
+                images = jax.device_put(images, self._repl_sharding)
+                labels = jax.device_put(labels, self._repl_sharding)
+            else:
+                images, labels = jnp.asarray(images), jnp.asarray(labels)
+            loss, corr = self._eval_step(state.params, images, labels)
+            total_loss += float(loss)
+            correct += int(corr)
+            seen += int(labels.shape[0])
+            n_batches += 1
+        avg_loss = total_loss / max(n_batches, 1)
+        accuracy = correct / max(seen, 1)
+        log(f"Test set: average loss {avg_loss:.4f}, "
+            f"accuracy {correct}/{seen} ({100.0 * accuracy:.2f}%)")
+        return {"test_loss": avg_loss, "test_accuracy": accuracy,
+                "correct": correct, "seen": seen}

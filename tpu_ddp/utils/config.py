@@ -1,0 +1,72 @@
+"""Hyperparameter / run configuration.
+
+The reference keeps every hyperparameter as a module-level constant
+(reference part2/part2b/main.py:16-18,177,184-188); we centralise them in one
+dataclass so all four parts and the tests share a single source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+# Shared seed applied on every node so parameter init is identical across
+# replicas — correctness invariant (i) of the reference
+# (reference part1/main.py:14,115-117; report §2.2).
+SEED = 89395
+
+# Global batch is fixed; per-node batch = global // world_size
+# (reference part2/part2b/main.py:177).
+GLOBAL_BATCH_SIZE = 256
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """One training run's configuration (defaults = the reference's)."""
+
+    # Model / data
+    model: str = "VGG11"
+    num_classes: int = 10
+    image_size: int = 32
+    in_channels: int = 3
+
+    # Optimizer: SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    # (reference part1/main.py:124-125).
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+
+    # Loop shape (reference part1/main.py:17,128).
+    global_batch_size: int = GLOBAL_BATCH_SIZE
+    epochs: int = 1
+    seed: int = SEED
+
+    # Instrumentation cadence: loss print every 20 iters, timing over
+    # iterations 1..39 with iteration 0 discarded as warm-up
+    # (reference part1/main.py:82-91).
+    log_every: int = 20
+    timing_first_iter: int = 1
+    timing_last_iter: int = 39
+
+    # TPU-first knobs (no reference equivalent — native to this framework).
+    compute_dtype: str = "bfloat16"   # matmul/conv dtype on the MXU
+    param_dtype: str = "float32"      # master params & optimizer state
+
+    # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
+    # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
+    max_iters: int | None = None
+
+    def __post_init__(self):
+        if self.max_iters is None:
+            env = os.environ.get("TPU_DDP_MAX_ITERS")
+            if env:
+                self.max_iters = int(env)
+        # Smoke-test hook: shrink the global batch (e.g. on the 1-core CPU
+        # CI host, where a 256-image VGG step is minutes of compute).
+        env_bs = os.environ.get("TPU_DDP_GLOBAL_BATCH")
+        if env_bs:
+            self.global_batch_size = int(env_bs)
+
+    def per_node_batch_size(self, world_size: int) -> int:
+        # int(256 / world_size), as in reference part2/part2b/main.py:177.
+        return int(self.global_batch_size / world_size)

@@ -1,0 +1,52 @@
+"""Per-iteration timing harness — the reference's primary metric.
+
+Reference part1/main.py:66,86-91 (and clones in 2a/2b/3): wall time of each
+iteration via ``time.perf_counter_ns()``; iterations 1..39 accumulated
+(iteration 0 discarded as compile/warm-up); total and average printed at
+iteration 39. The JAX-correct analogue must call ``block_until_ready`` on
+the step outputs before stopping the clock — otherwise async dispatch makes
+every iteration look free (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+@dataclasses.dataclass
+class IterationTimer:
+    """Accumulates ns over iterations [first_iter, last_iter]."""
+
+    first_iter: int = 1
+    last_iter: int = 39
+    total_ns: int = 0
+    count: int = 0
+    _t0: int = 0
+
+    def start(self):
+        self._t0 = time.perf_counter_ns()
+
+    def stop(self, iteration: int) -> int:
+        """Record iteration's elapsed ns; returns the elapsed ns."""
+        elapsed = time.perf_counter_ns() - self._t0
+        if self.first_iter <= iteration <= self.last_iter:
+            self.total_ns += elapsed
+            self.count += 1
+        return elapsed
+
+    @property
+    def average_ns(self) -> float:
+        return self.total_ns / self.count if self.count else 0.0
+
+    @property
+    def average_s(self) -> float:
+        return self.average_ns / 1e9
+
+    def report(self, prefix: str = "") -> str:
+        """The reference prints total + average ns after iteration 39
+        (part1/main.py:86-91); same payload here."""
+        return (f"{prefix}timing over iterations "
+                f"{self.first_iter}-{self.last_iter}: total {self.total_ns} ns, "
+                f"average {self.average_ns:.0f} ns "
+                f"({self.average_s:.4f} s/iter)")
