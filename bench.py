@@ -33,7 +33,7 @@ def run_bench(batch_size: int = 256, timed_iters: int = 39) -> dict:
     from tpu_ddp.utils.timing import IterationTimer
 
     cfg = TrainConfig()
-    model = get_model("VGG11")
+    model = get_model("VGG11", use_pallas_bn=cfg.pallas_bn)
     # part3-equivalent (flagship) configuration: fused DP step, pinned to
     # exactly ONE chip so the per-chip metric stays honest on multi-chip
     # hosts (the pmean over a 1-slot axis degenerates gracefully).

@@ -107,7 +107,8 @@ def run_part(part: str, argv=None):
         rank=rank, world_size=world_size, batch_size=batch_size,
         root=args.data_root, seed=cfg.seed)
 
-    model = get_model(cfg.model, num_classes=cfg.num_classes)
+    model = get_model(cfg.model, num_classes=cfg.num_classes,
+                      use_pallas_bn=cfg.pallas_bn)
     trainer = Trainer(model, cfg, strategy=PART_TO_STRATEGY[part], mesh=mesh)
     state = trainer.init_state()
 

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpu_ddp.models.vgg import batch_norm
+from tpu_ddp.models.vgg import BN_EPS, batch_norm
 
 RESNET_CFG = {
     # (blocks per stage); bottleneck width multiplier is 4.
@@ -55,6 +55,9 @@ class ResNetModel:
     small_inputs: bool = False   # True: 3x3/1 stem, no stem pool (CIFAR)
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # Fused Pallas BatchNorm+ReLU kernel for the relu=True blocks
+    # (tpu_ddp/ops/pallas/bn_relu.py); BN-without-relu stays on the jnp path.
+    use_pallas_bn: bool = False
 
     def _conv_bn(self, key, h, w, c_in, c_out):
         k_w, = jax.random.split(key, 1)
@@ -96,8 +99,15 @@ class ResNetModel:
         return params
 
     def _bn_relu(self, x, p, relu=True):
-        y = batch_norm(x, p["bn_scale"].astype(jnp.float32),
-                       p["bn_bias"].astype(jnp.float32))
+        scale = p["bn_scale"].astype(jnp.float32)
+        bias = p["bn_bias"].astype(jnp.float32)
+        if relu and self.use_pallas_bn:
+            from tpu_ddp.ops.pallas import batch_norm_relu
+            # x stays in compute dtype: the kernel casts to f32 internally
+            # and the VJP residual then holds the small bf16 activation.
+            y = batch_norm_relu(x, scale, bias, BN_EPS)
+            return y.astype(self.compute_dtype)
+        y = batch_norm(x, scale, bias)
         if relu:
             y = jnp.maximum(y, 0)
         return y.astype(self.compute_dtype)

@@ -96,6 +96,9 @@ class VGGModel:
     in_channels: int = 3
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # Use the fused Pallas BatchNorm+ReLU kernel (tpu_ddp/ops/pallas/
+    # bn_relu.py) instead of the XLA-fused jnp pair below.
+    use_pallas_bn: bool = False
 
     # ---- parameters ----------------------------------------------------
 
@@ -160,9 +163,15 @@ class VGGModel:
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
             )
             y = y.astype(jnp.float32) + p["bias"].astype(jnp.float32)
-            y = batch_norm(y, p["bn_scale"].astype(jnp.float32),
-                           p["bn_bias"].astype(jnp.float32))
-            x = jnp.maximum(y, 0).astype(cd)
+            if self.use_pallas_bn:
+                from tpu_ddp.ops.pallas import batch_norm_relu
+                x = batch_norm_relu(
+                    y, p["bn_scale"].astype(jnp.float32),
+                    p["bn_bias"].astype(jnp.float32), BN_EPS).astype(cd)
+            else:
+                y = batch_norm(y, p["bn_scale"].astype(jnp.float32),
+                               p["bn_bias"].astype(jnp.float32))
+                x = jnp.maximum(y, 0).astype(cd)
         # After 5 pools a 32x32 input is 1x1x512 -> flatten to 512
         # (reference part1/model.py:42-44).
         x = x.reshape(x.shape[0], -1)

@@ -29,6 +29,9 @@ class SGD:
     learning_rate: float = 0.1
     momentum: float = 0.9
     weight_decay: float = 1e-4
+    # Run the whole update as one single-pass Pallas kernel per leaf
+    # (tpu_ddp/ops/pallas/sgd.py) instead of the tree.map chain below.
+    use_pallas: bool = False
 
     def init(self, params) -> SGDState:
         return {"momentum": jax.tree.map(jnp.zeros_like, params)}
@@ -41,6 +44,13 @@ class SGD:
 
     def apply(self, params, grads, state: SGDState):
         """One update; returns (new_params, new_state)."""
+        if self.use_pallas:
+            from tpu_ddp.ops.pallas import fused_sgd_step
+            new_params, new_buf = fused_sgd_step(
+                params, grads, state["momentum"],
+                lr=self.learning_rate, momentum=self.momentum,
+                weight_decay=self.weight_decay)
+            return new_params, {"momentum": new_buf}
         # Two tree.maps (buf recomputed in the second) — XLA CSEs the
         # duplicate, and it keeps the pytree structure trivially aligned.
         new_buf = jax.tree.map(self._new_buf, params, grads,

@@ -1,0 +1,32 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference's compute kernels all live in ATen C++ (SURVEY.md §2 row N3);
+the TPU-native replacement is mostly XLA-emitted HLO, but the ops where a
+hand-written kernel pays — single-pass fused elementwise chains that XLA
+would otherwise split across HBM round-trips — are implemented here with
+Pallas:
+
+- :mod:`sgd`      — fused SGD momentum+weight-decay parameter update
+                    (one read + one write per buffer instead of the
+                    multi-op elementwise chain).
+- :mod:`bn_relu`  — fused BatchNorm(batch-stats)+ReLU forward/backward
+                    with a custom VJP.
+
+Every kernel runs compiled on TPU and falls back to interpreter mode on
+CPU (tests force the host platform, conftest.py), selected automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret_mode() -> bool:
+    """True when Pallas must run interpreted (no TPU backend)."""
+    return jax.default_backend() != "tpu"
+
+
+from tpu_ddp.ops.pallas.sgd import fused_sgd_step  # noqa: E402
+from tpu_ddp.ops.pallas.bn_relu import batch_norm_relu  # noqa: E402
+
+__all__ = ["interpret_mode", "fused_sgd_step", "batch_norm_relu"]

@@ -67,6 +67,7 @@ class Trainer:
             learning_rate=self.config.learning_rate,
             momentum=self.config.momentum,
             weight_decay=self.config.weight_decay,
+            use_pallas=self.config.pallas_sgd,
         )
         if mesh is not None:
             self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))

@@ -20,6 +20,20 @@ SEED = 89395
 GLOBAL_BATCH_SIZE = 256
 
 
+def _env_bool(name: str, default: bool) -> bool:
+    """Parse a boolean env var; unset -> default, junk -> ValueError."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    low = raw.strip().lower()
+    if low in ("1", "true", "yes", "on"):
+        return True
+    if low in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(f"{name}={raw!r}: expected a boolean "
+                     f"(1/0/true/false/yes/no/on/off)")
+
+
 @dataclasses.dataclass
 class TrainConfig:
     """One training run's configuration (defaults = the reference's)."""
@@ -51,6 +65,8 @@ class TrainConfig:
     # TPU-first knobs (no reference equivalent — native to this framework).
     compute_dtype: str = "bfloat16"   # matmul/conv dtype on the MXU
     param_dtype: str = "float32"      # master params & optimizer state
+    pallas_sgd: bool = False          # fused Pallas optimizer update kernel
+    pallas_bn: bool = False           # fused Pallas BatchNorm+ReLU kernel
 
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
@@ -66,6 +82,8 @@ class TrainConfig:
         env_bs = os.environ.get("TPU_DDP_GLOBAL_BATCH")
         if env_bs:
             self.global_batch_size = int(env_bs)
+        self.pallas_sgd = _env_bool("TPU_DDP_PALLAS_SGD", self.pallas_sgd)
+        self.pallas_bn = _env_bool("TPU_DDP_PALLAS_BN", self.pallas_bn)
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.
