@@ -1,0 +1,290 @@
+// Native data pipeline: augment + normalize + multi-threaded prefetch.
+//
+// TPU-native replacement-in-kind for the reference's native data path
+// (SURVEY.md §2 row N4): torchvision's C transforms (RandomCrop(32, pad 4),
+// RandomHorizontalFlip, ToTensor, Normalize — reference part1/main.py:19-50)
+// plus the DataLoader worker pool (num_workers=2, pin_memory=True —
+// reference part1/main.py:36-41). Here both live in one C++ library:
+// worker threads transform whole batches ahead of consumption into a
+// bounded prefetch queue; the Python side (tpu_ddp/data/native.py) pops
+// finished float32 NHWC batches over ctypes.
+//
+// Determinism: augmentation randomness is counter-based — a splitmix64
+// hash of (seed, epoch, global image index) — so results are identical
+// regardless of thread count or scheduling, and reshuffle per epoch like
+// the reference's sampler.set_epoch (part2/part2b/main.py:189).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---- counter-based RNG --------------------------------------------------
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+struct AugmentDraw {
+  int dy, dx;
+  bool flip;
+};
+
+inline AugmentDraw draw_for(uint64_t seed, uint64_t epoch, uint64_t img_idx,
+                            int padding) {
+  uint64_t h = splitmix64(seed ^ splitmix64(epoch ^ 0xA5A5A5A5ULL) ^
+                          splitmix64(img_idx * 0x9E3779B97F4A7C15ULL + 1));
+  int span = 2 * padding + 1;
+  AugmentDraw d;
+  d.dy = static_cast<int>(h % span);
+  d.dx = static_cast<int>((h >> 16) % span);
+  d.flip = ((h >> 32) & 1) != 0;
+  return d;
+}
+
+// ---- batch transform ----------------------------------------------------
+
+struct Dataset {
+  const uint8_t* images;  // (n, h, w, c) NHWC
+  const int32_t* labels;  // (n,)
+  int64_t n;
+  int h, w, c;
+  std::vector<float> mean;     // size c
+  std::vector<float> inv_std;  // size c
+
+  void set_norm(const float* m, const float* s) {
+    mean.assign(m, m + c);
+    inv_std.resize(c);
+    for (int k = 0; k < c; ++k) inv_std[k] = 1.0f / s[k];
+  }
+};
+
+// Transform one image: optional pad-crop + hflip, then normalize.
+// out: (h, w, c) float32.
+void transform_image(const Dataset& ds, int64_t img_idx, bool augment,
+                     uint64_t seed, uint64_t epoch, float* out) {
+  const int h = ds.h, w = ds.w, c = ds.c;
+  const uint8_t* src = ds.images + img_idx * static_cast<int64_t>(h) * w * c;
+  int dy = 0, dx = 0;
+  bool flip = false;
+  const int padding = 4;
+  if (augment) {
+    AugmentDraw d = draw_for(seed, epoch, static_cast<uint64_t>(img_idx),
+                             padding);
+    dy = d.dy;
+    dx = d.dx;
+    flip = d.flip;
+  }
+  // Output pixel (y, x) reads padded-image pixel (y + dy, x + dx), where
+  // the padded image is the source offset by `padding` with a zero border
+  // — i.e. source row sy = y + dy - padding (zero outside [0, h)).
+  for (int y = 0; y < h; ++y) {
+    int sy = augment ? y + dy - padding : y;
+    bool row_in = sy >= 0 && sy < h;
+    for (int x = 0; x < w; ++x) {
+      int ox = flip ? (w - 1 - x) : x;   // horizontal flip of the crop
+      int sx = augment ? ox + dx - padding : ox;
+      float* dst = out + (static_cast<int64_t>(y) * w + x) * c;
+      if (row_in && sx >= 0 && sx < w) {
+        const uint8_t* px = src + (static_cast<int64_t>(sy) * w + sx) * c;
+        for (int k = 0; k < c; ++k) {
+          dst[k] = (static_cast<float>(px[k]) / 255.0f - ds.mean[k]) *
+                   ds.inv_std[k];
+        }
+      } else {
+        for (int k = 0; k < c; ++k) {
+          dst[k] = (0.0f - ds.mean[k]) * ds.inv_std[k];  // zero padding
+        }
+      }
+    }
+  }
+}
+
+// ---- prefetching loader -------------------------------------------------
+
+struct Batch {
+  int64_t index;  // batch ordinal within the epoch
+  std::vector<float> images;
+  std::vector<int32_t> labels;
+  int size;
+};
+
+struct Loader {
+  Dataset ds;
+  std::vector<int64_t> order;  // epoch's (sharded, shuffled) index order
+  int batch_size;
+  bool augment;
+  uint64_t seed, epoch;
+  int prefetch_depth;
+
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv_produce, cv_consume;
+  std::deque<Batch> ready;     // completed batches, any order
+  int64_t next_to_build = 0;   // next batch ordinal to claim (producers)
+  int64_t next_to_emit = 0;    // next batch ordinal to hand out (consumer)
+  int64_t num_batches = 0;
+  std::atomic<bool> stop{false};
+
+  void worker_loop() {
+    for (;;) {
+      int64_t bi;
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        // Backpressure: at most `prefetch_depth` batches claimed but not
+        // yet consumed (building or sitting in `ready`).
+        cv_produce.wait(lock, [&] {
+          return stop.load() ||
+                 (next_to_build < num_batches &&
+                  next_to_build - next_to_emit < prefetch_depth);
+        });
+        if (stop.load()) return;
+        bi = next_to_build++;
+      }
+      Batch b;
+      b.index = bi;
+      int64_t start = bi * static_cast<int64_t>(batch_size);
+      b.size = static_cast<int>(
+          std::min<int64_t>(batch_size, order.size() - start));
+      int64_t px = static_cast<int64_t>(ds.h) * ds.w * ds.c;
+      b.images.resize(static_cast<size_t>(b.size) * px);
+      b.labels.resize(b.size);
+      for (int i = 0; i < b.size; ++i) {
+        int64_t idx = order[start + i];
+        transform_image(ds, idx, augment, seed, epoch,
+                        b.images.data() + i * px);
+        b.labels[i] = ds.labels[idx];
+      }
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        ready.push_back(std::move(b));
+      }
+      cv_consume.notify_all();
+    }
+  }
+
+  // Blocks until the next in-order batch is ready; returns its size or -1
+  // at epoch end. Copies into caller-provided buffers.
+  int next(float* out_images, int32_t* out_labels) {
+    std::unique_lock<std::mutex> lock(mu);
+    if (next_to_emit >= num_batches) return -1;
+    int64_t want = next_to_emit;
+    cv_consume.wait(lock, [&] {
+      if (stop.load()) return true;
+      for (const Batch& b : ready)
+        if (b.index == want) return true;
+      return false;
+    });
+    if (stop.load()) return -1;
+    for (auto it = ready.begin(); it != ready.end(); ++it) {
+      if (it->index == want) {
+        std::memcpy(out_images, it->images.data(),
+                    it->images.size() * sizeof(float));
+        std::memcpy(out_labels, it->labels.data(),
+                    it->labels.size() * sizeof(int32_t));
+        int size = it->size;
+        ready.erase(it);
+        ++next_to_emit;
+        cv_produce.notify_all();
+        return size;
+      }
+    }
+    return -1;  // unreachable
+  }
+
+  ~Loader() {
+    {
+      // stop must flip under the mutex: a worker could otherwise observe
+      // stop==false inside its wait predicate, miss this notify, and
+      // block forever (lost wakeup) — deadlocking join() below.
+      std::lock_guard<std::mutex> lock(mu);
+      stop.store(true);
+    }
+    cv_produce.notify_all();
+    cv_consume.notify_all();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// One-shot batch transform (no threads): the ctypes analogue of calling
+// torchvision transforms on a batch. Used for equivalence tests and as a
+// building block. indices may be null (identity).
+void tpu_ddp_transform_batch(const uint8_t* images, const int32_t* labels,
+                             int64_t n_total, int h, int w, int c,
+                             const int64_t* indices, int64_t n_out,
+                             const float* mean, const float* std,
+                             int augment, uint64_t seed, uint64_t epoch,
+                             float* out_images, int32_t* out_labels) {
+  Dataset ds;
+  ds.images = images;
+  ds.labels = labels;
+  ds.n = n_total;
+  ds.h = h;
+  ds.w = w;
+  ds.c = c;
+  ds.set_norm(mean, std);
+  int64_t px = static_cast<int64_t>(h) * w * c;
+  for (int64_t i = 0; i < n_out; ++i) {
+    int64_t idx = indices ? indices[i] : i;
+    transform_image(ds, idx, augment != 0, seed, epoch, out_images + i * px);
+    out_labels[i] = labels[idx];
+  }
+}
+
+// Prefetching loader lifecycle. `order` is the epoch's index order (the
+// sampler's shard); the loader copies it. Returns an opaque handle.
+void* tpu_ddp_loader_create(const uint8_t* images, const int32_t* labels,
+                            int64_t n_total, int h, int w, int c,
+                            const int64_t* order, int64_t n_order,
+                            int batch_size, const float* mean,
+                            const float* std, int augment, uint64_t seed,
+                            uint64_t epoch, int num_threads,
+                            int prefetch_depth) {
+  Loader* L = new Loader();
+  L->ds.images = images;
+  L->ds.labels = labels;
+  L->ds.n = n_total;
+  L->ds.h = h;
+  L->ds.w = w;
+  L->ds.c = c;
+  L->ds.set_norm(mean, std);
+  L->order.assign(order, order + n_order);
+  L->batch_size = batch_size;
+  L->augment = augment != 0;
+  L->seed = seed;
+  L->epoch = epoch;
+  L->prefetch_depth = prefetch_depth < 1 ? 1 : prefetch_depth;
+  L->num_batches =
+      (n_order + batch_size - 1) / static_cast<int64_t>(batch_size);
+  if (num_threads < 1) num_threads = 1;
+  for (int t = 0; t < num_threads; ++t)
+    L->workers.emplace_back([L] { L->worker_loop(); });
+  return L;
+}
+
+int tpu_ddp_loader_next(void* handle, float* out_images,
+                        int32_t* out_labels) {
+  return static_cast<Loader*>(handle)->next(out_images, out_labels);
+}
+
+void tpu_ddp_loader_destroy(void* handle) {
+  delete static_cast<Loader*>(handle);
+}
+
+int tpu_ddp_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,125 @@
+"""C++ native data pipeline vs the numpy pipeline.
+
+The native loader replaces torchvision transforms + DataLoader workers
+(SURVEY.md §2 row N4). These tests pin its contract: exact normalization
+parity, deterministic schedule-independent augmentation, DataLoader-equal
+iteration shape, and sharded operation.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_ddp.data import native
+from tpu_ddp.data.cifar10 import normalize
+from tpu_ddp.data.loader import DataLoader, create_data_loaders
+from tpu_ddp.data.sampler import DistributedShardSampler
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native library unavailable: {native.build_error()}")
+
+
+def _toy(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    return x, y
+
+
+class TestTransformBatch:
+    def test_normalize_matches_numpy(self):
+        x, y = _toy()
+        out_x, out_y = native.transform_batch(x, y, augment=False)
+        np.testing.assert_allclose(out_x, normalize(x), rtol=0, atol=1e-6)
+        np.testing.assert_array_equal(out_y, y)
+
+    def test_indices_select(self):
+        x, y = _toy()
+        idx = np.array([5, 3, 3, 60], dtype=np.int64)
+        out_x, out_y = native.transform_batch(x, y, idx, augment=False)
+        np.testing.assert_allclose(out_x, normalize(x[idx]), atol=1e-6)
+        np.testing.assert_array_equal(out_y, y[idx])
+
+    def test_augment_deterministic_and_epoch_varying(self):
+        x, y = _toy()
+        a1, _ = native.transform_batch(x, y, augment=True, seed=1, epoch=0)
+        a2, _ = native.transform_batch(x, y, augment=True, seed=1, epoch=0)
+        b, _ = native.transform_batch(x, y, augment=True, seed=1, epoch=1)
+        np.testing.assert_array_equal(a1, a2)
+        assert np.abs(a1 - b).max() > 0  # some image moved
+
+    def test_augment_is_crop_of_padded(self):
+        """Every augmented image must be a 32x32 window of the 40x40
+        zero-padded (possibly flipped) original."""
+        x, y = _toy(n=4)
+        out, _ = native.transform_batch(x, y, augment=True, seed=7)
+        x_norm_pad = np.zeros((4, 40, 40, 3), np.float32)
+        x_norm_pad += normalize(np.zeros((1, 1, 1, 3), np.uint8))  # pad value
+        x_norm_pad[:, 4:36, 4:36] = normalize(x)
+        for i in range(4):
+            found = False
+            for dy in range(9):
+                for dx in range(9):
+                    win = x_norm_pad[i, dy:dy + 32, dx:dx + 32]
+                    if np.allclose(out[i], win, atol=1e-6) or \
+                       np.allclose(out[i], win[:, ::-1], atol=1e-6):
+                        found = True
+                        break
+                if found:
+                    break
+            assert found, f"image {i} is not a crop/flip of its original"
+
+
+class TestNativeDataLoader:
+    def test_matches_python_loader_no_augment(self):
+        x, y = _toy(n=70)
+        py = DataLoader(x, y, batch_size=32, augment=False)
+        nat = native.NativeDataLoader(x, y, batch_size=32, augment=False)
+        assert len(py) == len(nat) == 3
+        for (px, pl_), (nx, nl) in zip(py, nat):
+            np.testing.assert_allclose(nx, px, atol=1e-6)
+            np.testing.assert_array_equal(nl, pl_)
+
+    def test_short_final_batch_kept(self):
+        x, y = _toy(n=70)
+        sizes = [len(l) for _, l in
+                 native.NativeDataLoader(x, y, batch_size=32)]
+        assert sizes == [32, 32, 6]  # drop_last=False
+
+    def test_sharded(self):
+        x, y = _toy(n=64)
+        shards = []
+        for rank in range(4):
+            s = DistributedShardSampler(64, num_replicas=4, rank=rank,
+                                        shuffle=False, drop_last=False)
+            loader = native.NativeDataLoader(x, y, batch_size=16,
+                                             sampler=s, augment=False)
+            shards.append(np.concatenate([l for _, l in loader]))
+        # All 64 labels covered exactly once across the 4 ranks.
+        assert sorted(np.concatenate(shards).tolist()) == sorted(y.tolist())
+
+    def test_deterministic_across_runs_with_augment(self):
+        x, y = _toy(n=40)
+        def run():
+            loader = native.NativeDataLoader(x, y, batch_size=16,
+                                             augment=True, seed=3,
+                                             num_threads=3)
+            loader.set_epoch(2)
+            return np.concatenate([b for b, _ in loader])
+        np.testing.assert_array_equal(run(), run())
+
+    def test_multiple_epochs_reiterable(self):
+        x, y = _toy(n=20)
+        loader = native.NativeDataLoader(x, y, batch_size=8, augment=True)
+        n0 = sum(len(l) for _, l in loader)
+        loader.set_epoch(1)
+        n1 = sum(len(l) for _, l in loader)
+        assert n0 == n1 == 20
+
+    def test_create_data_loaders_native_flag(self):
+        tr, te = create_data_loaders(batch_size=16, synthetic_size=64,
+                                     native=True)
+        assert isinstance(tr, native.NativeDataLoader)
+        xb, yb = next(iter(tr))
+        assert xb.shape == (16, 32, 32, 3) and xb.dtype == np.float32
+        assert isinstance(te, native.NativeDataLoader)

@@ -74,6 +74,7 @@ def create_data_loaders(
     root: str | None = None,
     seed: int = SEED,
     synthetic_size: int | None = None,
+    native: bool | None = None,
 ):
     """(train_loader, test_loader), the reference's L4 facade.
 
@@ -95,7 +96,18 @@ def create_data_loaders(
         sampler = DistributedShardSampler(
             len(train_y), num_replicas=world_size, rank=rank,
             shuffle=False, drop_last=False)
-    train_loader = DataLoader(train_x, train_y, batch_size,
+    if native is None:
+        from tpu_ddp.utils.config import _env_bool
+        native = _env_bool("TPU_DDP_NATIVE_LOADER", False)
+    loader_cls = DataLoader
+    if native:
+        from tpu_ddp.data import native as native_mod
+        if native_mod.available():
+            loader_cls = native_mod.NativeDataLoader
+        else:
+            print("[tpu_ddp.data] native loader requested but unavailable "
+                  f"({native_mod.build_error()}) -> numpy pipeline")
+    train_loader = loader_cls(train_x, train_y, batch_size,
                               sampler=sampler, augment=True, seed=seed)
-    test_loader = DataLoader(test_x, test_y, batch_size, augment=False)
+    test_loader = loader_cls(test_x, test_y, batch_size, augment=False)
     return train_loader, test_loader

@@ -1,0 +1,42 @@
+"""Device prefetch: keep the next batches' host->device transfers in flight.
+
+The reference overlaps host work with compute through DataLoader workers +
+``pin_memory=True`` (reference part1/main.py:36-41). The TPU-native
+equivalent is to issue ``device_put`` for upcoming batches before the
+current step completes — JAX transfers are asynchronous, so a small
+lookahead hides the PCIe/tunnel latency behind the device step.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterable, Iterator
+
+
+def prefetch_to_device(batches: Iterable, put_fn: Callable, depth: int = 2
+                       ) -> Iterator:
+    """Yield ``put_fn(batch)`` results with ``depth`` transfers in flight.
+
+    ``put_fn`` is typically ``Trainer.put_batch`` applied to the loader's
+    ``(images, labels)`` tuples; with ``depth=0`` this degenerates to plain
+    mapping (no lookahead).
+    """
+    if depth <= 0:
+        for b in batches:
+            yield put_fn(*b) if isinstance(b, tuple) else put_fn(b)
+        return
+    it = iter(batches)
+    queue = collections.deque()
+    try:
+        while len(queue) < depth:
+            b = next(it)
+            queue.append(put_fn(*b) if isinstance(b, tuple) else put_fn(b))
+    except StopIteration:
+        pass
+    while queue:
+        yield queue.popleft()
+        try:
+            b = next(it)
+            queue.append(put_fn(*b) if isinstance(b, tuple) else put_fn(b))
+        except StopIteration:
+            continue

@@ -67,6 +67,7 @@ class TrainConfig:
     param_dtype: str = "float32"      # master params & optimizer state
     pallas_sgd: bool = False          # fused Pallas optimizer update kernel
     pallas_bn: bool = False           # fused Pallas BatchNorm+ReLU kernel
+    device_prefetch: int = 0          # host->device transfers kept in flight
 
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
@@ -84,6 +85,9 @@ class TrainConfig:
             self.global_batch_size = int(env_bs)
         self.pallas_sgd = _env_bool("TPU_DDP_PALLAS_SGD", self.pallas_sgd)
         self.pallas_bn = _env_bool("TPU_DDP_PALLAS_BN", self.pallas_bn)
+        env_pf = os.environ.get("TPU_DDP_PREFETCH")
+        if env_pf:
+            self.device_prefetch = int(env_pf)
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.
