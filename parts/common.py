@@ -47,7 +47,15 @@ def parse_arguments(argv=None, require_num_nodes: bool = False):
                    help="CIFAR-10 root (default: search standard paths, "
                         "fall back to synthetic)")
     p.add_argument("--epochs", type=int, default=1)
-    return p.parse_args(argv)
+    p.add_argument("--ckpt-dir", type=str, default=None,
+                   help="checkpoint directory; saves after each epoch "
+                        "(TPU-native extension, no reference equivalent)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --ckpt-dir")
+    args = p.parse_args(argv)
+    if args.resume and not args.ckpt_dir:
+        p.error("--resume requires --ckpt-dir")  # fail before rendezvous
+    return args
 
 
 def run_part(part: str, argv=None):
@@ -109,17 +117,41 @@ def run_part(part: str, argv=None):
 
     model = get_model(cfg.model, num_classes=cfg.num_classes,
                       use_pallas_bn=cfg.pallas_bn)
-    trainer = Trainer(model, cfg, strategy=PART_TO_STRATEGY[part], mesh=mesh)
-    state = trainer.init_state()
+    from tpu_ddp.utils.metrics import from_env as metrics_from_env
+    from tpu_ddp.utils.profiling import profile_dir_from_env, profile_trace
+
+    trainer = Trainer(model, cfg, strategy=PART_TO_STRATEGY[part], mesh=mesh,
+                      metrics=metrics_from_env(rank=rank))
+    start_epoch = 0
+    if args.resume:
+        state = trainer.restore_checkpoint(args.ckpt_dir)
+        # Derive where to pick up: checkpoints are written at epoch ends,
+        # so completed epochs = step / iters-per-epoch. Training then
+        # continues to the requested --epochs total (not N more).
+        iters_per_epoch = len(train_loader)
+        if cfg.max_iters is not None:
+            iters_per_epoch = min(iters_per_epoch, cfg.max_iters)
+        start_epoch = state.step // max(iters_per_epoch, 1)
+        print(f"[{part}] resumed from {args.ckpt_dir} at step {state.step} "
+              f"(epoch {start_epoch})")
+    else:
+        state = trainer.init_state()
 
     print(f"[{part}] strategy={PART_TO_STRATEGY[part]} world_size={world_size} "
           f"rank={rank} dp_slots={dp_size} per-node batch={batch_size} "
           f"platform={jax.devices()[0].platform}")
 
-    for epoch in range(cfg.epochs):
+    for epoch in range(start_epoch, cfg.epochs):
         # Per-epoch reshuffle hook (reference part2/part2b/main.py:189).
         train_loader.set_epoch(epoch)
-        state, stats = trainer.train_epoch(state, train_loader, epoch=epoch)
+        # Deep profiling (TPU_DDP_PROFILE_DIR): trace the first epoch.
+        with profile_trace(profile_dir_from_env() if epoch == 0 else None):
+            state, stats = trainer.train_epoch(state, train_loader,
+                                               epoch=epoch)
+        if args.ckpt_dir:
+            path = trainer.save_checkpoint(args.ckpt_dir, state)
+            if path:
+                print(f"[{part}] checkpoint saved: {path}")
         trainer.evaluate(state, test_loader)
         print(f"[{part}] epoch {epoch}: avg iter "
               f"{stats['avg_iter_s']:.4f}s over {stats['timed_iters']} timed "

@@ -1,0 +1,105 @@
+"""Checkpoint/resume subsystem (no reference equivalent — SURVEY.md §5
+lists checkpointing as absent upstream; it is native to this framework)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_ddp.models import get_model
+from tpu_ddp.train.engine import Trainer
+from tpu_ddp.utils import checkpoint as ckpt
+from tpu_ddp.utils.config import TrainConfig
+
+
+def _tree(seed=0):
+    k = jax.random.split(jax.random.key(seed), 3)
+    return {"a": jax.random.normal(k[0], (4, 3)),
+            "b": {"c": jax.random.normal(k[1], (7,)),
+                  "d": jax.random.normal(k[2], (2, 2, 2))}}
+
+
+class TestCheckpointCore:
+    def test_roundtrip_bit_exact(self, tmp_path):
+        tree = _tree()
+        ckpt.save_checkpoint(str(tmp_path), tree, step=5)
+        restored, step = ckpt.restore_checkpoint(str(tmp_path), tree)
+        assert step == 5
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), tree, restored)
+
+    def test_latest_and_explicit_step(self, tmp_path):
+        t1, t2 = _tree(1), _tree(2)
+        ckpt.save_checkpoint(str(tmp_path), t1, step=1)
+        ckpt.save_checkpoint(str(tmp_path), t2, step=2)
+        assert ckpt.all_steps(str(tmp_path)) == [1, 2]
+        r, s = ckpt.restore_checkpoint(str(tmp_path), t1)
+        assert s == 2
+        np.testing.assert_array_equal(np.asarray(r["a"]), np.asarray(t2["a"]))
+        r1, s1 = ckpt.restore_checkpoint(str(tmp_path), t1, step=1)
+        assert s1 == 1
+        np.testing.assert_array_equal(np.asarray(r1["a"]),
+                                      np.asarray(t1["a"]))
+
+    def test_keep_last_prunes(self, tmp_path):
+        for s in range(5):
+            ckpt.save_checkpoint(str(tmp_path), _tree(), step=s,
+                                 keep_last=2)
+        assert ckpt.all_steps(str(tmp_path)) == [3, 4]
+
+    def test_partial_write_invisible(self, tmp_path):
+        os.makedirs(tmp_path / ".tmp-abc")
+        (tmp_path / ".tmp-abc" / "arrays.npz").write_bytes(b"junk")
+        os.makedirs(tmp_path / "step_00000009")  # no manifest => incomplete
+        assert ckpt.all_steps(str(tmp_path)) == []
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore_checkpoint(str(tmp_path), _tree())
+
+    def test_structure_mismatch_raises(self, tmp_path):
+        ckpt.save_checkpoint(str(tmp_path), _tree(), step=0)
+        bad = {"a": np.zeros((4, 3)), "b": {"c": np.zeros((7,))}}
+        with pytest.raises(ValueError, match="structures differ"):
+            ckpt.restore_checkpoint(str(tmp_path), bad)
+        bad_shape = _tree()
+        bad_shape["a"] = np.zeros((5, 3))
+        with pytest.raises(ValueError, match="shape"):
+            ckpt.restore_checkpoint(str(tmp_path), bad_shape)
+
+
+class TestTrainerResume:
+    def _batch(self, n=8):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+        y = (np.arange(n) % 10).astype(np.int32)
+        return x, y
+
+    def test_resume_continues_identically(self, tmp_path, devices):
+        """save -> restore -> one step == uninterrupted two steps."""
+        import jax.numpy as jnp
+
+        from tpu_ddp.parallel.mesh import make_mesh
+
+        cfg = TrainConfig(global_batch_size=8)
+        model = get_model("VGG11", compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:4])
+        x, y = self._batch()
+
+        tr = Trainer(model, cfg, strategy="fused", mesh=mesh)
+        state = tr.init_state()
+        xb, yb, wb = tr.put_batch(x, y)
+        state, _ = tr.train_step(state, xb, yb, wb)
+        tr.save_checkpoint(str(tmp_path), state)
+        state, _ = tr.train_step(state, xb, yb, wb)  # uninterrupted path
+
+        tr2 = Trainer(model, cfg, strategy="fused", mesh=mesh)
+        state2 = tr2.restore_checkpoint(str(tmp_path))
+        assert state2.step == 1
+        xb2, yb2, wb2 = tr2.put_batch(x, y)
+        state2, _ = tr2.train_step(state2, xb2, yb2, wb2)
+
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            state.params, state2.params)
+        assert state2.step == state.step == 2

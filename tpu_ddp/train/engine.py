@@ -34,6 +34,7 @@ from tpu_ddp.ops.optim import SGD
 from tpu_ddp.parallel.mesh import DATA_AXIS
 from tpu_ddp.parallel.sync import get_sync_strategy
 from tpu_ddp.utils.config import TrainConfig
+from tpu_ddp.utils.metrics import MetricsLogger
 from tpu_ddp.utils.timing import IterationTimer
 
 
@@ -58,9 +59,11 @@ class Trainer:
         config: TrainConfig | None = None,
         strategy: str = "none",
         mesh: Mesh | None = None,
+        metrics: "MetricsLogger | None" = None,
     ):
         self.model = model
         self.config = config or TrainConfig()
+        self.metrics = metrics if metrics is not None else MetricsLogger()
         self.strategy_name = strategy
         self.sync_fn = get_sync_strategy(strategy)
         self.mesh = mesh
@@ -89,6 +92,38 @@ class Trainer:
             params = jax.device_put(params, self._repl_sharding)
             opt_state = jax.device_put(opt_state, self._repl_sharding)
         return TrainState(params=params, opt_state=opt_state)
+
+    # ---- checkpoint / resume (no reference equivalent, SURVEY.md §5) ---
+
+    def save_checkpoint(self, directory: str, state: TrainState,
+                        keep_last: int | None = None) -> str | None:
+        """Write ``state`` at its step; only process 0 writes (state under
+        DP is replicated). Returns the path (None on non-zero processes)."""
+        if jax.process_index() != 0:
+            return None
+        from tpu_ddp.utils import checkpoint as ckpt
+        tree = {"params": state.params, "opt_state": state.opt_state,
+                "step": np.int64(state.step)}
+        return ckpt.save_checkpoint(directory, tree, step=state.step,
+                                    keep_last=keep_last)
+
+    def restore_checkpoint(self, directory: str,
+                           step: int | None = None) -> TrainState:
+        """Load a checkpoint (latest by default) placed like
+        :meth:`init_state` places fresh state (replicated on the mesh)."""
+        from tpu_ddp.utils import checkpoint as ckpt
+        # Shape-only template: eval_shape skips the real init + placement.
+        shapes = jax.eval_shape(
+            lambda: (lambda s: {"params": s.params,
+                                "opt_state": s.opt_state})(self.init_state()))
+        template = {**shapes, "step": np.int64(0)}
+        restored, _ = ckpt.restore_checkpoint(directory, template, step)
+        params, opt_state = restored["params"], restored["opt_state"]
+        if self.mesh is not None:
+            params = jax.device_put(params, self._repl_sharding)
+            opt_state = jax.device_put(opt_state, self._repl_sharding)
+        return TrainState(params=params, opt_state=opt_state,
+                          step=int(restored["step"]))
 
     # ---- train step ----------------------------------------------------
 
@@ -267,9 +302,15 @@ class Trainer:
             if it % cfg.log_every == cfg.log_every - 1:
                 log(f"[epoch {epoch}, iter {it + 1}] "
                     f"loss: {running_loss / cfg.log_every:.3f}")
+                self.metrics.log("train_iter", epoch=epoch, iter=it + 1,
+                                 step=state.step,
+                                 loss=round(running_loss / cfg.log_every, 5))
                 running_loss = 0.0
             if it == cfg.timing_last_iter:
                 log(timer.report(prefix=f"[epoch {epoch}] "))
+        self.metrics.log("epoch", epoch=epoch, iters=n_iters,
+                         avg_iter_s=timer.average_s,
+                         last_loss=round(last_loss, 5))
         return state, {
             "avg_iter_ns": timer.average_ns,
             "avg_iter_s": timer.average_s,
@@ -315,5 +356,7 @@ class Trainer:
         accuracy = correct / max(seen, 1)
         log(f"Test set: average loss {avg_loss:.4f}, "
             f"accuracy {correct}/{seen} ({100.0 * accuracy:.2f}%)")
+        self.metrics.log("eval", test_loss=round(avg_loss, 5),
+                         test_accuracy=round(accuracy, 5), seen=seen)
         return {"test_loss": avg_loss, "test_accuracy": accuracy,
                 "correct": correct, "seen": seen}

@@ -1,0 +1,140 @@
+"""Checkpoint / resume.
+
+The reference has NO checkpointing (SURVEY.md §5: no ``state_dict``
+save/load anywhere; training is 1 epoch from scratch) — this subsystem is
+native to the TPU framework so long runs on preemptible TPU slices can
+resume. Design:
+
+- A checkpoint is a directory ``step_{N:08d}/`` holding one ``arrays.npz``
+  (every leaf of the state pytree, keyed by its tree path) plus
+  ``manifest.json`` (step, leaf order, framework version). No pickle.
+- Writes are atomic: a ``.tmp-*`` staging dir is renamed into place only
+  when complete, so a preempted write can never be mistaken for a valid
+  checkpoint.
+- Restore maps leaves back into a caller-provided template pytree (the
+  standard JAX pattern — ``Trainer.init_state()`` provides it), so device
+  placement/sharding of the restored state matches the template's.
+- Multi-host: state under pure DP is replicated, so only process 0 writes
+  (callers gate on ``jax.process_index() == 0``); every process restores.
+- ``keep_last`` prunes old step dirs after a successful write.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"^step_(\d{8,})$")
+_FORMAT_VERSION = 1
+
+
+def _leaf_key(i: int, path) -> str:
+    # Human-readable but unambiguous: "0003:features.2.kernel"
+    return f"{i:05d}:" + jax.tree_util.keystr(path, simple=True,
+                                              separator=".")
+
+
+def save_checkpoint(directory: str, state, step: int,
+                    keep_last: int | None = None) -> str:
+    """Write ``state`` (any pytree of arrays) as step ``step``.
+
+    Returns the final checkpoint path. Atomic: partial writes never
+    become visible.
+    """
+    os.makedirs(directory, exist_ok=True)
+    final = os.path.join(directory, f"step_{step:08d}")
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    arrays = {}
+    for i, (path, leaf) in enumerate(leaves):
+        arrays[_leaf_key(i, path)] = np.asarray(leaf)
+    tmp = tempfile.mkdtemp(prefix=".tmp-", dir=directory)
+    try:
+        with open(os.path.join(tmp, "arrays.npz"), "wb") as f:
+            np.savez(f, **arrays)
+        manifest = {
+            "format_version": _FORMAT_VERSION,
+            "step": step,
+            "leaves": list(arrays.keys()),
+        }
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+        if os.path.isdir(final):
+            shutil.rmtree(final)  # re-saving the same step overwrites
+        os.rename(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    if keep_last is not None:
+        for step_i in all_steps(directory)[:-keep_last]:
+            shutil.rmtree(os.path.join(directory, f"step_{step_i:08d}"),
+                          ignore_errors=True)
+    return final
+
+
+def all_steps(directory: str) -> list[int]:
+    """Completed checkpoint steps in ``directory``, ascending."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        m = _STEP_RE.match(name)
+        if m and os.path.exists(os.path.join(directory, name,
+                                             "manifest.json")):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> int | None:
+    steps = all_steps(directory)
+    return steps[-1] if steps else None
+
+
+def restore_checkpoint(directory: str, template, step: int | None = None):
+    """Restore into the structure of ``template``; returns ``(state, step)``.
+
+    ``template`` supplies the pytree structure (and is typically a freshly
+    built state, e.g. ``Trainer.init_state()``); restored leaves are
+    returned as numpy arrays in that structure — callers re-place them on
+    device (``Trainer.restore`` does). ``step=None`` picks the latest.
+    """
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {directory!r}")
+    path = os.path.join(directory, f"step_{step:08d}")
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest["format_version"] != _FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {manifest['format_version']} != "
+            f"{_FORMAT_VERSION}")
+    with np.load(os.path.join(path, "arrays.npz")) as npz:
+        paths_and_leaves, treedef = \
+            jax.tree_util.tree_flatten_with_path(template)
+        if len(paths_and_leaves) != len(manifest["leaves"]):
+            raise ValueError(
+                f"checkpoint has {len(manifest['leaves'])} leaves, "
+                f"template has {len(paths_and_leaves)} — structures differ")
+        restored = []
+        for i, (tree_path, leaf) in enumerate(paths_and_leaves):
+            key = _leaf_key(i, tree_path)
+            if key not in npz:
+                raise KeyError(
+                    f"leaf {key!r} missing from checkpoint {path!r} "
+                    f"(saved: {manifest['leaves'][i]!r}) — structure "
+                    f"mismatch")
+            arr = npz[key]
+            want = np.shape(leaf)
+            if tuple(arr.shape) != tuple(want):
+                raise ValueError(
+                    f"leaf {key!r}: checkpoint shape {arr.shape} != "
+                    f"template shape {want}")
+            restored.append(arr)
+    return treedef.unflatten(restored), manifest["step"]

@@ -1,0 +1,65 @@
+"""Structured metrics logging.
+
+The reference's observability is ``print`` only (SURVEY.md §5: loss every
+20 mini-batches, timing at iter 39, eval summary). Those prints survive in
+the engine for parity; this module adds the framework-native structured
+sink: one JSON object per line, suitable for tailing, plotting or joining
+across ranks (each line carries rank + timestamp).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics writer.
+
+    ``path=None`` makes every call a no-op, so engine code can log
+    unconditionally. Lines look like::
+
+        {"ts": 1722..., "rank": 0, "event": "train_iter", "step": 40,
+         "loss": 1.93, "iter_s": 0.0021}
+    """
+
+    def __init__(self, path: str | None = None, rank: int = 0):
+        self.path = path
+        self.rank = rank
+        self._fh = None
+        if path:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(path, "a", buffering=1)  # line-buffered
+
+    @property
+    def enabled(self) -> bool:
+        return self._fh is not None
+
+    def log(self, event: str, **fields) -> None:
+        if self._fh is None:
+            return
+        rec = {"ts": round(time.time(), 3), "rank": self.rank,
+               "event": event}
+        rec.update(fields)
+        self._fh.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def from_env(rank: int = 0) -> MetricsLogger:
+    """Logger configured by ``TPU_DDP_METRICS_FILE`` (``{rank}`` expands)."""
+    path = os.environ.get("TPU_DDP_METRICS_FILE")
+    if path:
+        path = path.replace("{rank}", str(rank))
+    return MetricsLogger(path, rank=rank)

@@ -1,0 +1,39 @@
+"""Profiler integration.
+
+The reference's only tracing is a hand-rolled ``perf_counter_ns`` harness
+(SURVEY.md §5) — preserved in :mod:`tpu_ddp.utils.timing`. This module adds
+the TPU-native deep profiler: XLA device traces via ``jax.profiler``,
+viewable in TensorBoard/Perfetto, enabled by flag or the
+``TPU_DDP_PROFILE_DIR`` env var.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str | None = None):
+    """Capture a device trace into ``logdir`` for the duration of the
+    ``with`` block; no-op when ``logdir`` is falsy."""
+    if not logdir:
+        yield
+        return
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the trace timeline (host + device)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def profile_dir_from_env() -> str | None:
+    return os.environ.get("TPU_DDP_PROFILE_DIR") or None
