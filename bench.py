@@ -22,7 +22,10 @@ import time
 import numpy as np
 
 
-def run_bench(batch_size: int = 256, timed_iters: int = 39) -> dict:
+def run_bench(batch_size: int | None = None, timed_iters: int = 39,
+              config: str | None = None) -> dict:
+    import os
+
     import jax
 
     from tpu_ddp.data.prefetch import prefetch_to_device
@@ -32,8 +35,16 @@ def run_bench(batch_size: int = 256, timed_iters: int = 39) -> dict:
     from tpu_ddp.utils.config import TrainConfig
     from tpu_ddp.utils.timing import IterationTimer
 
-    cfg = TrainConfig()
-    model = get_model("VGG11", use_pallas_bn=cfg.pallas_bn)
+    # Headline = the reference ladder's config; TPU_DDP_BENCH_CONFIG=
+    # resnet50_imagenet runs the BASELINE.json stretch scale-up instead
+    # (no reference number exists for it -> vs_baseline is null).
+    config = config or os.environ.get("TPU_DDP_BENCH_CONFIG",
+                                      "vgg11_cifar10")
+    cfg = TrainConfig.preset(config)
+    if batch_size is None:
+        batch_size = cfg.global_batch_size
+    model = get_model(cfg.model, num_classes=cfg.num_classes,
+                      use_pallas_bn=cfg.pallas_bn)
     # part3-equivalent (flagship) configuration: fused DP step, pinned to
     # exactly ONE chip so the per-chip metric stays honest on multi-chip
     # hosts (the pmean over a 1-slot axis degenerates gracefully).
@@ -50,10 +61,11 @@ def run_bench(batch_size: int = 256, timed_iters: int = 39) -> dict:
     # the batch fetch, part1/main.py:65-66).
     rng = np.random.default_rng(0)
     n_distinct = 8
-    raw = [rng.integers(0, 256, size=(batch_size, 32, 32, 3),
+    side = cfg.image_size
+    raw = [rng.integers(0, 256, size=(batch_size, side, side, 3),
                         ).astype(np.uint8) for _ in range(n_distinct)]
-    labels = [rng.integers(0, 10, size=batch_size).astype(np.int32)
-              for _ in range(n_distinct)]
+    labels = [rng.integers(0, cfg.num_classes, size=batch_size,
+                           ).astype(np.int32) for _ in range(n_distinct)]
     batches = ((raw[it % n_distinct], labels[it % n_distinct])
                for it in range(timed_iters + 1))
     stream = prefetch_to_device(batches, trainer.put_batch, depth=2)
@@ -66,11 +78,14 @@ def run_bench(batch_size: int = 256, timed_iters: int = 39) -> dict:
         timer.stop(it)
 
     imgs_per_sec = batch_size / timer.average_s
+    headline = config == "vgg11_cifar10"
     return {
-        "metric": "cifar10_vgg11_images_per_sec_per_chip",
+        "metric": ("cifar10_vgg11_images_per_sec_per_chip" if headline
+                   else f"{cfg.dataset}_{cfg.model.lower()}"
+                        "_images_per_sec_per_chip"),
         "value": round(imgs_per_sec, 1),
         "unit": "images/sec",
-        "vs_baseline": round(imgs_per_sec / 386.0, 2),
+        "vs_baseline": round(imgs_per_sec / 386.0, 2) if headline else None,
         "extra": {
             "avg_iter_s": round(timer.average_s, 6),
             "batch_size": batch_size,

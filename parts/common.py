@@ -44,9 +44,17 @@ def parse_arguments(argv=None, require_num_nodes: bool = False):
                    help="process rank; default inferred from hostname "
                         "nodeN (reference part2/part2a/main.py:35-39)")
     p.add_argument("--data-root", type=str, default=None,
-                   help="CIFAR-10 root (default: search standard paths, "
-                        "fall back to synthetic)")
+                   help="dataset root: CIFAR-10 batches dir for the "
+                        "default config, or ImageNet numpy-shard dir "
+                        "({split}_images.npy/{split}_labels.npy) for "
+                        "--config resnet50_imagenet (default: search "
+                        "standard paths / IMAGENET_DIR, fall back to "
+                        "synthetic)")
     p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--config", type=str, default="vgg11_cifar10",
+                   help="named run preset: vgg11_cifar10 (the reference "
+                        "ladder) or resnet50_imagenet (the BASELINE.json "
+                        "stretch scale-up)")
     p.add_argument("--ckpt-dir", type=str, default=None,
                    help="checkpoint directory; saves after each epoch "
                         "(TPU-native extension, no reference equivalent)")
@@ -103,7 +111,7 @@ def run_part(part: str, argv=None):
     if distributed:
         test_distributed_setup(ctx)
 
-    cfg = TrainConfig(epochs=args.epochs)
+    cfg = TrainConfig.preset(args.config, epochs=args.epochs)
     batch_size = cfg.per_node_batch_size(world_size)
 
     # Replicas on the mesh = data-parallel slots. One process with D local
@@ -111,9 +119,16 @@ def run_part(part: str, argv=None):
     mesh = make_mesh() if distributed else None
     dp_size = mesh.shape["dp"] if mesh is not None else 1
 
-    train_loader, test_loader = create_data_loaders(
-        rank=rank, world_size=world_size, batch_size=batch_size,
-        root=args.data_root, seed=cfg.seed)
+    if cfg.dataset == "imagenet":
+        from tpu_ddp.data.imagenet import create_imagenet_loaders
+        train_loader, test_loader = create_imagenet_loaders(
+            rank=rank, world_size=world_size, batch_size=batch_size,
+            root=args.data_root, seed=cfg.seed,
+            image_size=cfg.image_size, num_classes=cfg.num_classes)
+    else:
+        train_loader, test_loader = create_data_loaders(
+            rank=rank, world_size=world_size, batch_size=batch_size,
+            root=args.data_root, seed=cfg.seed)
 
     model = get_model(cfg.model, num_classes=cfg.num_classes,
                       use_pallas_bn=cfg.pallas_bn)

@@ -17,3 +17,13 @@ from tpu_ddp.data.cifar10 import (  # noqa: F401
 )
 from tpu_ddp.data.sampler import DistributedShardSampler  # noqa: F401
 from tpu_ddp.data.loader import DataLoader, create_data_loaders  # noqa: F401
+
+
+def normalization_constants(dataset: str):
+    """(mean, std) on the x/255 scale for a dataset name."""
+    if dataset == "cifar10":
+        return CIFAR10_MEAN, CIFAR10_STD
+    if dataset == "imagenet":
+        from tpu_ddp.data.imagenet import IMAGENET_MEAN, IMAGENET_STD
+        return IMAGENET_MEAN, IMAGENET_STD
+    raise ValueError(f"unknown dataset {dataset!r}")

@@ -97,8 +97,10 @@ def load_cifar10(root: str | None = None, split: str = "train",
     return images, labels, {"synthetic": True, "dir": None}
 
 
-def normalize(images_u8: np.ndarray) -> np.ndarray:
+def normalize(images_u8: np.ndarray, mean: np.ndarray = CIFAR10_MEAN,
+              std: np.ndarray = CIFAR10_STD) -> np.ndarray:
     """uint8 NHWC -> normalized float32 (ToTensor + Normalize,
-    reference part1/main.py:20-31)."""
+    reference part1/main.py:20-31). ``mean``/``std`` are on the x/255
+    scale; defaults are CIFAR-10's."""
     x = images_u8.astype(np.float32) / 255.0
-    return (x - CIFAR10_MEAN) / CIFAR10_STD
+    return (x - mean) / std

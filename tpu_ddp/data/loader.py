@@ -12,7 +12,8 @@ from __future__ import annotations
 import numpy as np
 
 from tpu_ddp.data.augment import random_crop_flip
-from tpu_ddp.data.cifar10 import load_cifar10, normalize
+from tpu_ddp.data.cifar10 import (CIFAR10_MEAN, CIFAR10_STD, load_cifar10,
+                                  normalize)
 from tpu_ddp.data.sampler import DistributedShardSampler
 from tpu_ddp.utils.config import SEED
 
@@ -34,6 +35,8 @@ class DataLoader:
         sampler: DistributedShardSampler | None = None,
         augment: bool = False,
         seed: int = SEED,
+        mean: np.ndarray = CIFAR10_MEAN,
+        std: np.ndarray = CIFAR10_STD,
     ):
         self.images_u8 = images_u8
         self.labels = np.asarray(labels, dtype=np.int32)
@@ -42,6 +45,8 @@ class DataLoader:
         self.augment = augment
         self.seed = seed
         self.epoch = 0
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -64,7 +69,22 @@ class DataLoader:
             imgs = self.images_u8[sel]
             if self.augment:
                 imgs = random_crop_flip(imgs, rng)
-            yield normalize(imgs), self.labels[sel]
+            yield normalize(imgs, self.mean, self.std), self.labels[sel]
+
+
+def _pick_loader_cls(native: bool | None):
+    """DataLoader or NativeDataLoader per the ``native`` arg /
+    ``TPU_DDP_NATIVE_LOADER`` env, with fallback when no toolchain."""
+    if native is None:
+        from tpu_ddp.utils.config import _env_bool
+        native = _env_bool("TPU_DDP_NATIVE_LOADER", False)
+    if native:
+        from tpu_ddp.data import native as native_mod
+        if native_mod.available():
+            return native_mod.NativeDataLoader
+        print("[tpu_ddp.data] native loader requested but unavailable "
+              f"({native_mod.build_error()}) -> numpy pipeline")
+    return DataLoader
 
 
 def create_data_loaders(
@@ -96,17 +116,7 @@ def create_data_loaders(
         sampler = DistributedShardSampler(
             len(train_y), num_replicas=world_size, rank=rank,
             shuffle=False, drop_last=False)
-    if native is None:
-        from tpu_ddp.utils.config import _env_bool
-        native = _env_bool("TPU_DDP_NATIVE_LOADER", False)
-    loader_cls = DataLoader
-    if native:
-        from tpu_ddp.data import native as native_mod
-        if native_mod.available():
-            loader_cls = native_mod.NativeDataLoader
-        else:
-            print("[tpu_ddp.data] native loader requested but unavailable "
-                  f"({native_mod.build_error()}) -> numpy pipeline")
+    loader_cls = _pick_loader_cls(native)
     train_loader = loader_cls(train_x, train_y, batch_size,
                               sampler=sampler, augment=True, seed=seed)
     test_loader = loader_cls(test_x, test_y, batch_size, augment=False)

@@ -127,20 +127,21 @@ class Trainer:
 
     # ---- train step ----------------------------------------------------
 
-    @staticmethod
-    def _maybe_normalize(images):
+    def _maybe_normalize(self, images):
         """Fused on-device normalization for raw uint8 batches.
 
         Transferring uint8 moves 4x fewer bytes over PCIe than host-side
         float32 normalization (tunnel/HBM bandwidth is the bottleneck);
         the arithmetic then fuses into the first conv. Branch is on the
         static dtype, so f32 inputs (the reference-parity host path,
-        reference part1/main.py:20-31) compile to a no-op.
+        reference part1/main.py:20-31) compile to a no-op. Constants come
+        from ``config.dataset``.
         """
         if images.dtype == jnp.uint8:
-            from tpu_ddp.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD
+            from tpu_ddp.data import normalization_constants
+            mean, std = normalization_constants(self.config.dataset)
             x = images.astype(jnp.float32) * (1.0 / 255.0)
-            return (x - jnp.asarray(CIFAR10_MEAN)) / jnp.asarray(CIFAR10_STD)
+            return (x - jnp.asarray(mean)) / jnp.asarray(std)
         return images
 
     def _base_step(self, params, opt_state, images, labels, weights):

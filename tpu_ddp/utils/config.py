@@ -43,6 +43,7 @@ class TrainConfig:
     num_classes: int = 10
     image_size: int = 32
     in_channels: int = 3
+    dataset: str = "cifar10"          # "cifar10" | "imagenet"
 
     # Optimizer: SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
     # (reference part1/main.py:124-125).
@@ -92,3 +93,24 @@ class TrainConfig:
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.
         return int(self.global_batch_size / world_size)
+
+    @classmethod
+    def preset(cls, name: str, **overrides) -> "TrainConfig":
+        """Named run configurations (BASELINE.json configs)."""
+        try:
+            base = dict(PRESETS[name])
+        except KeyError:
+            raise ValueError(
+                f"unknown preset {name!r}; available: {sorted(PRESETS)}"
+            ) from None
+        base.update(overrides)
+        return cls(**base)
+
+
+# The reference ladder's configuration (configs[0..3]) plus the stretch
+# scale-up (configs[4], "ResNet-50 / ImageNet-1k").
+PRESETS = {
+    "vgg11_cifar10": {},
+    "resnet50_imagenet": dict(model="ResNet50", num_classes=1000,
+                              image_size=224, dataset="imagenet"),
+}
