@@ -1,0 +1,209 @@
+"""Ring attention / sequence parallelism / LM engine.
+
+The decisive property: the sp-sharded path computes EXACTLY the same
+function as the single-device path (ring attention is exact, not an
+approximation), for values AND gradients, causal and not.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_ddp.models.transformer import TransformerLM, make_transformer
+from tpu_ddp.parallel.mesh import DATA_AXIS, SEQ_AXIS, make_mesh
+from tpu_ddp.parallel.ring_attention import full_attention, ring_attention
+from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+
+
+def _qkv(key, b=2, L=32, h=4, d=16):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, L, h, d)) for k in ks)
+
+
+def _ring_on_mesh(mesh, sp, causal):
+    def fn(q, k, v):
+        return ring_attention(q, k, v, SEQ_AXIS, sp, causal=causal)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, SEQ_AXIS), P(None, SEQ_AXIS), P(None, SEQ_AXIS)),
+        out_specs=P(None, SEQ_AXIS), check_vma=False))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("sp", [2, 4, 8])
+    def test_matches_full_attention(self, devices, causal, sp):
+        q, k, v = _qkv(jax.random.key(0))
+        mesh = make_mesh(devices[:sp], dp=1, sp=sp)
+        got = _ring_on_mesh(mesh, sp, causal)(q, k, v)
+        want = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gradients_match(self, devices):
+        q, k, v = _qkv(jax.random.key(1), L=16)
+        sp = 4
+        mesh = make_mesh(devices[:sp], dp=1, sp=sp)
+        ring = _ring_on_mesh(mesh, sp, True)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring(q, k, v) ** 2)
+
+        def loss_full(q, k, v):
+            return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+        g_r = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_f = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_r, g_f):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-5, atol=5e-5)
+
+    def test_causal_masks_future(self, devices):
+        """Perturbing future positions must not change earlier outputs."""
+        q, k, v = _qkv(jax.random.key(2), L=16)
+        sp = 4
+        mesh = make_mesh(devices[:sp], dp=1, sp=sp)
+        ring = _ring_on_mesh(mesh, sp, True)
+        base = np.asarray(ring(q, k, v))
+        k2 = k.at[:, 12:].add(100.0)
+        v2 = v.at[:, 12:].add(-50.0)
+        pert = np.asarray(ring(q, k2, v2))
+        np.testing.assert_allclose(pert[:, :12], base[:, :12],
+                                   rtol=1e-5, atol=1e-5)
+        assert np.abs(pert[:, 12:] - base[:, 12:]).max() > 1e-3
+
+
+class TestTransformerLM:
+    def test_forward_shapes(self):
+        model = make_transformer("TransformerLM-tiny", max_seq_len=64,
+                                 compute_dtype=jnp.float32)
+        params = model.init(jax.random.key(0))
+        tokens = jnp.zeros((2, 64), jnp.int32)
+        logits = model.apply(params, tokens)
+        assert logits.shape == (2, 64, model.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_sp_sharded_matches_single_device(self, devices):
+        """The whole MODEL (RoPE offsets + ring attention + loss path)
+        computes the same function under sp=4 as on one device."""
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        params = model.init(jax.random.key(3))
+        tokens = jax.random.randint(jax.random.key(4), (2, 32), 0, 1024)
+
+        want = model.apply(params, tokens)
+
+        sp = 4
+        mesh = make_mesh(devices[:sp], dp=1, sp=sp)
+        sharded = model.with_sequence_parallel(SEQ_AXIS, sp)
+        fn = jax.jit(jax.shard_map(
+            sharded.apply, mesh=mesh,
+            in_specs=(P(), P(None, SEQ_AXIS)),
+            out_specs=P(None, SEQ_AXIS), check_vma=False))
+        got = fn(params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_causal_lm_property(self):
+        """Changing token t+k must not change logits at positions < t."""
+        model = make_transformer("TransformerLM-tiny", max_seq_len=16,
+                                 compute_dtype=jnp.float32)
+        params = model.init(jax.random.key(5))
+        t = jax.random.randint(jax.random.key(6), (1, 16), 0, 1024)
+        l1 = model.apply(params, t)
+        t2 = t.at[0, 10].set((t[0, 10] + 7) % 1024)
+        l2 = model.apply(params, t2)
+        np.testing.assert_allclose(np.asarray(l1[:, :10]),
+                                   np.asarray(l2[:, :10]),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestAdamW:
+    def test_three_layer_blocks_not_corrupted(self):
+        """Regression: params trees containing 3-tuples (e.g. a 3-layer
+        blocks tuple) must update structure-safely."""
+        from tpu_ddp.ops.optim import AdamW
+        model = make_transformer("TransformerLM-tiny", num_layers=3,
+                                 max_seq_len=16,
+                                 compute_dtype=jnp.float32)
+        params = model.init(jax.random.key(0))
+        grads = jax.tree.map(jnp.ones_like, params)
+        opt = AdamW()
+        state = opt.init(params)
+        new_p, state = opt.apply(params, grads, state)
+        assert jax.tree.structure(new_p) == jax.tree.structure(params)
+        assert len(new_p["blocks"]) == 3
+        for blk in new_p["blocks"]:
+            assert set(blk) == {"ln1", "wqkv", "wo", "ln2", "w1", "w2"}
+        # And the update actually moved every leaf.
+        moved = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()),
+                             params, new_p)
+        assert min(jax.tree.leaves(moved)) > 0
+
+    def test_matches_manual_single_step(self):
+        from tpu_ddp.ops.optim import AdamW
+        opt = AdamW(learning_rate=0.01, b1=0.9, b2=0.999, eps=1e-8,
+                    weight_decay=0.0)
+        p = {"w": jnp.asarray([2.0])}
+        g = {"w": jnp.asarray([0.5])}
+        state = opt.init(p)
+        new_p, _ = opt.apply(p, g, state)
+        mu = 0.1 * 0.5
+        nu = 0.001 * 0.25
+        step = (mu / 0.1) / (np.sqrt(nu / 0.001) + 1e-8)
+        np.testing.assert_allclose(np.asarray(new_p["w"]),
+                                   [2.0 - 0.01 * step], rtol=1e-6)
+
+
+class TestLMTrainer:
+    def test_train_step_dp_x_sp(self, devices):
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:8], dp=2, sp=4)
+        tr = LMTrainer(model, mesh)
+        assert tr.dp == 2 and tr.sp == 4
+        state = tr.init_state()
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 1024, size=(4, 33))
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        losses = []
+        for _ in range(3):
+            state, loss = tr.train_step(state, x, y)
+            losses.append(float(np.mean(np.asarray(loss))))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]  # AdamW memorizes a fixed batch fast
+        assert state.step == 3
+
+    def test_loss_matches_dp_only(self, devices):
+        """First-step loss under dp=2 x sp=4 equals dp=8 x sp=1 equals
+        the global token mean computed by hand."""
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        rng = np.random.default_rng(1)
+        tokens = rng.integers(0, 1024, size=(8, 33))
+        inp, tgt = make_lm_batch(tokens)
+
+        def first_loss(dp, sp):
+            mesh = make_mesh(devices[:8], dp=dp, sp=sp)
+            tr = LMTrainer(model, mesh)
+            state = tr.init_state(seed=42)
+            x, y = tr.put_batch(inp, tgt)
+            _, loss = tr.train_step(state, x, y)
+            return float(np.mean(np.asarray(loss)))
+
+        a = first_loss(2, 4)
+        b = first_loss(8, 1)
+        assert abs(a - b) < 1e-4, (a, b)
+
+    def test_indivisible_raises(self, devices):
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        tr = LMTrainer(model, make_mesh(devices[:8], dp=2, sp=4))
+        with pytest.raises(ValueError, match="not divisible"):
+            tr.put_batch(np.zeros((3, 32), np.int32),
+                         np.zeros((3, 32), np.int32))
+        with pytest.raises(ValueError, match="not divisible"):
+            tr.put_batch(np.zeros((2, 30), np.int32),
+                         np.zeros((2, 30), np.int32))

@@ -17,6 +17,8 @@ import os
 
 import numpy as np
 
+from tpu_ddp.utils.config import SEED
+
 # torchvision's canonical ImageNet normalization constants.
 IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
@@ -63,7 +65,7 @@ def create_imagenet_loaders(
     world_size: int = 1,
     batch_size: int = 256,
     root: str | None = None,
-    seed: int = 89395,
+    seed: int = SEED,
     synthetic_size: int | None = None,
     image_size: int = 224,
     num_classes: int = 1000,
@@ -75,15 +77,17 @@ def create_imagenet_loaders(
     from tpu_ddp.data.loader import DataLoader, _pick_loader_cls
     from tpu_ddp.data.sampler import DistributedShardSampler
 
-    train_x, train_y, meta = load_imagenet(
+    train_x, train_y, meta_tr = load_imagenet(
         root, "train", synthetic_size, image_size, num_classes)
-    test_x, test_y, _ = load_imagenet(
+    test_x, test_y, meta_va = load_imagenet(
         root, "val",
         None if synthetic_size is None else max(synthetic_size // 4, 8),
         image_size, num_classes)
-    if meta["synthetic"]:
-        print("[tpu_ddp.data] ImageNet not found -> deterministic synthetic "
-              "stand-in (set IMAGENET_DIR to use real shards)")
+    for split, meta in (("train", meta_tr), ("val", meta_va)):
+        if meta["synthetic"]:
+            print(f"[tpu_ddp.data] ImageNet {split} split not found -> "
+                  "deterministic synthetic stand-in (set IMAGENET_DIR to "
+                  "use real shards)")
     sampler = None
     if world_size > 1:
         sampler = DistributedShardSampler(

@@ -16,6 +16,11 @@ from tpu_ddp.models.vgg import (  # noqa: F401
     make_vgg,
 )
 from tpu_ddp.models.resnet import ResNetModel, resnet50, make_resnet  # noqa: F401
+from tpu_ddp.models.transformer import (  # noqa: F401
+    TransformerLM,
+    make_transformer,
+)
+import functools as _functools
 
 _REGISTRY = {
     "VGG11": vgg11,
@@ -23,6 +28,12 @@ _REGISTRY = {
     "VGG16": vgg16,
     "VGG19": vgg19,
     "ResNet50": resnet50,
+    "TransformerLM-tiny": _functools.partial(make_transformer,
+                                             "TransformerLM-tiny"),
+    "TransformerLM-small": _functools.partial(make_transformer,
+                                              "TransformerLM-small"),
+    "TransformerLM-base": _functools.partial(make_transformer,
+                                             "TransformerLM-base"),
 }
 
 

@@ -1,0 +1,174 @@
+"""Decoder-only transformer LM — the long-context model family.
+
+No reference counterpart (the reference ships only VGG,
+part1/model.py:49-50); this family exists because long-context training is
+first-class in this framework. Same conventions as the rest of the zoo:
+functional (init/apply over a pytree), bf16 compute with f32 params and
+f32 softmax/LN statistics, static config on a frozen dataclass.
+
+Sequence parallelism: ``apply`` takes the LOCAL sequence chunk. When
+``sp_axis``/``sp_size`` are configured (and apply runs inside a
+``shard_map`` over that axis), attention runs as ring attention over the
+``sp`` mesh axis (tpu_ddp/parallel/ring_attention.py) and RoPE positions
+are offset by the chunk's global start — so the model computes EXACTLY the
+same function as the single-device configuration (tested in
+tests/test_ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_ddp.parallel.ring_attention import attend
+
+
+def _normal(key, shape, std, dtype):
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding. x: (B, L, H, D), positions: (L,)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]  # (1, L, 1, half)
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    x32_1, x32_2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [x32_1 * cos - x32_2 * sin, x32_2 * cos + x32_1 * sin],
+        axis=-1).astype(x.dtype)
+
+
+def layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerLM:
+    """GPT-style pre-LN decoder. Causal by construction."""
+
+    name: str = "TransformerLM"
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    d_model: int = 512
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    # Sequence parallelism: mesh axis name/extent the LOCAL chunk lives on.
+    sp_axis: str | None = None
+    sp_size: int = 1
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    # ---- parameters ----------------------------------------------------
+
+    def init(self, key) -> dict:
+        dm, dff, v = self.d_model, self.d_ff, self.vocab_size
+        std = 0.02
+        keys = iter(jax.random.split(key, 4 + 8 * self.num_layers))
+        params = {
+            "embed": _normal(next(keys), (v, dm), std, self.param_dtype),
+            "ln_f": {"scale": jnp.ones((dm,), self.param_dtype),
+                     "bias": jnp.zeros((dm,), self.param_dtype)},
+            "head": _normal(next(keys), (dm, v), std, self.param_dtype),
+        }
+        blocks = []
+        for _ in range(self.num_layers):
+            blocks.append({
+                "ln1": {"scale": jnp.ones((dm,), self.param_dtype),
+                        "bias": jnp.zeros((dm,), self.param_dtype)},
+                "wqkv": _normal(next(keys), (dm, 3 * dm), std,
+                                self.param_dtype),
+                "wo": _normal(next(keys), (dm, dm), std, self.param_dtype),
+                "ln2": {"scale": jnp.ones((dm,), self.param_dtype),
+                        "bias": jnp.zeros((dm,), self.param_dtype)},
+                "w1": _normal(next(keys), (dm, dff), std, self.param_dtype),
+                "w2": _normal(next(keys), (dff, dm), std, self.param_dtype),
+            })
+        params["blocks"] = tuple(blocks)
+        return params
+
+    # ---- forward -------------------------------------------------------
+
+    def _positions(self, lc: int):
+        """Global positions of the local chunk (chunk offset under sp)."""
+        if self.sp_axis is not None and self.sp_size > 1:
+            start = lax.axis_index(self.sp_axis) * lc
+        else:
+            start = 0
+        return start + jnp.arange(lc)
+
+    def apply(self, params, tokens):
+        """tokens: (B, L_local) int32 -> logits (B, L_local, V) float32."""
+        cd = self.compute_dtype
+        b, lc = tokens.shape
+        h, hd = self.num_heads, self.head_dim
+        pos = self._positions(lc)
+        x = params["embed"][tokens].astype(cd)          # (B, L, dm)
+        for blk in params["blocks"]:
+            y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+            qkv = jnp.dot(y, blk["wqkv"].astype(cd),
+                          preferred_element_type=jnp.float32)
+            q, k, v = jnp.split(qkv.astype(cd), 3, axis=-1)
+            q = rope(q.reshape(b, lc, h, hd), pos)
+            k = rope(k.reshape(b, lc, h, hd), pos)
+            v = v.reshape(b, lc, h, hd)
+            o = attend(q, k, v, causal=True, axis_name=self.sp_axis,
+                       axis_size=self.sp_size)
+            o = jnp.dot(o.reshape(b, lc, self.d_model),
+                        blk["wo"].astype(cd),
+                        preferred_element_type=jnp.float32).astype(cd)
+            x = x + o
+            y = layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+            y = jnp.dot(y, blk["w1"].astype(cd),
+                        preferred_element_type=jnp.float32)
+            y = jax.nn.gelu(y.astype(jnp.float32)).astype(cd)
+            y = jnp.dot(y, blk["w2"].astype(cd),
+                        preferred_element_type=jnp.float32).astype(cd)
+            x = x + y
+        x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        logits = jnp.dot(x, params["head"].astype(cd),
+                         preferred_element_type=jnp.float32)
+        return logits.astype(jnp.float32)
+
+    def num_params(self, params=None, key=None) -> int:
+        if params is None:
+            params = self.init(key if key is not None else jax.random.key(0))
+        return sum(int(p.size) for p in jax.tree.leaves(params))
+
+    def with_sequence_parallel(self, axis_name: str,
+                               axis_size: int) -> "TransformerLM":
+        return dataclasses.replace(self, sp_axis=axis_name,
+                                   sp_size=axis_size)
+
+
+def make_transformer(name: str = "TransformerLM-small",
+                     **kwargs) -> TransformerLM:
+    presets = {
+        "TransformerLM-tiny": dict(num_layers=2, num_heads=4, d_model=128,
+                                   d_ff=512, vocab_size=1024),
+        "TransformerLM-small": dict(num_layers=4, num_heads=8, d_model=512,
+                                    d_ff=2048, vocab_size=32000),
+        "TransformerLM-base": dict(num_layers=12, num_heads=12, d_model=768,
+                                   d_ff=3072, vocab_size=32000),
+    }
+    if name not in presets:
+        raise ValueError(f"unknown transformer preset {name!r}; "
+                         f"available: {sorted(presets)}")
+    cfg = dict(presets[name])
+    cfg.update(kwargs)
+    return TransformerLM(name=name, **cfg)

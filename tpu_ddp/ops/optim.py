@@ -58,3 +58,45 @@ class SGD:
         new_params = jax.tree.map(
             lambda p, buf: p - self.learning_rate * buf, params, new_buf)
         return new_params, {"momentum": new_buf}
+
+
+@dataclasses.dataclass(frozen=True)
+class AdamW:
+    """AdamW (decoupled weight decay) — the LM-family optimizer.
+
+    No reference counterpart (the reference uses SGD only,
+    part1/main.py:124-125); added for the transformer/long-context models,
+    same pure-pytree-transform shape as :class:`SGD`.
+    """
+
+    learning_rate: float = 3e-4
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    weight_decay: float = 0.1
+
+    def init(self, params) -> dict:
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)  # noqa: E731
+        return {"mu": zeros(), "nu": zeros(),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def apply(self, params, grads, state):
+        count = state["count"] + 1
+        c = count.astype(jnp.float32)
+        bc1 = 1.0 - self.b1 ** c
+        bc2 = 1.0 - self.b2 ** c
+        # Separate tree.maps per output (the SGD style above): structure-
+        # safe for any params pytree, and XLA CSEs the shared subterms.
+        new_mu = jax.tree.map(
+            lambda p, g, mu: self.b1 * mu + (1 - self.b1) * g.astype(p.dtype),
+            params, grads, state["mu"])
+        new_nu = jax.tree.map(
+            lambda p, g, nu: self.b2 * nu
+            + (1 - self.b2) * jnp.square(g.astype(p.dtype)),
+            params, grads, state["nu"])
+        new_p = jax.tree.map(
+            lambda p, mu, nu: p - self.learning_rate * (
+                (mu / bc1) / (jnp.sqrt(nu / bc2) + self.eps)
+                + self.weight_decay * p),
+            params, new_mu, new_nu)
+        return new_p, {"mu": new_mu, "nu": new_nu, "count": count}

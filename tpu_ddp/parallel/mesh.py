@@ -18,27 +18,30 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "dp"
+SEQ_AXIS = "sp"
 MODEL_AXIS = "mp"
 
 
-def make_mesh(devices=None, dp: int | None = None, mp: int = 1) -> Mesh:
-    """Build a (dp, mp) mesh over ``devices`` (default: all devices).
+def make_mesh(devices=None, dp: int | None = None, sp: int = 1,
+              mp: int = 1) -> Mesh:
+    """Build a (dp, sp, mp) mesh over ``devices`` (default: all devices).
 
-    ``dp`` defaults to ``len(devices) // mp``. For pure data parallelism
-    (the reference's only mode) this is a 1-D dp mesh with a trivial mp
-    axis.
+    ``dp`` defaults to ``len(devices) // (sp * mp)``. For pure data
+    parallelism (the reference's only mode) this is a 1-D dp mesh with
+    trivial sp/mp axes; ``sp`` > 1 shards the sequence axis for ring
+    attention (tpu_ddp/parallel/ring_attention.py).
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     if dp is None:
-        if n % mp:
-            raise ValueError(f"{n} devices not divisible by mp={mp}")
-        dp = n // mp
-    if dp * mp != n:
-        raise ValueError(f"dp*mp = {dp}*{mp} != {n} devices")
-    arr = np.asarray(devices).reshape(dp, mp)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+        if n % (sp * mp):
+            raise ValueError(f"{n} devices not divisible by sp*mp={sp * mp}")
+        dp = n // (sp * mp)
+    if dp * sp * mp != n:
+        raise ValueError(f"dp*sp*mp = {dp}*{sp}*{mp} != {n} devices")
+    arr = np.asarray(devices).reshape(dp, sp, mp)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def data_parallel_specs():

@@ -1,0 +1,124 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+No reference counterpart — the reference's workload is 32x32 image
+classification and it implements no sequence/context parallelism
+(SURVEY.md §5 "Long-context / sequence parallelism: Absent") — but
+long-context training is first-class in this framework, so the primitive
+lives here in the parallel layer next to the DP sync strategies.
+
+Scheme (Liu et al., "Ring Attention with Blockwise Transformers",
+arXiv:2310.01889 — reimplemented from the paper's algorithm, not from any
+code): the sequence axis is sharded over the ``sp`` mesh axis; each device
+keeps its Q chunk resident and the K/V chunks travel around the ring via
+``lax.ppermute`` (XLA lowers this to ICI neighbor exchange), one hop per
+step, overlapping each hop with the local blockwise-attention compute.
+Softmax is computed online (flash-attention style running max / sum /
+accumulator in float32), so the result is EXACT full attention — verified
+against a single-device reference in tests/test_ring_attention.py —
+with per-device memory O(L/sp · L/sp) instead of O(L²).
+
+Causal masking uses global positions (chunk offset = ring distance), so
+chunks strictly above the diagonal contribute nothing (their scores are
+masked; the compute is still issued — a skip would unbalance ring steps).
+
+Differentiable: pure jnp + ``ppermute`` (whose transpose is the inverse
+rotation), so ``jax.grad`` through a ``shard_map``'d call just works.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_ddp.parallel.mesh import SEQ_AXIS
+
+_NEG_INF = -1e30  # mask value; avoids NaN from (-inf) - (-inf)
+
+
+def _block_attn(q, k, v, m_prev, l_prev, acc_prev, q_pos, k_pos, causal,
+                scale):
+    """One blockwise-attention update of the online softmax state.
+
+    q: (B, Lq, H, D); k/v: (B, Lk, H, D); positions: (Lq,), (Lk,).
+    State: m (B, H, Lq) running max, l (B, H, Lq) running sum,
+    acc (B, Lq, H, D) unnormalized output. All state float32.
+    """
+    # scores: (B, H, Lq, Lk) in f32 (MXU accumulates f32 from bf16 inputs).
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = k_pos[None, None, None, :] > q_pos[None, None, :, None]
+        scores = jnp.where(mask, _NEG_INF, scores)
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))      # (B,H,Lq)
+    p = jnp.exp(scores - m_new[..., None])                     # (B,H,Lq,Lk)
+    correction = jnp.exp(m_prev - m_new)                       # (B,H,Lq)
+    l_new = correction * l_prev + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    acc_new = acc_prev * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
+                   axis_size: int | None = None, causal: bool = False):
+    """Exact multi-head attention with sequence sharded over ``axis_name``.
+
+    Must be called inside a ``shard_map`` over a mesh with that axis.
+    ``q``/``k``/``v``: local chunks (B, L/sp, H, D). Returns the local
+    output chunk (B, L/sp, H, D) in ``q``'s dtype.
+    """
+    if axis_size is None:
+        raise ValueError("axis_size (the sp mesh extent) is required — "
+                         "loop bounds must be static under jit")
+    b, lc, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    my = lax.axis_index(axis_name)
+    q_pos = my * lc + jnp.arange(lc)
+
+    m = jnp.full((b, h, lc), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, lc), jnp.float32)
+    acc = jnp.zeros((b, lc, h, d), jnp.float32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    k_cur, v_cur = k, v
+    for step in range(axis_size):
+        # After `step` forward rotations each device holds the chunk that
+        # originated `step` positions behind it on the ring.
+        kv_owner = (my - step) % axis_size
+        k_pos = kv_owner * lc + jnp.arange(lc)
+        m, l, acc = _block_attn(q, k_cur, v_cur, m, l, acc,
+                                q_pos, k_pos, causal, scale)
+        if step != axis_size - 1:
+            # Rotate K/V one hop; XLA overlaps this ICI exchange with the
+            # next iteration's einsums (independent dataflow).
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def full_attention(q, k, v, causal: bool = False):
+    """Single-device reference: same math, whole sequence resident."""
+    b, L, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        pos = jnp.arange(L)
+        scores = jnp.where(pos[None, None, None, :] > pos[None, None, :, None],
+                           _NEG_INF, scores)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def attend(q, k, v, *, causal: bool = False, axis_name: str | None = None,
+           axis_size: int | None = None):
+    """Dispatch: ring attention when a sequence axis is given, else full."""
+    if axis_name is not None and axis_size is not None and axis_size > 1:
+        return ring_attention(q, k, v, axis_name, axis_size, causal=causal)
+    return full_attention(q, k, v, causal=causal)

@@ -1,0 +1,116 @@
+"""Language-model training engine: data parallel × sequence parallel.
+
+No reference counterpart (the reference trains VGG on CIFAR with DP only,
+SURVEY.md §2/§5) — this engine exists because long-context training is
+first-class here. One jitted ``shard_map`` step over a (dp, sp) mesh:
+
+- token/target batches (B, L) are sharded batch-over-``dp`` AND
+  sequence-over-``sp``;
+- attention inside the model runs as ring attention over ``sp``
+  (tpu_ddp/parallel/ring_attention.py) so each device only ever holds its
+  L/sp chunk;
+- the loss is the global per-token mean: local weighted sums are
+  ``psum``'d over BOTH axes;
+- gradients are ``pmean``'d over (dp, sp) — params/optimizer state are
+  replicated everywhere, exactly like the DP ladder's "fused" strategy
+  (part3-equivalent) generalized to two axes.
+
+Next-token shift happens on host (``make_lm_batch``): inputs = tokens[:-1],
+targets = tokens[1:], so no cross-chunk halo exchange is needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_ddp.ops.optim import AdamW
+from tpu_ddp.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+
+@dataclasses.dataclass
+class LMTrainState:
+    params: Any
+    opt_state: Any
+    step: int = 0
+
+
+def make_lm_batch(tokens: np.ndarray):
+    """(B, L+1) token ids -> (inputs, targets), each (B, L)."""
+    tokens = np.asarray(tokens)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+class LMTrainer:
+    """Wires a TransformerLM + AdamW into a dp x sp sharded train step."""
+
+    def __init__(self, model, mesh: Mesh, optimizer: AdamW | None = None):
+        self.mesh = mesh
+        self.dp = mesh.shape[DATA_AXIS]
+        self.sp = mesh.shape[SEQ_AXIS]
+        self.model = model.with_sequence_parallel(SEQ_AXIS, self.sp) \
+            if self.sp > 1 else model
+        self.optimizer = optimizer or AdamW()
+        self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+        self._repl_sharding = NamedSharding(mesh, P())
+        self._train_step = self._build_train_step()
+
+    def init_state(self, seed: int = 0) -> LMTrainState:
+        params = self.model.init(jax.random.key(seed))
+        opt_state = self.optimizer.init(params)
+        params = jax.device_put(params, self._repl_sharding)
+        opt_state = jax.device_put(opt_state, self._repl_sharding)
+        return LMTrainState(params=params, opt_state=opt_state)
+
+    def _base_step(self, params, opt_state, inputs, targets):
+        def loss_fn(p):
+            logits = self.model.apply(p, inputs)        # (B, Lc, V) f32
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, targets[..., None], axis=-1)[..., 0]
+            local_sum = jnp.sum(nll)
+            local_n = jnp.float32(nll.size)
+            total = lax.psum(local_n, (DATA_AXIS, SEQ_AXIS))
+            n_shards = lax.psum(1.0, (DATA_AXIS, SEQ_AXIS))
+            # Scale so pmean-of-grads == grad of the GLOBAL token mean.
+            loss_for_grad = n_shards * local_sum / total
+            return loss_for_grad, local_sum / local_n
+        (_, local_mean), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = lax.pmean(grads, (DATA_AXIS, SEQ_AXIS))
+        params, opt_state = self.optimizer.apply(params, grads, opt_state)
+        # (1, 1) per shard -> (dp, sp) global: every shard's own chunk mean.
+        return params, opt_state, local_mean.reshape(1, 1)
+
+    def _build_train_step(self):
+        mapped = jax.shard_map(
+            self._base_step,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(DATA_AXIS, SEQ_AXIS),
+                      P(DATA_AXIS, SEQ_AXIS)),
+            out_specs=(P(), P(), P(DATA_AXIS, SEQ_AXIS)),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def put_batch(self, inputs, targets):
+        inputs = np.ascontiguousarray(inputs, np.int32)
+        targets = np.ascontiguousarray(targets, np.int32)
+        b, L = inputs.shape
+        if b % self.dp:
+            raise ValueError(f"batch {b} not divisible by dp={self.dp}")
+        if L % self.sp:
+            raise ValueError(f"seq len {L} not divisible by sp={self.sp}")
+        return (jax.device_put(inputs, self._batch_sharding),
+                jax.device_put(targets, self._batch_sharding))
+
+    def train_step(self, state: LMTrainState, inputs, targets):
+        params, opt_state, loss = self._train_step(
+            state.params, state.opt_state, inputs, targets)
+        return LMTrainState(params, opt_state, state.step + 1), loss
