@@ -1,0 +1,218 @@
+"""Local multi-process launcher — the cluster-in-a-box analogue of the
+reference's launch recipe.
+
+The reference is launched by hand on every node of a 4-node cluster with
+the same command (reference README.md:8-19)::
+
+    python main.py --num-nodes 4 --rank R --master-ip 10.10.1.1 --master-port 4000
+
+This module automates that loop on ONE host: it spawns ``nproc`` worker
+processes, each running a part's ``main.py`` with ``--rank i`` and a shared
+``127.0.0.1`` coordinator, so the real multi-process rendezvous path
+(``jax.distributed.initialize`` -> cross-process collectives) is exercised
+without a cluster — the TPU-native analogue of gloo's multi-process
+single-host mode (SURVEY.md §4). On an actual TPU pod each host still runs
+its part ``main.py`` directly, exactly like the reference.
+
+CLI::
+
+    python -m tpu_ddp.launch part2b --nproc 4 [--platform cpu]
+        [--devices-per-proc 1] [--port auto] [part args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+PARTS_DIR = Path(__file__).resolve().parent.parent / "parts"
+PARTS = ("part1", "part2a", "part2b", "part3")
+
+
+def find_free_port() -> int:
+    """Ask the OS for a free TCP port for the coordinator."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class WorkerResult:
+    rank: int
+    returncode: int
+    output: str = ""
+
+
+@dataclass
+class LaunchResult:
+    workers: list = field(default_factory=list)
+    # Exit code of the FIRST rank observed failing — the root cause, not
+    # the -9 of bystander ranks reaped afterwards. 0 when all succeeded.
+    first_failure: int = 0
+
+    @property
+    def returncode(self) -> int:
+        if self.first_failure:
+            return self.first_failure
+        # Fallback (e.g. hand-built results): any nonzero rank fails the
+        # launch, including negative signal-kill codes.
+        return next((w.returncode for w in self.workers
+                     if w.returncode != 0), 0)
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0
+
+    def output_of(self, rank: int) -> str:
+        for w in self.workers:
+            if w.rank == rank:
+                return w.output
+        raise KeyError(rank)
+
+
+def _drain(proc, rank: int, sink: list, echo: bool) -> None:
+    """Stream one worker's stdout, prefixing lines with its rank."""
+    for raw in proc.stdout:
+        line = raw.rstrip("\n")
+        sink.append(line)
+        if echo:
+            print(f"[rank {rank}] {line}", flush=True)
+    proc.stdout.close()
+
+
+def launch(
+    part: str,
+    nproc: int,
+    extra_args: list | None = None,
+    platform: str = "cpu",
+    devices_per_proc: int = 1,
+    port: int | None = None,
+    env: dict | None = None,
+    echo: bool = True,
+    timeout: float | None = None,
+) -> LaunchResult:
+    """Run ``nproc`` rank processes of ``parts/<part>/main.py`` and wait.
+
+    Each worker gets ``JAX_PLATFORMS=<platform>`` and (on cpu) a forced
+    host-platform device count of ``devices_per_proc``, so a laptop/CI host
+    emulates an ``nproc``-node cluster with ``nproc * devices_per_proc``
+    total dp slots. Extra env wins over the computed defaults.
+    """
+    if part not in PARTS:
+        raise ValueError(f"unknown part {part!r}; available: {PARTS}")
+    if nproc < 1:
+        raise ValueError("nproc must be >= 1")
+    script = PARTS_DIR / part / "main.py"
+    if not script.exists():
+        raise FileNotFoundError(
+            f"{script}: the launcher runs the parts/ CLIs and therefore "
+            "needs a source checkout (parts/ is not part of the installed "
+            "package)")
+    port = port or find_free_port()
+
+    procs = []
+    sinks = []
+    threads = []
+    for rank in range(nproc):
+        child_env = dict(os.environ)
+        child_env["JAX_PLATFORMS"] = platform
+        if platform == "cpu":
+            # Replace (not append) any inherited forced device count.
+            flags = [f for f in child_env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f]
+            flags.append("--xla_force_host_platform_device_count="
+                         f"{devices_per_proc}")
+            child_env["XLA_FLAGS"] = " ".join(flags)
+        if env:
+            child_env.update(env)
+        cmd = [sys.executable, str(script),
+               "--num-nodes", str(nproc),
+               "--rank", str(rank),
+               "--master-ip", "127.0.0.1",
+               "--master-port", str(port)] + list(extra_args or [])
+        proc = subprocess.Popen(
+            cmd, env=child_env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+            cwd=str(PARTS_DIR.parent))
+        sink: list = []
+        t = threading.Thread(target=_drain, args=(proc, rank, sink, echo),
+                             daemon=True)
+        t.start()
+        procs.append(proc)
+        sinks.append(sink)
+        threads.append(t)
+
+    # Poll all ranks concurrently against ONE shared deadline. Sequential
+    # proc.wait() calls would hang forever (timeout=None) or for
+    # nproc*timeout when one rank dies early and the survivors block in
+    # the rendezvous/collective waiting for it.
+    deadline = None if timeout is None else time.monotonic() + timeout
+    rcs: dict = {}
+    first_failure = 0
+    while len(rcs) < len(procs):
+        for rank, proc in enumerate(procs):
+            if rank in rcs:
+                continue
+            rc = proc.poll()
+            if rc is None:
+                continue
+            rcs[rank] = rc
+            if rc != 0:
+                first_failure = first_failure or rc
+                # A dead rank leaves the others blocked in a collective;
+                # reap them now instead of waiting out the timeout.
+                for other in procs:
+                    if other.poll() is None:
+                        other.kill()
+        if len(rcs) < len(procs):
+            if deadline is not None and time.monotonic() > deadline:
+                for rank, proc in enumerate(procs):
+                    if rank not in rcs:
+                        proc.kill()
+                        proc.wait()
+                        rcs[rank] = -9
+                first_failure = first_failure or -9
+                break
+            time.sleep(0.05)
+    result = LaunchResult(first_failure=first_failure)
+    for rank in range(len(procs)):
+        result.workers.append(WorkerResult(rank=rank, returncode=rcs[rank]))
+    for t in threads:
+        t.join(timeout=5)
+    for w, sink in zip(result.workers, sinks):
+        w.output = "\n".join(sink)
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_ddp.launch",
+        description="spawn an N-process local cluster running one part")
+    p.add_argument("part", choices=PARTS)
+    p.add_argument("--nproc", type=int, required=True,
+                   help="number of rank processes (the --num-nodes value)")
+    p.add_argument("--platform", default="cpu",
+                   help="JAX platform for workers (default cpu; use tpu "
+                        "only with per-process device isolation)")
+    p.add_argument("--devices-per-proc", type=int, default=1,
+                   help="forced CPU device count per worker (cpu only)")
+    p.add_argument("--port", type=int, default=None,
+                   help="coordinator port (default: pick a free one)")
+    args, extra = p.parse_known_args(argv)
+    res = launch(args.part, args.nproc, extra_args=extra,
+                 platform=args.platform,
+                 devices_per_proc=args.devices_per_proc, port=args.port)
+    for w in res.workers:
+        print(f"[launch] rank {w.rank} exited {w.returncode}")
+    return res.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,10 @@ class TransformerLM:
         """tokens: (B, L_local) int32 -> logits (B, L_local, V) float32."""
         cd = self.compute_dtype
         b, lc = tokens.shape
+        if lc * self.sp_size > self.max_seq_len:
+            raise ValueError(
+                f"global sequence length {lc * self.sp_size} (local {lc} x "
+                f"sp {self.sp_size}) exceeds max_seq_len={self.max_seq_len}")
         h, hd = self.num_heads, self.head_dim
         pos = self._positions(lc)
         x = params["embed"][tokens].astype(cd)          # (B, L, dm)

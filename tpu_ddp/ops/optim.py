@@ -73,6 +73,9 @@ class AdamW:
     b1: float = 0.9
     b2: float = 0.95
     eps: float = 1e-8
+    # Decoupled decay, applied ONLY to matrix-shaped leaves (ndim >= 2):
+    # LayerNorm scales/biases and bias vectors are exempt, embeddings and
+    # projection matrices decay — the standard transformer AdamW recipe.
     weight_decay: float = 0.1
 
     def init(self, params) -> dict:
@@ -97,6 +100,6 @@ class AdamW:
         new_p = jax.tree.map(
             lambda p, mu, nu: p - self.learning_rate * (
                 (mu / bc1) / (jnp.sqrt(nu / bc2) + self.eps)
-                + self.weight_decay * p),
+                + (self.weight_decay * p if p.ndim >= 2 else 0.0)),
             params, new_mu, new_nu)
         return new_p, {"mu": new_mu, "nu": new_nu, "count": count}

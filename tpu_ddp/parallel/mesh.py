@@ -6,8 +6,10 @@ Data-parallel replicas are mesh slots; the batch is sharded over ``dp`` and
 parameters are replicated — XLA then lowers the gradient ``psum`` onto ICI
 (intra-slice) / DCN (cross-slice) automatically (SURVEY.md §2 row N1).
 
-A second, size-1-by-default ``mp`` axis is kept in the mesh shape so tensor/
-pipeline extensions can widen the mesh without touching callers.
+The mesh is always (``dp``, ``sp``, ``mp``): ``sp`` shards the sequence
+axis for ring attention (size 1 for the DP-only ladder) and ``mp`` is a
+size-1-by-default placeholder so tensor/pipeline extensions can widen the
+mesh without touching callers.
 """
 
 from __future__ import annotations

@@ -119,6 +119,14 @@ def full_attention(q, k, v, causal: bool = False):
 def attend(q, k, v, *, causal: bool = False, axis_name: str | None = None,
            axis_size: int | None = None):
     """Dispatch: ring attention when a sequence axis is given, else full."""
-    if axis_name is not None and axis_size is not None and axis_size > 1:
-        return ring_attention(q, k, v, axis_name, axis_size, causal=causal)
+    if axis_name is not None:
+        if axis_size is None:
+            # Falling back to full_attention here would silently compute
+            # block-LOCAL attention on each shard — wrong logits, no error.
+            raise ValueError(
+                "attend: axis_name given without axis_size; pass the sp "
+                "mesh extent (loop bounds must be static under jit)")
+        if axis_size > 1:
+            return ring_attention(q, k, v, axis_name, axis_size,
+                                  causal=causal)
     return full_attention(q, k, v, causal=causal)

@@ -30,6 +30,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_ddp.ops.loss import softmax_cross_entropy
 from tpu_ddp.ops.optim import AdamW
 from tpu_ddp.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
@@ -71,9 +72,8 @@ class LMTrainer:
     def _base_step(self, params, opt_state, inputs, targets):
         def loss_fn(p):
             logits = self.model.apply(p, inputs)        # (B, Lc, V) f32
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(
-                logp, targets[..., None], axis=-1)[..., 0]
+            nll = softmax_cross_entropy(
+                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1))
             local_sum = jnp.sum(nll)
             local_n = jnp.float32(nll.size)
             total = lax.psum(local_n, (DATA_AXIS, SEQ_AXIS))
