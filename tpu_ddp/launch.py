@@ -176,8 +176,13 @@ def launch(
                 for rank, proc in enumerate(procs):
                     if rank not in rcs:
                         proc.kill()
-                        proc.wait()
-                        rcs[rank] = -9
+                        # A rank may have exited with a real code between
+                        # the last poll and this sweep — keep that code as
+                        # the root cause rather than recording our kill.
+                        rc = proc.wait()
+                        rcs[rank] = rc if rc not in (None, 0) else -9
+                        if rcs[rank] != -9:
+                            first_failure = first_failure or rcs[rank]
                 first_failure = first_failure or -9
                 break
             time.sleep(0.05)

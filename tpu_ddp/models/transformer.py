@@ -13,6 +13,14 @@ Sequence parallelism: ``apply`` takes the LOCAL sequence chunk. When
 are offset by the chunk's global start — so the model computes EXACTLY the
 same function as the single-device configuration (tested in
 tests/test_ring_attention.py).
+
+Tensor parallelism: when ``tp_axis``/``tp_size`` are configured, each
+block's parameters arrive as mp-shards (attention heads and the MLP hidden
+axis split over ``tp_size`` — :meth:`TransformerLM.param_specs` is the
+authoritative layout) and the block computes with the Megatron column/row
+sandwich (tpu_ddp/parallel/tensor_parallel.py): two ``psum``s per block,
+everything else replicated. Composes with sequence parallelism — ring
+attention rotates K/V over ``sp`` within each head shard.
 """
 
 from __future__ import annotations
@@ -24,7 +32,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from jax.sharding import PartitionSpec as P
+
 from tpu_ddp.parallel.ring_attention import attend
+from tpu_ddp.parallel.tensor_parallel import tp_input, tp_output
 
 
 def _normal(key, shape, std, dtype):
@@ -69,15 +80,30 @@ class TransformerLM:
     # Sequence parallelism: mesh axis name/extent the LOCAL chunk lives on.
     sp_axis: str | None = None
     sp_size: int = 1
+    # Tensor parallelism: mesh axis name/extent block params are sharded on.
+    tp_axis: str | None = None
+    tp_size: int = 1
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.num_heads
 
+    @property
+    def _tp(self) -> int:
+        return self.tp_size if self.tp_axis is not None else 1
+
     # ---- parameters ----------------------------------------------------
 
     def init(self, key) -> dict:
+        """GLOBAL parameter pytree (sharding is the trainer's job).
+
+        Layouts are chosen so tensor-parallel sharding is a clean axis
+        split (:meth:`param_specs`): ``wqkv`` is (dm, 3, heads, head_dim)
+        and ``wo`` is (heads, head_dim, dm) — the head axis shards over
+        ``tp``; ``w1``/``w2`` shard on the ``d_ff`` axis.
+        """
         dm, dff, v = self.d_model, self.d_ff, self.vocab_size
+        h, hd = self.num_heads, self.head_dim
         std = 0.02
         keys = iter(jax.random.split(key, 4 + 8 * self.num_layers))
         params = {
@@ -91,9 +117,10 @@ class TransformerLM:
             blocks.append({
                 "ln1": {"scale": jnp.ones((dm,), self.param_dtype),
                         "bias": jnp.zeros((dm,), self.param_dtype)},
-                "wqkv": _normal(next(keys), (dm, 3 * dm), std,
+                "wqkv": _normal(next(keys), (dm, 3, h, hd), std,
                                 self.param_dtype),
-                "wo": _normal(next(keys), (dm, dm), std, self.param_dtype),
+                "wo": _normal(next(keys), (h, hd, dm), std,
+                              self.param_dtype),
                 "ln2": {"scale": jnp.ones((dm,), self.param_dtype),
                         "bias": jnp.zeros((dm,), self.param_dtype)},
                 "w1": _normal(next(keys), (dm, dff), std, self.param_dtype),
@@ -101,6 +128,31 @@ class TransformerLM:
             })
         params["blocks"] = tuple(blocks)
         return params
+
+    def param_specs(self) -> dict:
+        """Pytree of ``PartitionSpec``s mirroring :meth:`init`'s tree.
+
+        The authoritative tensor-parallel layout: attention head axis and
+        MLP hidden axis shard over ``tp_axis``; everything else (LayerNorm,
+        embeddings, LM head) is replicated. With ``tp_size == 1`` every
+        leaf is fully replicated.
+        """
+        tp = self.tp_axis if self._tp > 1 else None
+        ln = {"scale": P(), "bias": P()}
+        blk = {
+            "ln1": dict(ln),
+            "wqkv": P(None, None, tp, None),
+            "wo": P(tp, None, None),
+            "ln2": dict(ln),
+            "w1": P(None, tp),
+            "w2": P(tp, None),
+        }
+        return {
+            "embed": P(),
+            "ln_f": dict(ln),
+            "head": P(),
+            "blocks": tuple(dict(blk) for _ in range(self.num_layers)),
+        }
 
     # ---- forward -------------------------------------------------------
 
@@ -112,37 +164,65 @@ class TransformerLM:
             start = 0
         return start + jnp.arange(lc)
 
+    def _tp_in(self, x):
+        """Megatron ``f`` before a column-parallel matmul (no-op sans tp).
+
+        Sits AFTER LayerNorm so the psum'd backward makes LN/embedding/
+        residual gradients exact and replicated on every tp shard."""
+        if self._tp > 1:
+            return tp_input(x, self.tp_axis)
+        return x
+
+    def _tp_out(self, x):
+        """Megatron ``g`` after a row-parallel matmul (no-op sans tp)."""
+        if self._tp > 1:
+            return tp_output(x, self.tp_axis)
+        return x
+
     def apply(self, params, tokens):
-        """tokens: (B, L_local) int32 -> logits (B, L_local, V) float32."""
+        """tokens: (B, L_local) int32 -> logits (B, L_local, V) float32.
+
+        Under tensor parallelism ``params`` holds this shard's slices
+        (heads and d_ff split ``tp_size``-ways, :meth:`param_specs`); the
+        residual stream stays replicated, with one ``psum`` after each of
+        the two row-parallel projections.
+        """
         cd = self.compute_dtype
         b, lc = tokens.shape
         if lc * self.sp_size > self.max_seq_len:
             raise ValueError(
                 f"global sequence length {lc * self.sp_size} (local {lc} x "
                 f"sp {self.sp_size}) exceeds max_seq_len={self.max_seq_len}")
-        h, hd = self.num_heads, self.head_dim
+        h_loc, hd = self.num_heads // self._tp, self.head_dim
         pos = self._positions(lc)
         x = params["embed"][tokens].astype(cd)          # (B, L, dm)
         for blk in params["blocks"]:
             y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
-            qkv = jnp.dot(y, blk["wqkv"].astype(cd),
+            # Column-parallel QKV: local heads only, zero communication.
+            wqkv = blk["wqkv"].astype(cd).reshape(self.d_model, -1)
+            qkv = jnp.dot(self._tp_in(y), wqkv,
                           preferred_element_type=jnp.float32)
-            q, k, v = jnp.split(qkv.astype(cd), 3, axis=-1)
-            q = rope(q.reshape(b, lc, h, hd), pos)
-            k = rope(k.reshape(b, lc, h, hd), pos)
-            v = v.reshape(b, lc, h, hd)
+            qkv = qkv.astype(cd).reshape(b, lc, 3, h_loc, hd)
+            q = rope(qkv[:, :, 0], pos)
+            k = rope(qkv[:, :, 1], pos)
+            v = qkv[:, :, 2]
             o = attend(q, k, v, causal=True, axis_name=self.sp_axis,
                        axis_size=self.sp_size)
-            o = jnp.dot(o.reshape(b, lc, self.d_model),
-                        blk["wo"].astype(cd),
-                        preferred_element_type=jnp.float32).astype(cd)
+            # Row-parallel output projection: partial sums psum'd over tp.
+            wo = blk["wo"].astype(cd).reshape(h_loc * hd, self.d_model)
+            o = self._tp_out(jnp.dot(
+                o.reshape(b, lc, h_loc * hd), wo,
+                preferred_element_type=jnp.float32)).astype(cd)
             x = x + o
             y = layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
-            y = jnp.dot(y, blk["w1"].astype(cd),
+            # Column-parallel up-projection (local d_ff slice) ...
+            y = jnp.dot(self._tp_in(y), blk["w1"].astype(cd),
                         preferred_element_type=jnp.float32)
             y = jax.nn.gelu(y.astype(jnp.float32)).astype(cd)
-            y = jnp.dot(y, blk["w2"].astype(cd),
-                        preferred_element_type=jnp.float32).astype(cd)
+            # ... row-parallel down-projection, psum'd.
+            y = self._tp_out(jnp.dot(
+                y, blk["w2"].astype(cd),
+                preferred_element_type=jnp.float32)).astype(cd)
             x = x + y
         x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
         logits = jnp.dot(x, params["head"].astype(cd),
@@ -158,6 +238,17 @@ class TransformerLM:
                                axis_size: int) -> "TransformerLM":
         return dataclasses.replace(self, sp_axis=axis_name,
                                    sp_size=axis_size)
+
+    def with_tensor_parallel(self, axis_name: str,
+                             axis_size: int) -> "TransformerLM":
+        if self.num_heads % axis_size:
+            raise ValueError(f"num_heads={self.num_heads} not divisible by "
+                             f"tp={axis_size}")
+        if self.d_ff % axis_size:
+            raise ValueError(f"d_ff={self.d_ff} not divisible by "
+                             f"tp={axis_size}")
+        return dataclasses.replace(self, tp_axis=axis_name,
+                                   tp_size=axis_size)
 
 
 def make_transformer(name: str = "TransformerLM-small",

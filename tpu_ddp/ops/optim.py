@@ -19,6 +19,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec
 
 
 SGDState = dict  # {"momentum": pytree like params}
@@ -35,6 +36,11 @@ class SGD:
 
     def init(self, params) -> SGDState:
         return {"momentum": jax.tree.map(jnp.zeros_like, params)}
+
+    def state_specs(self, param_specs):
+        """Optimizer-state PartitionSpec tree mirroring ``param_specs`` —
+        momentum lives in the same sharding as its parameter."""
+        return {"momentum": param_specs}
 
     def _new_buf(self, p, g, buf):
         g = g.astype(p.dtype)
@@ -82,6 +88,12 @@ class AdamW:
         zeros = lambda: jax.tree.map(jnp.zeros_like, params)  # noqa: E731
         return {"mu": zeros(), "nu": zeros(),
                 "count": jnp.zeros((), jnp.int32)}
+
+    def state_specs(self, param_specs):
+        """Optimizer-state PartitionSpec tree mirroring ``param_specs`` —
+        moments live in the same sharding as their parameter."""
+        return {"mu": param_specs, "nu": param_specs,
+                "count": PartitionSpec()}
 
     def apply(self, params, grads, state):
         count = state["count"] + 1

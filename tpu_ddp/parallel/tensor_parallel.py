@@ -1,0 +1,89 @@
+"""Tensor (model) parallelism primitives — Megatron-style sharded matmuls.
+
+No reference counterpart (the reference implements data parallelism only —
+SURVEY.md §2 "Absent parallelism strategies"); this module exists because
+multi-axis model sharding is first-class in this framework. The scheme is
+the classic column/row-parallel pair (Shoeybi et al., "Megatron-LM",
+arXiv:1909.08053 — reimplemented from the paper's algebra, not from any
+code), expressed the shard_map way:
+
+- **column-parallel** matmul ``y @ W_col``: ``W`` is sharded on its OUTPUT
+  axis over the ``mp`` mesh axis; each device computes its slice of the
+  output with no communication. Its input must carry the ``f`` operator
+  (:func:`tp_input`): identity in the forward pass, gradient ``psum`` in
+  the backward pass — because each shard back-propagates only its slice's
+  contribution to ``dy``, the true ``dy`` is the sum over shards.
+- **row-parallel** matmul ``h @ W_row``: ``W`` is sharded on its INPUT
+  axis; each device computes a partial sum of the full output, combined
+  with an explicit ``lax.psum`` (:func:`tp_output`) — the ``g`` operator.
+  Its backward is the free part: the psum's transpose is a broadcast.
+
+One transformer block therefore costs exactly two ``psum``s (after the
+attention output projection and after the MLP down-projection), which XLA
+lowers onto ICI and overlaps with neighbouring compute. Everything outside
+the column→row sandwiches (LayerNorm, residual stream, embeddings, LM
+head) stays replicated over ``mp``, and because ``tp_input`` sits between
+the LayerNorm and the column matmul, gradients of those replicated
+parameters come out identical on every ``mp`` shard — the replication
+invariant the optimizer relies on (tested in tests/test_tensor_parallel.py
+by numerically comparing a TP step against a dense step; psum reduction
+order makes bitwise equality unattainable).
+"""
+
+from __future__ import annotations
+
+import functools
+
+from jax import custom_vjp, lax
+
+from tpu_ddp.parallel.mesh import MODEL_AXIS
+
+
+@functools.partial(custom_vjp, nondiff_argnums=(1,))
+def tp_input(x, axis_name: str = MODEL_AXIS):
+    """Megatron's ``f``: identity forward, gradient all-reduce backward.
+
+    Place immediately before a column-parallel matmul. The forward input is
+    replicated over ``axis_name``; each shard's backward contributes only
+    its output-slice's term of the input gradient, so the transpose sums
+    them — making every gradient upstream of this point (LayerNorm scales,
+    embeddings, the residual stream) exact and replicated.
+    """
+    return x
+
+
+def _tp_input_fwd(x, axis_name):
+    return x, None
+
+
+def _tp_input_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+tp_input.defvjp(_tp_input_fwd, _tp_input_bwd)
+
+
+@functools.partial(custom_vjp, nondiff_argnums=(1,))
+def tp_output(x, axis_name: str = MODEL_AXIS):
+    """Megatron's ``g``: all-reduce the row-parallel partial sums.
+
+    Place immediately after a row-parallel matmul. The backward is the
+    identity — the output (and hence its cotangent) is replicated over
+    ``axis_name``, and each shard's partial-sum input receives exactly
+    that cotangent. Spelled as a custom_vjp because under
+    ``check_vma=False`` shard_map cannot see the replication and would
+    transpose a bare ``lax.psum`` into another ``psum``, inflating every
+    gradient that flows through the block branch by the axis size.
+    """
+    return lax.psum(x, axis_name)
+
+
+def _tp_output_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _tp_output_bwd(axis_name, _, g):
+    return (g,)
+
+
+tp_output.defvjp(_tp_output_fwd, _tp_output_bwd)

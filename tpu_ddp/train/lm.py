@@ -1,19 +1,24 @@
-"""Language-model training engine: data parallel × sequence parallel.
+"""Language-model training engine: data x sequence x tensor parallel.
 
 No reference counterpart (the reference trains VGG on CIFAR with DP only,
-SURVEY.md §2/§5) — this engine exists because long-context training is
-first-class here. One jitted ``shard_map`` step over a (dp, sp) mesh:
+SURVEY.md §2/§5) — this engine exists because long-context and model-
+sharded training are first-class here. One jitted ``shard_map`` step over
+a (dp, sp, mp) mesh:
 
 - token/target batches (B, L) are sharded batch-over-``dp`` AND
-  sequence-over-``sp``;
+  sequence-over-``sp`` (replicated over ``mp``);
 - attention inside the model runs as ring attention over ``sp``
   (tpu_ddp/parallel/ring_attention.py) so each device only ever holds its
   L/sp chunk;
+- block parameters shard over ``mp`` per the model's ``param_specs()``
+  (Megatron column/row layout, tpu_ddp/parallel/tensor_parallel.py);
+  LayerNorms/embeddings/head and the optimizer moments of every leaf live
+  in the SAME sharding as the leaf;
 - the loss is the global per-token mean: local weighted sums are
-  ``psum``'d over BOTH axes;
-- gradients are ``pmean``'d over (dp, sp) — params/optimizer state are
-  replicated everywhere, exactly like the DP ladder's "fused" strategy
-  (part3-equivalent) generalized to two axes.
+  ``psum``'d over (dp, sp) — the ``mp`` shards compute it redundantly;
+- gradients are ``pmean``'d over (dp, sp): tp-sharded leaves sync their
+  own slice, replicated leaves are already identical across ``mp`` by the
+  tensor-parallel backward construction.
 
 Next-token shift happens on host (``make_lm_batch``): inputs = tokens[:-1],
 targets = tokens[1:], so no cross-chunk halo exchange is needed.
@@ -32,7 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_ddp.ops.loss import softmax_cross_entropy
 from tpu_ddp.ops.optim import AdamW
-from tpu_ddp.parallel.mesh import DATA_AXIS, SEQ_AXIS
+from tpu_ddp.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 
 @dataclasses.dataclass
@@ -48,25 +53,42 @@ def make_lm_batch(tokens: np.ndarray):
     return tokens[:, :-1], tokens[:, 1:]
 
 
+def _is_spec(x):
+    return isinstance(x, P)
+
+
 class LMTrainer:
-    """Wires a TransformerLM + AdamW into a dp x sp sharded train step."""
+    """Wires a TransformerLM + AdamW into a dp x sp x tp sharded step."""
 
     def __init__(self, model, mesh: Mesh, optimizer: AdamW | None = None):
         self.mesh = mesh
         self.dp = mesh.shape[DATA_AXIS]
         self.sp = mesh.shape[SEQ_AXIS]
-        self.model = model.with_sequence_parallel(SEQ_AXIS, self.sp) \
-            if self.sp > 1 else model
+        self.tp = mesh.shape.get(MODEL_AXIS, 1)
+        if self.sp > 1:
+            model = model.with_sequence_parallel(SEQ_AXIS, self.sp)
+        if self.tp > 1:
+            model = model.with_tensor_parallel(MODEL_AXIS, self.tp)
+        self.model = model
         self.optimizer = optimizer or AdamW()
+        self._param_specs = self.model.param_specs()
+        self._opt_specs = self.optimizer.state_specs(self._param_specs)
         self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
-        self._repl_sharding = NamedSharding(mesh, P())
+        self._param_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), self._param_specs,
+            is_leaf=_is_spec)
+        self._opt_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), self._opt_specs,
+            is_leaf=_is_spec)
         self._train_step = self._build_train_step()
 
     def init_state(self, seed: int = 0) -> LMTrainState:
+        """Init GLOBAL params from the seed, then place every leaf in its
+        spec's sharding (tp leaves split over ``mp``, rest replicated)."""
         params = self.model.init(jax.random.key(seed))
         opt_state = self.optimizer.init(params)
-        params = jax.device_put(params, self._repl_sharding)
-        opt_state = jax.device_put(opt_state, self._repl_sharding)
+        params = jax.device_put(params, self._param_shardings)
+        opt_state = jax.device_put(opt_state, self._opt_shardings)
         return LMTrainState(params=params, opt_state=opt_state)
 
     def _base_step(self, params, opt_state, inputs, targets):
@@ -79,10 +101,14 @@ class LMTrainer:
             total = lax.psum(local_n, (DATA_AXIS, SEQ_AXIS))
             n_shards = lax.psum(1.0, (DATA_AXIS, SEQ_AXIS))
             # Scale so pmean-of-grads == grad of the GLOBAL token mean.
+            # mp shards hold the same tokens and compute the same loss.
             loss_for_grad = n_shards * local_sum / total
             return loss_for_grad, local_sum / local_n
         (_, local_mean), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
+        # Sync over the data axes only: each mp shard owns its tp slice
+        # (replicated leaves' grads are identical across mp by the
+        # tensor-parallel backward construction — tensor_parallel.tp_input).
         grads = lax.pmean(grads, (DATA_AXIS, SEQ_AXIS))
         params, opt_state = self.optimizer.apply(params, grads, opt_state)
         # (1, 1) per shard -> (dp, sp) global: every shard's own chunk mean.
@@ -92,9 +118,10 @@ class LMTrainer:
         mapped = jax.shard_map(
             self._base_step,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(DATA_AXIS, SEQ_AXIS),
-                      P(DATA_AXIS, SEQ_AXIS)),
-            out_specs=(P(), P(), P(DATA_AXIS, SEQ_AXIS)),
+            in_specs=(self._param_specs, self._opt_specs,
+                      P(DATA_AXIS, SEQ_AXIS), P(DATA_AXIS, SEQ_AXIS)),
+            out_specs=(self._param_specs, self._opt_specs,
+                       P(DATA_AXIS, SEQ_AXIS)),
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
