@@ -1,0 +1,23 @@
+"""Part 4 — ZeRO-1 sharded optimizer: the rung ABOVE the reference ladder.
+
+The reference stops at framework DDP (reference part3/main.py:13,174),
+with optimizer state fully replicated on every worker. Part 4 splits the
+gradient all-reduce into reduce_scatter + all_gather and shards the
+optimizer state 1/N per data-parallel worker (tpu_ddp/parallel/zero.py):
+same bytes on the ICI wire per step as part3, 1/N the optimizer memory
+and update FLOPs per device.
+
+Launch (per node):
+  python parts/part4/main.py --num-nodes N [--rank R --master-ip IP --master-port P]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from common import run_part  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(run_part("part4"))

@@ -58,6 +58,20 @@ def test_two_process_part3_fused():
         assert "strategy=fused" in res.output_of(rank)
 
 
+@pytest.mark.slow
+def test_two_process_part4_zero():
+    """ZeRO rung across REAL process boundaries: the reduce_scatter +
+    all_gather pair and the dp-sharded optimizer state span two
+    jax.distributed processes; synchronized params -> identical eval."""
+    res = launch("part4", nproc=2, env=SMOKE_ENV, echo=False, timeout=600)
+    assert res.ok, "\n".join(w.output for w in res.workers)
+    for rank in (0, 1):
+        assert "strategy=zero" in res.output_of(rank)
+    line0 = [l for l in res.output_of(0).splitlines() if "Test set" in l]
+    line1 = [l for l in res.output_of(1).splitlines() if "Test set" in l]
+    assert line0 == line1
+
+
 def test_failed_rank_fails_launch_fast():
     # Out-of-range rank -> bootstrap ValueError before rendezvous. The
     # launch must report failure (not mask it behind a clean rank) and

@@ -33,7 +33,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 PARTS_DIR = Path(__file__).resolve().parent.parent / "parts"
-PARTS = ("part1", "part2a", "part2b", "part3")
+PARTS = ("part1", "part2a", "part2b", "part3", "part4")
 
 
 def find_free_port() -> int:
@@ -177,12 +177,13 @@ def launch(
                     if rank not in rcs:
                         proc.kill()
                         # A rank may have exited with a real code between
-                        # the last poll and this sweep — keep that code as
-                        # the root cause rather than recording our kill.
+                        # the last poll and this sweep — keep that code
+                        # (even 0) rather than recording our kill; the
+                        # launch is still marked failed below.
                         rc = proc.wait()
-                        rcs[rank] = rc if rc not in (None, 0) else -9
-                        if rcs[rank] != -9:
-                            first_failure = first_failure or rcs[rank]
+                        rcs[rank] = -9 if rc < 0 else rc
+                        if rc > 0:
+                            first_failure = first_failure or rc
                 first_failure = first_failure or -9
                 break
             time.sleep(0.05)

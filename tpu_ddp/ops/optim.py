@@ -48,8 +48,15 @@ class SGD:
             g = g + self.weight_decay * p
         return self.momentum * buf + g
 
-    def apply(self, params, grads, state: SGDState):
-        """One update; returns (new_params, new_state)."""
+    def apply(self, params, grads, state: SGDState, decay_mask=None):
+        """One update; returns (new_params, new_state).
+
+        ``decay_mask`` is accepted for optimizer-API uniformity (ZeRO
+        passes one) and ignored: torch SGD decays every parameter
+        uniformly (reference part1/main.py:124-125), so flattened slices
+        update identically to the original leaves.
+        """
+        del decay_mask
         if self.use_pallas:
             from tpu_ddp.ops.pallas import fused_sgd_step
             new_params, new_buf = fused_sgd_step(
@@ -95,11 +102,16 @@ class AdamW:
         return {"mu": param_specs, "nu": param_specs,
                 "count": PartitionSpec()}
 
-    def apply(self, params, grads, state):
+    def apply(self, params, grads, state, decay_mask=None):
+        """``decay_mask``: optional bool pytree overriding the ndim>=2
+        rule per leaf — ZeRO passes the ORIGINAL leaves' ranks since its
+        flattened slices are all rank-1."""
         count = state["count"] + 1
         c = count.astype(jnp.float32)
         bc1 = 1.0 - self.b1 ** c
         bc2 = 1.0 - self.b2 ** c
+        if decay_mask is None:
+            decay_mask = jax.tree.map(lambda p: p.ndim >= 2, params)
         # Separate tree.maps per output (the SGD style above): structure-
         # safe for any params pytree, and XLA CSEs the shared subterms.
         new_mu = jax.tree.map(
@@ -110,8 +122,8 @@ class AdamW:
             + (1 - self.b2) * jnp.square(g.astype(p.dtype)),
             params, grads, state["nu"])
         new_p = jax.tree.map(
-            lambda p, mu, nu: p - self.learning_rate * (
+            lambda p, mu, nu, dk: p - self.learning_rate * (
                 (mu / bc1) / (jnp.sqrt(nu / bc2) + self.eps)
-                + (self.weight_decay * p if p.ndim >= 2 else 0.0)),
-            params, new_mu, new_nu)
+                + (self.weight_decay * p if dk else 0.0)),
+            params, new_mu, new_nu, decay_mask)
         return new_p, {"mu": new_mu, "nu": new_nu, "count": count}

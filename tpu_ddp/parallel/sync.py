@@ -96,19 +96,32 @@ SYNC_STRATEGIES = {
     "gather_scatter": sync_gather_scatter,
     "all_reduce": sync_all_reduce,
     "fused": sync_fused,
+    # ZeRO's reduce_scatter IS the sync; grads enter the optimizer
+    # unsynced and the engine wraps the optimizer in ZeRO1
+    # (tpu_ddp/parallel/zero.py), so the grads->grads hook is identity.
+    "zero": sync_none,
 }
 
-# The reference parts, by name.
+# The reference parts, by name. "part4" extends the ladder beyond the
+# reference: ZeRO-1 sharded optimizer (tpu_ddp/parallel/zero.py) — the
+# sync is a reduce_scatter + all_gather pair folded into the optimizer,
+# so it is not a (grads -> grads) strategy and the engine special-cases it.
 PART_TO_STRATEGY = {
     "part1": "none",
     "part2a": "gather_scatter",
     "part2b": "all_reduce",
     "part3": "fused",
+    "part4": "zero",
 }
 
 
+def canonical_strategy(name: str) -> str:
+    """Resolve a part alias ('part4') to its strategy name ('zero')."""
+    return PART_TO_STRATEGY.get(name, name)
+
+
 def get_sync_strategy(name: str):
-    key = PART_TO_STRATEGY.get(name, name)
+    key = canonical_strategy(name)
     try:
         return SYNC_STRATEGIES[key]
     except KeyError:

@@ -1,0 +1,112 @@
+"""ZeRO-1 sharded optimizer — the fifth rung of the DP ladder.
+
+No reference counterpart: the reference ladder stops at framework DDP
+(part3, reference part3/main.py:13,174), where parameters, gradients and
+optimizer state are fully replicated on every worker. This rung goes one
+step beyond (Rajbhandari et al., "ZeRO: Memory Optimizations Toward
+Training Trillion Parameter Models", arXiv:1910.02054 — reimplemented from
+the paper's stage-1 partitioning scheme, not from any code): optimizer
+state is sharded 1/N per data-parallel worker, and the gradient all-reduce
+is split into its two halves —
+
+    all_reduce == reduce_scatter + all_gather
+
+- ``reduce_scatter`` (``lax.psum_scatter`` over the ``dp`` axis) hands each
+  worker the SUM of one 1/N slice of every gradient — half the comm volume
+  of an all-reduce, and the only slice this worker needs;
+- each worker runs the (elementwise) optimizer update on its slice only —
+  1/N of the update FLOPs and 1/N of the optimizer-state memory;
+- ``all_gather`` (tiled) reassembles the updated parameters on every
+  worker.
+
+Total bytes on the wire per step equal part3's all-reduce (XLA lowers both
+halves onto ICI), so throughput matches the fused strategy while optimizer
+memory drops from O(P) to O(P/N) per device — the property that matters
+once P stops fitting in HBM. Numerical equivalence with the fused rung is
+tested in tests/test_zero.py.
+
+Leaves are flattened and zero-padded to a multiple of the axis size so
+every worker owns an equal contiguous slice; the padding tail receives
+zero gradients and never leaves the pad region (elementwise update of a
+zero-init, zero-grad slice stays zero under SGD/AdamW's decay-free tail).
+Because flattening erases leaf ranks, the wrapper computes AdamW's
+weight-decay mask from the ORIGINAL leaf shapes and passes it through
+(``decay_mask`` in tpu_ddp/ops/optim.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_ddp.parallel.mesh import DATA_AXIS
+
+
+class ZeRO1:
+    """Wrap an elementwise optimizer; shard its state over ``axis_name``.
+
+    ``init``/``state_specs`` run OUTSIDE shard_map (global view: every
+    state leaf is a flat (padded_size,) array, sharded over the axis);
+    ``apply`` runs INSIDE the shard_map'd train step on UNSYNCED local
+    gradients — the reduce-scatter it performs IS the gradient sync.
+    """
+
+    def __init__(self, inner, axis_name: str = DATA_AXIS,
+                 axis_size: int | None = None):
+        if axis_size is None or axis_size < 1:
+            raise ValueError("ZeRO1 needs the static dp axis size")
+        self.inner = inner
+        self.axis_name = axis_name
+        self.axis_size = axis_size
+
+    def _chunk(self, size: int) -> int:
+        return -(-size // self.axis_size)  # ceil div
+
+    def init(self, params):
+        """Global flat state: inner state over (padded_size,) zero leaves."""
+        flat = jax.tree.map(
+            lambda p: jnp.zeros((self._chunk(p.size) * self.axis_size,),
+                                p.dtype), params)
+        return self.inner.init(flat)
+
+    def state_specs(self, param_specs=None):
+        """Every (flat) state leaf shards over the dp axis; scalars (e.g.
+        AdamW's step count) stay replicated — the inner optimizer's
+        state_specs decides which is which."""
+        return self.inner.state_specs(P(self.axis_name))
+
+    def apply(self, params, grads, opt_state):
+        """One sharded step. Call inside shard_map over ``axis_name`` with
+        ``grads`` UNSYNCED; returns (new_params, new_state) with params
+        full-size and synchronized (identical on every worker)."""
+        ax, n = self.axis_name, self.axis_size
+        idx = lax.axis_index(ax)
+
+        def grad_slice(g):
+            chunk = self._chunk(g.size)
+            flat = jnp.pad(g.reshape(-1), (0, chunk * n - g.size))
+            # SUM of this slice across workers, then mean over replicas —
+            # the ladder's all_reduce semantics, half delivered here, half
+            # by the all_gather below.
+            return lax.psum_scatter(flat.reshape(n, chunk), ax,
+                                    scatter_dimension=0) / n
+
+        def param_slice(p):
+            chunk = self._chunk(p.size)
+            flat = jnp.pad(p.reshape(-1), (0, chunk * n - p.size))
+            return lax.dynamic_slice_in_dim(flat, idx * chunk, chunk)
+
+        g_sh = jax.tree.map(grad_slice, grads)
+        p_sh = jax.tree.map(param_slice, params)
+        # Decay policy must see the ORIGINAL ranks, not the flat slices.
+        mask = jax.tree.map(lambda p: p.ndim >= 2, params)
+        new_p_sh, new_state = self.inner.apply(p_sh, g_sh, opt_state,
+                                               decay_mask=mask)
+
+        def reassemble(p, sh):
+            full = lax.all_gather(sh.astype(p.dtype), ax, tiled=True)
+            return full[:p.size].reshape(p.shape)
+
+        return jax.tree.map(reassemble, params, new_p_sh), new_state

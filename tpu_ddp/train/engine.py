@@ -32,7 +32,7 @@ from tpu_ddp.ops.loss import cross_entropy_loss, softmax_cross_entropy
 from tpu_ddp.ops.metrics import top1_correct
 from tpu_ddp.ops.optim import SGD
 from tpu_ddp.parallel.mesh import DATA_AXIS
-from tpu_ddp.parallel.sync import get_sync_strategy
+from tpu_ddp.parallel.sync import canonical_strategy, get_sync_strategy
 from tpu_ddp.utils.config import TrainConfig
 from tpu_ddp.utils.metrics import MetricsLogger
 from tpu_ddp.utils.timing import IterationTimer
@@ -67,12 +67,20 @@ class Trainer:
         self.strategy_name = strategy
         self.sync_fn = get_sync_strategy(strategy)
         self.mesh = mesh
+        self.is_zero = canonical_strategy(strategy) == "zero"
         self.optimizer = SGD(
             learning_rate=self.config.learning_rate,
             momentum=self.config.momentum,
             weight_decay=self.config.weight_decay,
             use_pallas=self.config.pallas_sgd,
         )
+        if self.is_zero:
+            if mesh is None:
+                raise ValueError("strategy 'zero' shards optimizer state "
+                                 "over the dp axis and requires a mesh")
+            from tpu_ddp.parallel.zero import ZeRO1
+            self.optimizer = ZeRO1(self.optimizer, DATA_AXIS,
+                                   mesh.shape[DATA_AXIS])
         if mesh is not None:
             self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
             self._repl_sharding = NamedSharding(mesh, P())
@@ -80,6 +88,19 @@ class Trainer:
         self._eval_step = jax.jit(self._eval_step_impl)
 
     # ---- state ---------------------------------------------------------
+
+    def _opt_spec(self):
+        """shard_map prefix spec for the optimizer state: replicated for
+        the replicated strategies, dp-sharded flat leaves under ZeRO."""
+        return self.optimizer.state_specs(P())
+
+    def _opt_shardings(self, opt_state):
+        """Broadcast the prefix spec over the concrete state tree."""
+        return jax.tree.map(
+            lambda spec, sub: jax.tree.map(
+                lambda _: NamedSharding(self.mesh, spec), sub),
+            self._opt_spec(), opt_state,
+            is_leaf=lambda x: isinstance(x, P))
 
     def init_state(self, seed: int | None = None) -> TrainState:
         """Parameter init from the shared seed — correctness invariant (i)
@@ -90,7 +111,8 @@ class Trainer:
         opt_state = self.optimizer.init(params)
         if self.mesh is not None:
             params = jax.device_put(params, self._repl_sharding)
-            opt_state = jax.device_put(opt_state, self._repl_sharding)
+            opt_state = jax.device_put(opt_state,
+                                       self._opt_shardings(opt_state))
         return TrainState(params=params, opt_state=opt_state)
 
     # ---- checkpoint / resume (no reference equivalent, SURVEY.md §5) ---
@@ -99,10 +121,17 @@ class Trainer:
                         keep_last: int | None = None) -> str | None:
         """Write ``state`` at its step; only process 0 writes (state under
         DP is replicated). Returns the path (None on non-zero processes)."""
+        opt_state = state.opt_state
+        if self.mesh is not None and self.is_zero:
+            # ZeRO shards the optimizer state over dp; gather it to a
+            # replicated layout BEFORE the process-0 gate — the gather is
+            # a collective every process must enter.
+            opt_state = jax.jit(
+                lambda t: t, out_shardings=self._repl_sharding)(opt_state)
         if jax.process_index() != 0:
             return None
         from tpu_ddp.utils import checkpoint as ckpt
-        tree = {"params": state.params, "opt_state": state.opt_state,
+        tree = {"params": state.params, "opt_state": opt_state,
                 "step": np.int64(state.step)}
         return ckpt.save_checkpoint(directory, tree, step=state.step,
                                     keep_last=keep_last)
@@ -121,7 +150,8 @@ class Trainer:
         params, opt_state = restored["params"], restored["opt_state"]
         if self.mesh is not None:
             params = jax.device_put(params, self._repl_sharding)
-            opt_state = jax.device_put(opt_state, self._repl_sharding)
+            opt_state = jax.device_put(opt_state,
+                                       self._opt_shardings(opt_state))
         return TrainState(params=params, opt_state=opt_state,
                           step=int(restored["step"]))
 
@@ -174,6 +204,8 @@ class Trainer:
             return loss_for_grad, local_mean
 
         (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        # Under ZeRO sync_fn is the identity: the optimizer's own
+        # reduce_scatter + all_gather pair performs the synchronization.
         grads = self.sync_fn(grads, DATA_AXIS) if self.mesh is not None \
             else self.sync_fn(grads)
         params, opt_state = self.optimizer.apply(params, grads, opt_state)
@@ -191,11 +223,13 @@ class Trainer:
             # reference (every node prints locally, part2b/main.py:134-139).
             return params, opt_state, loss.reshape(1)
 
+        opt_spec = self._opt_spec()
         mapped = jax.shard_map(
             sharded_body,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(), P(), P(DATA_AXIS)),
+            in_specs=(P(), opt_spec, P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=(P(), opt_spec, P(DATA_AXIS)),
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
