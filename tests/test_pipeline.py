@@ -1,0 +1,115 @@
+"""Pipeline parallelism: the staged model computes EXACTLY the same
+function — loss, gradients, one full optimizer step — as the dense model,
+alone and composed with dp and tp.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.models.transformer import make_transformer
+from tpu_ddp.parallel.mesh import make_mesh
+from tpu_ddp.parallel.pipeline import (stack_block_params,
+                                       unstack_block_params)
+from tpu_ddp.train.lm import (LMTrainer, PipelineLMTrainer, make_lm_batch)
+
+
+def _tiny(**kw):
+    cfg = dict(max_seq_len=32, compute_dtype=jnp.float32, num_layers=4)
+    cfg.update(kw)
+    return make_transformer("TransformerLM-tiny", **cfg)
+
+
+def _tokens(b=4, L=33, seed=5):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 1024, size=(b, L))
+
+
+class TestStacking:
+    def test_roundtrip(self):
+        model = _tiny()
+        params = model.init(jax.random.key(0))
+        stacked = stack_block_params(params)
+        assert stacked["blocks"]["wqkv"].shape[0] == model.num_layers
+        back = unstack_block_params(stacked, model.num_layers)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _sgd():
+    # SGD's update is LINEAR in the gradient, so tiny psum-reordering
+    # noise stays tiny in the params; AdamW's first step is ~lr*sign(g),
+    # which would amplify a near-zero gradient's sign flip to 2*lr.
+    from tpu_ddp.ops.optim import SGD
+    return SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
+
+
+class TestPipelineEquivalence:
+    def _dense_step(self, devices, tokens):
+        model = _tiny()
+        tr = LMTrainer(model, make_mesh(devices[:1], dp=1),
+                       optimizer=_sgd())
+        state = tr.init_state(seed=7)
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        state, loss = tr.train_step(state, x, y)
+        return (jax.device_get(state.params),
+                float(np.mean(np.asarray(loss))))
+
+    @pytest.mark.parametrize("dp,pp,tp,micro", [
+        (1, 2, 1, 2), (1, 4, 1, 4), (2, 2, 1, 2), (1, 2, 2, 2),
+        (1, 4, 1, 1),  # single microbatch: pure bubble, still exact
+    ])
+    def test_one_step_matches_dense(self, devices, dp, pp, tp, micro):
+        tokens = _tokens()
+        dense_p, dense_loss = self._dense_step(devices, tokens)
+
+        model = _tiny()
+        mesh = make_mesh(devices[:dp * pp * tp], dp=dp, sp=1, mp=tp, pp=pp)
+        tr = PipelineLMTrainer(model, mesh, num_micro=micro,
+                               optimizer=_sgd())
+        state = tr.init_state(seed=7)
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        state, loss = tr.train_step(state, x, y)
+        got_loss = float(np.mean(np.asarray(loss)))
+        assert abs(got_loss - dense_loss) < 1e-4, (dp, pp, tp, micro)
+
+        got = unstack_block_params(jax.device_get(state.params),
+                                   model.num_layers)
+        for a, b in zip(jax.tree.leaves(dense_p), jax.tree.leaves(got)):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=3e-4, atol=3e-5,
+                err_msg=f"dp={dp} pp={pp} tp={tp} micro={micro}")
+
+    def test_multi_step_loss_decreases(self, devices):
+        model = _tiny()
+        mesh = make_mesh(devices[:8], dp=2, sp=1, mp=1, pp=4)
+        tr = PipelineLMTrainer(model, mesh)
+        assert (tr.dp, tr.pp, tr.num_micro) == (2, 4, 4)
+        state = tr.init_state()
+        x, y = tr.put_batch(*make_lm_batch(_tokens(b=8)))
+        losses = []
+        for _ in range(3):
+            state, loss = tr.train_step(state, x, y)
+            losses.append(float(np.mean(np.asarray(loss))))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+
+class TestPipelineValidation:
+    def test_indivisible_layers_raises(self, devices):
+        mesh = make_mesh(devices[:3], dp=1, sp=1, mp=1, pp=3)
+        with pytest.raises(ValueError, match="num_layers"):
+            PipelineLMTrainer(_tiny(), mesh)
+
+    def test_sp_composition_rejected(self, devices):
+        mesh = make_mesh(devices[:4], dp=1, sp=2, mp=1, pp=2)
+        with pytest.raises(ValueError, match="sequence parallelism"):
+            PipelineLMTrainer(_tiny(), mesh)
+
+    def test_batch_divisibility(self, devices):
+        mesh = make_mesh(devices[:4], dp=2, sp=1, mp=1, pp=2)
+        tr = PipelineLMTrainer(_tiny(), mesh, num_micro=2)
+        with pytest.raises(ValueError, match="not divisible"):
+            tr.put_batch(np.zeros((6, 32), np.int32),
+                         np.zeros((6, 32), np.int32))

@@ -176,13 +176,15 @@ def launch(
                 for rank, proc in enumerate(procs):
                     if rank not in rcs:
                         proc.kill()
-                        # A rank may have exited with a real code between
-                        # the last poll and this sweep — keep that code
-                        # (even 0) rather than recording our kill; the
-                        # launch is still marked failed below.
+                        # A rank may have exited with a real code (even 0,
+                        # or a real signal like SIGSEGV) between the last
+                        # poll and this sweep — record whatever wait()
+                        # reports: ranks we actually killed show up as -9
+                        # on their own, and the launch is still marked
+                        # failed below either way.
                         rc = proc.wait()
-                        rcs[rank] = -9 if rc < 0 else rc
-                        if rc > 0:
+                        rcs[rank] = rc
+                        if rc != 0:
                             first_failure = first_failure or rc
                 first_failure = first_failure or -9
                 break

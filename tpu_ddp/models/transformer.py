@@ -193,37 +193,53 @@ class TransformerLM:
             raise ValueError(
                 f"global sequence length {lc * self.sp_size} (local {lc} x "
                 f"sp {self.sp_size}) exceeds max_seq_len={self.max_seq_len}")
-        h_loc, hd = self.num_heads // self._tp, self.head_dim
         pos = self._positions(lc)
         x = params["embed"][tokens].astype(cd)          # (B, L, dm)
         for blk in params["blocks"]:
-            y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
-            # Column-parallel QKV: local heads only, zero communication.
-            wqkv = blk["wqkv"].astype(cd).reshape(self.d_model, -1)
-            qkv = jnp.dot(self._tp_in(y), wqkv,
-                          preferred_element_type=jnp.float32)
-            qkv = qkv.astype(cd).reshape(b, lc, 3, h_loc, hd)
-            q = rope(qkv[:, :, 0], pos)
-            k = rope(qkv[:, :, 1], pos)
-            v = qkv[:, :, 2]
-            o = attend(q, k, v, causal=True, axis_name=self.sp_axis,
-                       axis_size=self.sp_size)
-            # Row-parallel output projection: partial sums psum'd over tp.
-            wo = blk["wo"].astype(cd).reshape(h_loc * hd, self.d_model)
-            o = self._tp_out(jnp.dot(
-                o.reshape(b, lc, h_loc * hd), wo,
-                preferred_element_type=jnp.float32)).astype(cd)
-            x = x + o
-            y = layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
-            # Column-parallel up-projection (local d_ff slice) ...
-            y = jnp.dot(self._tp_in(y), blk["w1"].astype(cd),
-                        preferred_element_type=jnp.float32)
-            y = jax.nn.gelu(y.astype(jnp.float32)).astype(cd)
-            # ... row-parallel down-projection, psum'd.
-            y = self._tp_out(jnp.dot(
-                y, blk["w2"].astype(cd),
-                preferred_element_type=jnp.float32)).astype(cd)
-            x = x + y
+            x = self.block_apply(blk, x, pos)
+        return self.head_apply(params, x)
+
+    def block_apply(self, blk, x, pos):
+        """One transformer block: (B, L, dm) -> (B, L, dm).
+
+        Factored out so the pipeline engine can ``lax.scan`` it over a
+        stage's stacked layer slice (tpu_ddp/parallel/pipeline.py) while
+        the dense path loops over the blocks tuple.
+        """
+        cd = self.compute_dtype
+        b, lc = x.shape[0], x.shape[1]
+        h_loc, hd = self.num_heads // self._tp, self.head_dim
+        y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+        # Column-parallel QKV: local heads only, zero communication.
+        wqkv = blk["wqkv"].astype(cd).reshape(self.d_model, -1)
+        qkv = jnp.dot(self._tp_in(y), wqkv,
+                      preferred_element_type=jnp.float32)
+        qkv = qkv.astype(cd).reshape(b, lc, 3, h_loc, hd)
+        q = rope(qkv[:, :, 0], pos)
+        k = rope(qkv[:, :, 1], pos)
+        v = qkv[:, :, 2]
+        o = attend(q, k, v, causal=True, axis_name=self.sp_axis,
+                   axis_size=self.sp_size)
+        # Row-parallel output projection: partial sums psum'd over tp.
+        wo = blk["wo"].astype(cd).reshape(h_loc * hd, self.d_model)
+        o = self._tp_out(jnp.dot(
+            o.reshape(b, lc, h_loc * hd), wo,
+            preferred_element_type=jnp.float32)).astype(cd)
+        x = x + o
+        y = layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+        # Column-parallel up-projection (local d_ff slice) ...
+        y = jnp.dot(self._tp_in(y), blk["w1"].astype(cd),
+                    preferred_element_type=jnp.float32)
+        y = jax.nn.gelu(y.astype(jnp.float32)).astype(cd)
+        # ... row-parallel down-projection, psum'd.
+        y = self._tp_out(jnp.dot(
+            y, blk["w2"].astype(cd),
+            preferred_element_type=jnp.float32)).astype(cd)
+        return x + y
+
+    def head_apply(self, params, x):
+        """Final LayerNorm + LM head: (B, L, dm) -> (B, L, V) float32."""
+        cd = self.compute_dtype
         x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
         logits = jnp.dot(x, params["head"].astype(cd),
                          preferred_element_type=jnp.float32)

@@ -42,6 +42,11 @@ class SGD:
         momentum lives in the same sharding as its parameter."""
         return {"momentum": param_specs}
 
+    def decay_mask(self, params):
+        """Torch SGD decays every parameter uniformly (reference
+        part1/main.py:124-125) — no mask needed."""
+        return None
+
     def _new_buf(self, p, g, buf):
         g = g.astype(p.dtype)
         if self.weight_decay:
@@ -102,6 +107,11 @@ class AdamW:
         return {"mu": param_specs, "nu": param_specs,
                 "count": PartitionSpec()}
 
+    def decay_mask(self, params):
+        """The decay policy, queryable by wrappers (ZeRO) that re-lay-out
+        leaves and must evaluate it on the ORIGINAL shapes."""
+        return jax.tree.map(lambda p: p.ndim >= 2, params)
+
     def apply(self, params, grads, state, decay_mask=None):
         """``decay_mask``: optional bool pytree overriding the ndim>=2
         rule per leaf — ZeRO passes the ORIGINAL leaves' ranks since its
@@ -111,7 +121,7 @@ class AdamW:
         bc1 = 1.0 - self.b1 ** c
         bc2 = 1.0 - self.b2 ** c
         if decay_mask is None:
-            decay_mask = jax.tree.map(lambda p: p.ndim >= 2, params)
+            decay_mask = self.decay_mask(params)
         # Separate tree.maps per output (the SGD style above): structure-
         # safe for any params pytree, and XLA CSEs the shared subterms.
         new_mu = jax.tree.map(

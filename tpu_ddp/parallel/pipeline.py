@@ -1,0 +1,147 @@
+"""Pipeline parallelism: the layer stack sharded into stages over ``pp``.
+
+No reference counterpart (the reference implements data parallelism only —
+SURVEY.md §2 "Absent parallelism strategies"); included because multi-axis
+model sharding is first-class in this framework. The schedule is GPipe-
+style microbatching (Huang et al., arXiv:1811.06965 — reimplemented from
+the paper's schedule, not from any code) expressed the SPMD way, as a
+collective-permute ring pipeline:
+
+- block parameters are STACKED on a leading layer axis and sharded over
+  the ``pp`` mesh axis — each stage holds ``num_layers / pp`` layers and
+  scans over them locally (``lax.scan`` keeps one compiled block body);
+- the local batch is split into M microbatches; the pipeline runs
+  ``T = M + pp - 1`` ticks. Every tick each stage applies its layer slice
+  to its resident activation, then ``lax.ppermute`` rotates activations
+  one hop along the ring (stage i -> i+1) — XLA overlaps the ICI hop with
+  the next tick's compute, exactly like ring attention's K/V rotation;
+- stage 0 injects embedded microbatch t at tick t; the LAST stage's
+  output at tick t is microbatch ``t - (pp-1)``'s final activation. The
+  first ``pp - 1`` ticks per direction are the pipeline bubble — its
+  relative cost shrinks as M grows (bubble fraction = (pp-1)/(M+pp-1));
+- embeddings and the LM head run OUTSIDE the tick loop, once per device
+  over the full local batch (their per-device cost equals the dense
+  model's; only the result computed on stage 0 / the last stage is real,
+  selected by masks that zero the garbage — and, in the backward pass,
+  zero the garbage's gradients).
+
+Gradient flow needs no custom rules: ``ppermute`` transposes to the
+inverse rotation (the backward pipeline runs the ring in reverse), and
+the ``where``-masks confine embed/head gradients to the stages that
+actually used them — the trainer then ``psum``s those replicated leaves
+over ``pp`` (each stage contributes its share, zeros elsewhere) while
+stacked block leaves stay stage-local. Composes with tensor parallelism
+(block weights additionally sharded over ``mp`` inside each stage) and
+data parallelism; exactness vs the dense model is tested in
+tests/test_pipeline.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_ddp.parallel.mesh import PIPE_AXIS
+
+
+def _is_spec(x):
+    return isinstance(x, P)
+
+
+def stack_block_params(params: dict) -> dict:
+    """Per-layer blocks tuple -> one tree with a leading layer axis.
+
+    ``stacked[k][j] == params["blocks"][j][k]`` — layer order is the
+    stacking order, so specs/values round-trip with
+    :func:`unstack_block_params`.
+    """
+    blocks = params["blocks"]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    out = dict(params)
+    out["blocks"] = stacked
+    return out
+
+
+def unstack_block_params(params: dict, num_layers: int) -> dict:
+    """Inverse of :func:`stack_block_params` (host-side, for tests/ckpt)."""
+    stacked = params["blocks"]
+    blocks = tuple(
+        jax.tree.map(lambda x: x[j], stacked) for j in range(num_layers))
+    out = dict(params)
+    out["blocks"] = blocks
+    return out
+
+
+def pipeline_param_specs(model) -> dict:
+    """Specs for the STACKED tree: block leaves gain a leading ``pp``
+    axis on top of the model's own (tp) layout; embed/head/ln_f stay
+    replicated (their grads are pp-psum'd by the trainer)."""
+    base = model.param_specs()
+    blk = jax.tree.map(lambda s: P(PIPE_AXIS, *tuple(s)),
+                       base["blocks"][0], is_leaf=_is_spec)
+    return {"embed": base["embed"], "ln_f": base["ln_f"],
+            "head": base["head"], "blocks": blk}
+
+
+def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
+                  num_micro: int, pp_axis: str = PIPE_AXIS):
+    """(masked_loss_sum, local_n) for this shard's (B, L) batch.
+
+    Must run inside a shard_map over ``pp_axis`` with ``params["blocks"]``
+    holding this stage's stacked layer slice. ``masked_loss_sum`` is the
+    summed token NLL on the LAST stage and exactly 0.0 elsewhere (so its
+    gradient is confined to real compute); psum it over ``pp_axis`` to
+    read the value. ``local_n`` is the token count (same on all stages).
+    """
+    B, L = inputs.shape
+    if L > model.max_seq_len:
+        raise ValueError(f"sequence length {L} exceeds "
+                         f"max_seq_len={model.max_seq_len}")
+    if B % num_micro:
+        raise ValueError(f"local batch {B} not divisible by "
+                         f"num_micro={num_micro}")
+    mb = B // num_micro
+    S, M = pp_size, num_micro
+    cd = model.compute_dtype
+    stage = lax.axis_index(pp_axis)
+    pos = jnp.arange(L)
+
+    micro = inputs.reshape(M, mb, L)
+    x_embed = params["embed"][micro].astype(cd)      # (M, mb, L, dm)
+
+    def run_stage(x):
+        """This stage's layer slice, scanned layer by layer."""
+        def body(h, layer):
+            return model.block_apply(layer, h, pos), None
+        h, _ = lax.scan(body, x, params["blocks"])
+        return h
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        x_prev = carry
+        inj = lax.dynamic_index_in_dim(x_embed, jnp.minimum(t, M - 1), 0,
+                                       keepdims=False)
+        # Stage 0's input comes from injection, later stages' from the
+        # ring; the where-mask also zeroes embed grads on stages > 0.
+        x_in = jnp.where(stage == 0, inj, x_prev)
+        x_out = run_stage(x_in)
+        x_send = lax.ppermute(x_out, pp_axis, perm)
+        return x_send, x_out
+
+    x0 = jnp.zeros((mb, L, model.d_model), cd)
+    _, ys = lax.scan(tick, x0, jnp.arange(M + S - 1))
+    # On the last stage, tick t emitted microbatch t-(S-1): ys[S-1+m] = m.
+    outs = ys[S - 1:]                                 # (M, mb, L, dm)
+    x = outs.reshape(B, L, model.d_model)
+
+    from tpu_ddp.ops.loss import softmax_cross_entropy
+    logits = model.head_apply(params, x)              # (B, L, V) f32
+    nll = softmax_cross_entropy(
+        logits.reshape(-1, logits.shape[-1]), targets.reshape(-1))
+    # Only the last stage's activations are real; the mask zeroes the
+    # other stages' loss AND, transposed, their head/ln_f gradients.
+    is_last = (stage == S - 1).astype(nll.dtype)
+    return jnp.sum(nll) * is_last, jnp.float32(nll.size)

@@ -100,8 +100,10 @@ class ZeRO1:
 
         g_sh = jax.tree.map(grad_slice, grads)
         p_sh = jax.tree.map(param_slice, params)
-        # Decay policy must see the ORIGINAL ranks, not the flat slices.
-        mask = jax.tree.map(lambda p: p.ndim >= 2, params)
+        # The decay policy must be evaluated on the ORIGINAL leaves (the
+        # flat slices are all rank-1), so query the inner optimizer for
+        # its mask rather than re-implementing its rule here.
+        mask = self.inner.decay_mask(params)
         new_p_sh, new_state = self.inner.apply(p_sh, g_sh, opt_state,
                                                decay_mask=mask)
 

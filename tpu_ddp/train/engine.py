@@ -84,6 +84,10 @@ class Trainer:
         if mesh is not None:
             self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
             self._repl_sharding = NamedSharding(mesh, P())
+            if self.is_zero:
+                # Compiled once; reused by every checkpoint save.
+                self._gather_opt_state = jax.jit(
+                    lambda t: t, out_shardings=self._repl_sharding)
         self._train_step = self._build_train_step()
         self._eval_step = jax.jit(self._eval_step_impl)
 
@@ -126,8 +130,7 @@ class Trainer:
             # ZeRO shards the optimizer state over dp; gather it to a
             # replicated layout BEFORE the process-0 gate — the gather is
             # a collective every process must enter.
-            opt_state = jax.jit(
-                lambda t: t, out_shardings=self._repl_sharding)(opt_state)
+            opt_state = self._gather_opt_state(opt_state)
         if jax.process_index() != 0:
             return None
         from tpu_ddp.utils import checkpoint as ckpt

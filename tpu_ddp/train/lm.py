@@ -37,7 +37,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_ddp.ops.loss import softmax_cross_entropy
 from tpu_ddp.ops.optim import AdamW
-from tpu_ddp.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from tpu_ddp.parallel.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
+                                   SEQ_AXIS)
 
 
 @dataclasses.dataclass
@@ -134,6 +135,116 @@ class LMTrainer:
             raise ValueError(f"batch {b} not divisible by dp={self.dp}")
         if L % self.sp:
             raise ValueError(f"seq len {L} not divisible by sp={self.sp}")
+        return (jax.device_put(inputs, self._batch_sharding),
+                jax.device_put(targets, self._batch_sharding))
+
+    def train_step(self, state: LMTrainState, inputs, targets):
+        params, opt_state, loss = self._train_step(
+            state.params, state.opt_state, inputs, targets)
+        return LMTrainState(params, opt_state, state.step + 1), loss
+
+
+class PipelineLMTrainer:
+    """GPipe-style pipeline engine over a dp x pp (x tp) mesh.
+
+    The layer stack shards into ``pp`` stages (stacked block params,
+    tpu_ddp/parallel/pipeline.py); each dp slice's batch is split into
+    ``num_micro`` microbatches that stream through the stage ring.
+    Composes with tensor parallelism (mp > 1); sequence parallelism under
+    the pipeline is not supported (ring attention would rotate K/V inside
+    every pipeline tick — a composition this engine does not schedule).
+    """
+
+    def __init__(self, model, mesh: Mesh, num_micro: int | None = None,
+                 optimizer: AdamW | None = None):
+        from tpu_ddp.parallel.pipeline import pipeline_param_specs
+        self.mesh = mesh
+        self.dp = mesh.shape[DATA_AXIS]
+        self.pp = mesh.shape[PIPE_AXIS]
+        self.tp = mesh.shape.get(MODEL_AXIS, 1)
+        if mesh.shape[SEQ_AXIS] != 1:
+            raise ValueError("PipelineLMTrainer does not compose with "
+                             "sequence parallelism (sp must be 1)")
+        if model.num_layers % self.pp:
+            raise ValueError(f"num_layers={model.num_layers} not "
+                             f"divisible by pp={self.pp}")
+        if self.tp > 1:
+            model = model.with_tensor_parallel(MODEL_AXIS, self.tp)
+        self.model = model
+        self.num_micro = num_micro if num_micro is not None else self.pp
+        self.optimizer = optimizer or AdamW()
+        self._param_specs = pipeline_param_specs(model)
+        self._opt_specs = self.optimizer.state_specs(self._param_specs)
+        self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self._param_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), self._param_specs,
+            is_leaf=_is_spec)
+        self._opt_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), self._opt_specs,
+            is_leaf=_is_spec)
+        self._train_step = self._build_train_step()
+
+    def init_state(self, seed: int = 0) -> LMTrainState:
+        """Same seed -> same parameters as the dense model, re-laid-out:
+        blocks stacked on a leading layer axis, sharded over pp."""
+        from tpu_ddp.parallel.pipeline import stack_block_params
+        params = stack_block_params(self.model.init(jax.random.key(seed)))
+        opt_state = self.optimizer.init(params)
+        params = jax.device_put(params, self._param_shardings)
+        opt_state = jax.device_put(opt_state, self._opt_shardings)
+        return LMTrainState(params=params, opt_state=opt_state)
+
+    def _sync_grads(self, grads):
+        """Stacked block leaves are stage-local (mean over dp only);
+        replicated leaves (embed/head/ln_f) got their real gradient on one
+        stage and zeros elsewhere — sum over pp reassembles it."""
+        def leaf(g, spec):
+            if PIPE_AXIS in tuple(spec):
+                return lax.pmean(g, DATA_AXIS)
+            return lax.pmean(lax.psum(g, PIPE_AXIS), DATA_AXIS)
+        return jax.tree.map(leaf, grads, self._param_specs)
+
+    def _base_step(self, params, opt_state, inputs, targets):
+        from tpu_ddp.parallel.pipeline import pipeline_loss
+
+        def loss_fn(p):
+            masked_sum, local_n = pipeline_loss(
+                self.model, p, inputs, targets, pp_size=self.pp,
+                num_micro=self.num_micro)
+            total = lax.psum(local_n, DATA_AXIS)
+            n_dp = lax.psum(1.0, DATA_AXIS)
+            # Scale so pmean-over-dp of grads == grad of the global token
+            # mean; masked_sum is nonzero on the last stage only and the
+            # pp-psum in _sync_grads completes the sum.
+            return n_dp * masked_sum / total, masked_sum / local_n
+
+        (_, local_mean), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = self._sync_grads(grads)
+        params, opt_state = self.optimizer.apply(params, grads, opt_state)
+        # Real chunk mean lives on the last stage; share it with everyone
+        # (outside the differentiated path).
+        mean = lax.psum(local_mean, PIPE_AXIS)
+        return params, opt_state, mean.reshape(1)
+
+    def _build_train_step(self):
+        mapped = jax.shard_map(
+            self._base_step,
+            mesh=self.mesh,
+            in_specs=(self._param_specs, self._opt_specs, P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=(self._param_specs, self._opt_specs, P(DATA_AXIS)),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def put_batch(self, inputs, targets):
+        inputs = np.ascontiguousarray(inputs, np.int32)
+        targets = np.ascontiguousarray(targets, np.int32)
+        b = inputs.shape[0]
+        if b % (self.dp * self.num_micro):
+            raise ValueError(f"batch {b} not divisible by dp*num_micro="
+                             f"{self.dp * self.num_micro}")
         return (jax.device_put(inputs, self._batch_sharding),
                 jax.device_put(targets, self._batch_sharding))
 
