@@ -1,0 +1,140 @@
+"""Mixture-of-experts + expert parallelism: the ep-sharded MoE computes
+the same function as its single-device execution (drop-free capacity),
+the router is differentiable, and MoE composes with dp and pp.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.models.transformer import make_transformer
+from tpu_ddp.ops.optim import SGD
+from tpu_ddp.parallel.mesh import EXPERT_AXIS, make_mesh
+from tpu_ddp.parallel.moe import switch_route
+from tpu_ddp.train.lm import (LMTrainer, PipelineLMTrainer, make_lm_batch)
+
+
+def _moe(**kw):
+    cfg = dict(max_seq_len=32, compute_dtype=jnp.float32,
+               moe_capacity_factor=8.0)  # drop-free for equivalence tests
+    cfg.update(kw)
+    return make_transformer("TransformerLM-moe-tiny", **cfg)
+
+
+def _sgd():
+    return SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
+
+
+def _tokens(b=4, L=33, seed=11):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 1024, size=(b, L))
+
+
+class TestSwitchRouting:
+    def test_dispatch_shapes_and_capacity(self):
+        logits = jnp.asarray(np.random.default_rng(0).normal(
+            size=(16, 4)).astype(np.float32))
+        dispatch, combine, aux = switch_route(logits, 4, capacity=2)
+        assert dispatch.shape == (16, 4, 2)
+        # At most `capacity` tokens per expert slot column.
+        assert float(jnp.max(jnp.sum(dispatch, axis=(0, 2)))) <= 2 + 1e-6
+        # Each kept token occupies exactly one (expert, slot).
+        per_token = jnp.sum(dispatch, axis=(1, 2))
+        assert set(np.unique(np.asarray(per_token))) <= {0.0, 1.0}
+        assert np.isfinite(float(aux))
+
+    def test_balanced_routing_aux_is_one(self):
+        # Perfectly uniform router -> f_e = P_e = 1/E -> aux = E*E*(1/E^2).
+        logits = jnp.zeros((8, 4), jnp.float32)
+        _, _, aux = switch_route(logits, 4, capacity=8)
+        assert abs(float(aux) - 1.0) < 1e-5
+
+
+class TestMoEForward:
+    def test_apply_with_aux(self):
+        model = _moe()
+        params = model.init(jax.random.key(0))
+        tokens = jnp.asarray(_tokens(2, 17)[:, :16])
+        logits, aux = model.apply_with_aux(params, tokens)
+        assert logits.shape == (2, 16, model.vocab_size)
+        assert float(aux) > 0.0
+        # Dense model reports zero aux.
+        dense = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        dp = dense.init(jax.random.key(0))
+        _, dense_aux = dense.apply_with_aux(dp, tokens)
+        assert float(dense_aux) == 0.0
+
+    def test_router_gradient_nonzero(self):
+        model = _moe()
+        params = model.init(jax.random.key(1))
+        tokens = jnp.asarray(_tokens(2, 17)[:, :16])
+
+        def loss(p):
+            logits, aux = model.apply_with_aux(p, tokens)
+            return jnp.mean(logits ** 2) + 0.01 * aux
+
+        grads = jax.grad(loss)(params)
+        g_router = np.asarray(grads["blocks"][0]["router"])
+        assert np.abs(g_router).max() > 0.0
+
+
+class TestExpertParallelEquivalence:
+    def _one_step(self, devices, dp, ep, tokens):
+        model = _moe()
+        mesh = make_mesh(devices[:dp * ep], dp=dp, sp=1, mp=1, pp=1, ep=ep)
+        tr = LMTrainer(model, mesh, optimizer=_sgd())
+        state = tr.init_state(seed=3)
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        state, loss = tr.train_step(state, x, y)
+        return (jax.device_get(state.params),
+                float(np.mean(np.asarray(loss))))
+
+    @pytest.mark.parametrize("dp,ep", [(1, 4), (2, 2), (1, 2)])
+    def test_step_matches_unsharded(self, devices, dp, ep):
+        tokens = _tokens()
+        ref_p, ref_loss = self._one_step(devices, dp * ep, 1, tokens)
+        got_p, got_loss = self._one_step(devices, dp, ep, tokens)
+        assert abs(got_loss - ref_loss) < 1e-4, (dp, ep)
+        for a, b in zip(jax.tree.leaves(ref_p), jax.tree.leaves(got_p)):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=3e-4, atol=3e-5,
+                err_msg=f"dp={dp} ep={ep}")
+
+    def test_loss_decreases_with_drops(self, devices):
+        """Tight capacity (tokens dropped) still trains stably."""
+        model = _moe(moe_capacity_factor=0.5)
+        mesh = make_mesh(devices[:4], dp=2, sp=1, mp=1, pp=1, ep=2)
+        tr = LMTrainer(model, mesh)
+        state = tr.init_state()
+        x, y = tr.put_batch(*make_lm_batch(_tokens(b=4)))
+        losses = []
+        for _ in range(3):
+            state, loss = tr.train_step(state, x, y)
+            losses.append(float(np.mean(np.asarray(loss))))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+
+class TestMoEComposition:
+    def test_moe_under_pipeline(self, devices):
+        """MoE blocks run under pp (experts stage-local, aux discarded)."""
+        model = _moe()
+        mesh = make_mesh(devices[:4], dp=2, sp=1, mp=1, pp=2)
+        tr = PipelineLMTrainer(model, mesh, num_micro=2, optimizer=_sgd())
+        state = tr.init_state(seed=0)
+        x, y = tr.put_batch(*make_lm_batch(_tokens(b=4)))
+        state, loss = tr.train_step(state, x, y)
+        assert np.isfinite(float(np.mean(np.asarray(loss))))
+
+    def test_ep_requires_moe_model(self, devices):
+        dense = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:2], dp=1, sp=1, mp=1, pp=1, ep=2)
+        with pytest.raises(ValueError, match="moe_experts"):
+            LMTrainer(dense, mesh)
+
+    def test_indivisible_experts_raises(self):
+        with pytest.raises(ValueError, match="not"):
+            _moe().with_expert_parallel(EXPERT_AXIS, 3)

@@ -81,6 +81,31 @@ class TestPipelineEquivalence:
                 np.asarray(b), np.asarray(a), rtol=3e-4, atol=3e-5,
                 err_msg=f"dp={dp} pp={pp} tp={tp} micro={micro}")
 
+    def test_adamw_decay_mask_uses_original_ranks(self, devices):
+        """Stacking raises LN scales/biases to rank 2; AdamW must still
+        exempt them from weight decay (regression: a pipelined AdamW step
+        must equal the dense AdamW step on LN leaves, where a spuriously
+        applied decay of wd*1.0 would dominate the tiny gradient)."""
+        tokens = _tokens()
+        model = _tiny()
+        dense = LMTrainer(model, make_mesh(devices[:1], dp=1))
+        ds = dense.init_state(seed=7)
+        x, y = dense.put_batch(*make_lm_batch(tokens))
+        ds, _ = dense.train_step(ds, x, y)
+        dense_ln = np.asarray(
+            jax.device_get(ds.params)["blocks"][0]["ln1"]["scale"])
+
+        mesh = make_mesh(devices[:2], dp=1, sp=1, mp=1, pp=2)
+        tr = PipelineLMTrainer(model, mesh, num_micro=2)
+        ps = tr.init_state(seed=7)
+        xp, yp = tr.put_batch(*make_lm_batch(tokens))
+        ps, _ = tr.train_step(ps, xp, yp)
+        pipe_ln = np.asarray(jax.device_get(
+            unstack_block_params(ps.params, model.num_layers)
+        )["blocks"][0]["ln1"]["scale"])
+        np.testing.assert_allclose(pipe_ln, dense_ln, rtol=1e-4,
+                                   atol=1e-6)
+
     def test_multi_step_loss_decreases(self, devices):
         model = _tiny()
         mesh = make_mesh(devices[:8], dp=2, sp=1, mp=1, pp=4)

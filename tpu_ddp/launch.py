@@ -173,20 +173,22 @@ def launch(
                         other.kill()
         if len(rcs) < len(procs):
             if deadline is not None and time.monotonic() > deadline:
+                # A rank may have exited with a real code (even 0, or a
+                # real signal like SIGSEGV) between the last poll and
+                # this sweep — record whatever wait() reports, and prefer
+                # any such real code as the root cause over the -9 of
+                # ranks we killed ourselves (checked only after the whole
+                # sweep, so an early hung rank cannot mask a later rank's
+                # real failure).
+                sweep_real = 0
                 for rank, proc in enumerate(procs):
                     if rank not in rcs:
                         proc.kill()
-                        # A rank may have exited with a real code (even 0,
-                        # or a real signal like SIGSEGV) between the last
-                        # poll and this sweep — record whatever wait()
-                        # reports: ranks we actually killed show up as -9
-                        # on their own, and the launch is still marked
-                        # failed below either way.
                         rc = proc.wait()
                         rcs[rank] = rc
-                        if rc != 0:
-                            first_failure = first_failure or rc
-                first_failure = first_failure or -9
+                        if rc not in (0, -9):
+                            sweep_real = sweep_real or rc
+                first_failure = first_failure or sweep_real or -9
                 break
             time.sleep(0.05)
     result = LaunchResult(first_failure=first_failure)

@@ -83,6 +83,13 @@ class TransformerLM:
     # Tensor parallelism: mesh axis name/extent block params are sharded on.
     tp_axis: str | None = None
     tp_size: int = 1
+    # Mixture of experts: when > 0 every block's MLP is a Switch top-1
+    # routed MoE with this many experts (tpu_ddp/parallel/moe.py).
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    # Expert parallelism: mesh axis name/extent the expert axis shards on.
+    ep_axis: str | None = None
+    ep_size: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -91,6 +98,10 @@ class TransformerLM:
     @property
     def _tp(self) -> int:
         return self.tp_size if self.tp_axis is not None else 1
+
+    @property
+    def _ep(self) -> int:
+        return self.ep_size if self.ep_axis is not None else 1
 
     # ---- parameters ----------------------------------------------------
 
@@ -113,8 +124,9 @@ class TransformerLM:
             "head": _normal(next(keys), (dm, v), std, self.param_dtype),
         }
         blocks = []
+        E = self.moe_experts
         for _ in range(self.num_layers):
-            blocks.append({
+            blk = {
                 "ln1": {"scale": jnp.ones((dm,), self.param_dtype),
                         "bias": jnp.zeros((dm,), self.param_dtype)},
                 "wqkv": _normal(next(keys), (dm, 3, h, hd), std,
@@ -123,9 +135,21 @@ class TransformerLM:
                               self.param_dtype),
                 "ln2": {"scale": jnp.ones((dm,), self.param_dtype),
                         "bias": jnp.zeros((dm,), self.param_dtype)},
-                "w1": _normal(next(keys), (dm, dff), std, self.param_dtype),
-                "w2": _normal(next(keys), (dff, dm), std, self.param_dtype),
-            })
+            }
+            if E:
+                # MoE MLP: stacked expert weights + a router.
+                blk["router"] = _normal(next(keys), (dm, E), std,
+                                        self.param_dtype)
+                blk["w1"] = _normal(next(keys), (E, dm, dff), std,
+                                    self.param_dtype)
+                blk["w2"] = _normal(next(keys), (E, dff, dm), std,
+                                    self.param_dtype)
+            else:
+                blk["w1"] = _normal(next(keys), (dm, dff), std,
+                                    self.param_dtype)
+                blk["w2"] = _normal(next(keys), (dff, dm), std,
+                                    self.param_dtype)
+            blocks.append(blk)
         params["blocks"] = tuple(blocks)
         return params
 
@@ -138,15 +162,21 @@ class TransformerLM:
         leaf is fully replicated.
         """
         tp = self.tp_axis if self._tp > 1 else None
+        ep = self.ep_axis if self._ep > 1 else None
         ln = {"scale": P(), "bias": P()}
         blk = {
             "ln1": dict(ln),
             "wqkv": P(None, None, tp, None),
             "wo": P(tp, None, None),
             "ln2": dict(ln),
-            "w1": P(None, tp),
-            "w2": P(tp, None),
         }
+        if self.moe_experts:
+            blk["router"] = P()
+            blk["w1"] = P(ep, None, tp)
+            blk["w2"] = P(ep, tp, None)
+        else:
+            blk["w1"] = P(None, tp)
+            blk["w2"] = P(tp, None)
         return {
             "embed": P(),
             "ln_f": dict(ln),
@@ -199,13 +229,32 @@ class TransformerLM:
             x = self.block_apply(blk, x, pos)
         return self.head_apply(params, x)
 
+    def apply_with_aux(self, params, tokens):
+        """Like :meth:`apply`, additionally returning the mean Switch
+        load-balance auxiliary loss over MoE blocks (0.0 when dense)."""
+        cd = self.compute_dtype
+        lc = tokens.shape[1]
+        pos = self._positions(lc)
+        x = params["embed"][tokens].astype(cd)
+        aux = jnp.float32(0.0)
+        for blk in params["blocks"]:
+            x, a = self.block_apply_aux(blk, x, pos)
+            aux = aux + a
+        return self.head_apply(params, x), aux / max(self.num_layers, 1)
+
     def block_apply(self, blk, x, pos):
         """One transformer block: (B, L, dm) -> (B, L, dm).
 
         Factored out so the pipeline engine can ``lax.scan`` it over a
         stage's stacked layer slice (tpu_ddp/parallel/pipeline.py) while
-        the dense path loops over the blocks tuple.
+        the dense path loops over the blocks tuple. For MoE blocks the
+        router's auxiliary loss is discarded here; use
+        :meth:`block_apply_aux` / :meth:`apply_with_aux` to train with
+        the load-balance regularizer.
         """
+        return self.block_apply_aux(blk, x, pos)[0]
+
+    def block_apply_aux(self, blk, x, pos):
         cd = self.compute_dtype
         b, lc = x.shape[0], x.shape[1]
         h_loc, hd = self.num_heads // self._tp, self.head_dim
@@ -227,6 +276,15 @@ class TransformerLM:
             preferred_element_type=jnp.float32)).astype(cd)
         x = x + o
         y = layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+        if self.moe_experts:
+            from tpu_ddp.parallel.moe import moe_mlp
+            y, aux = moe_mlp(
+                y, blk["router"], blk["w1"], blk["w2"],
+                num_experts=self.moe_experts,
+                capacity_factor=self.moe_capacity_factor,
+                ep_axis=self.ep_axis or "ep", ep_size=self._ep,
+                tp_in=self._tp_in, tp_out=self._tp_out)
+            return x + y, aux
         # Column-parallel up-projection (local d_ff slice) ...
         y = jnp.dot(self._tp_in(y), blk["w1"].astype(cd),
                     preferred_element_type=jnp.float32)
@@ -235,7 +293,7 @@ class TransformerLM:
         y = self._tp_out(jnp.dot(
             y, blk["w2"].astype(cd),
             preferred_element_type=jnp.float32)).astype(cd)
-        return x + y
+        return x + y, jnp.float32(0.0)
 
     def head_apply(self, params, x):
         """Final LayerNorm + LM head: (B, L, dm) -> (B, L, V) float32."""
@@ -266,6 +324,17 @@ class TransformerLM:
         return dataclasses.replace(self, tp_axis=axis_name,
                                    tp_size=axis_size)
 
+    def with_expert_parallel(self, axis_name: str,
+                             axis_size: int) -> "TransformerLM":
+        if not self.moe_experts:
+            raise ValueError("expert parallelism requires a MoE model "
+                             "(moe_experts > 0)")
+        if self.moe_experts % axis_size:
+            raise ValueError(f"moe_experts={self.moe_experts} not "
+                             f"divisible by ep={axis_size}")
+        return dataclasses.replace(self, ep_axis=axis_name,
+                                   ep_size=axis_size)
+
 
 def make_transformer(name: str = "TransformerLM-small",
                      **kwargs) -> TransformerLM:
@@ -276,6 +345,12 @@ def make_transformer(name: str = "TransformerLM-small",
                                     d_ff=2048, vocab_size=32000),
         "TransformerLM-base": dict(num_layers=12, num_heads=12, d_model=768,
                                    d_ff=3072, vocab_size=32000),
+        "TransformerLM-moe-tiny": dict(num_layers=2, num_heads=4,
+                                       d_model=128, d_ff=256,
+                                       vocab_size=1024, moe_experts=4),
+        "TransformerLM-moe-small": dict(num_layers=4, num_heads=8,
+                                        d_model=512, d_ff=1024,
+                                        vocab_size=32000, moe_experts=8),
     }
     if name not in presets:
         raise ValueError(f"unknown transformer preset {name!r}; "

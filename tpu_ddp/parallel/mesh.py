@@ -6,11 +6,12 @@ Data-parallel replicas are mesh slots; the batch is sharded over ``dp`` and
 parameters are replicated — XLA then lowers the gradient ``psum`` onto ICI
 (intra-slice) / DCN (cross-slice) automatically (SURVEY.md §2 row N1).
 
-The mesh is always (``dp``, ``sp``, ``mp``, ``pp``): ``sp`` shards the
-sequence axis for ring attention, ``mp`` shards tensors (Megatron
-column/row, tpu_ddp/parallel/tensor_parallel.py), ``pp`` shards the layer
-stack into pipeline stages (tpu_ddp/parallel/pipeline.py) — all size 1 by
-default so the DP-only ladder sees a plain 1-D dp mesh.
+The mesh is always (``dp``, ``sp``, ``mp``, ``pp``, ``ep``): ``sp``
+shards the sequence axis for ring attention, ``mp`` shards tensors
+(Megatron column/row, tpu_ddp/parallel/tensor_parallel.py), ``pp`` shards
+the layer stack into pipeline stages (tpu_ddp/parallel/pipeline.py),
+``ep`` shards mixture-of-experts layers (tpu_ddp/parallel/moe.py) — all
+size 1 by default so the DP-only ladder sees a plain 1-D dp mesh.
 """
 
 from __future__ import annotations
@@ -24,31 +25,33 @@ DATA_AXIS = "dp"
 SEQ_AXIS = "sp"
 MODEL_AXIS = "mp"
 PIPE_AXIS = "pp"
+EXPERT_AXIS = "ep"
 
 
 def make_mesh(devices=None, dp: int | None = None, sp: int = 1,
-              mp: int = 1, pp: int = 1) -> Mesh:
-    """Build a (dp, sp, mp, pp) mesh over ``devices`` (default: all).
+              mp: int = 1, pp: int = 1, ep: int = 1) -> Mesh:
+    """Build a (dp, sp, mp, pp, ep) mesh over ``devices`` (default: all).
 
-    ``dp`` defaults to ``len(devices) // (sp * mp * pp)``. For pure data
-    parallelism (the reference's only mode) this is a 1-D dp mesh with
-    trivial sp/mp/pp axes; ``sp`` > 1 shards the sequence axis for ring
-    attention (tpu_ddp/parallel/ring_attention.py).
+    ``dp`` defaults to ``len(devices) // (sp * mp * pp * ep)``. For pure
+    data parallelism (the reference's only mode) this is a 1-D dp mesh
+    with trivial sp/mp/pp/ep axes; ``sp`` > 1 shards the sequence axis
+    for ring attention (tpu_ddp/parallel/ring_attention.py).
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    denom = sp * mp * pp
+    denom = sp * mp * pp * ep
     if dp is None:
         if n % denom:
             raise ValueError(
-                f"{n} devices not divisible by sp*mp*pp={denom}")
+                f"{n} devices not divisible by sp*mp*pp*ep={denom}")
         dp = n // denom
     if dp * denom != n:
         raise ValueError(
-            f"dp*sp*mp*pp = {dp}*{sp}*{mp}*{pp} != {n} devices")
-    arr = np.asarray(devices).reshape(dp, sp, mp, pp)
-    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS, PIPE_AXIS))
+            f"dp*sp*mp*pp*ep = {dp}*{sp}*{mp}*{pp}*{ep} != {n} devices")
+    arr = np.asarray(devices).reshape(dp, sp, mp, pp, ep)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS, PIPE_AXIS,
+                      EXPERT_AXIS))
 
 
 def data_parallel_specs():

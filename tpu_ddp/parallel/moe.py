@@ -1,0 +1,117 @@
+"""Expert parallelism: Switch-style mixture-of-experts over ``ep``.
+
+No reference counterpart (the reference implements data parallelism only —
+SURVEY.md §2 "Absent parallelism strategies"); included because multi-axis
+model sharding is first-class in this framework. The layer is a top-1
+routed MoE MLP (Fedus et al., "Switch Transformers", arXiv:2101.03961 —
+reimplemented from the paper's routing algebra, not from any code),
+expressed the SPMD way:
+
+- expert weights are STACKED on a leading expert axis and sharded over
+  the ``ep`` mesh axis — each device hosts ``num_experts / ep`` experts;
+- tokens are data-parallel over (dp × ep): every device routes its OWN
+  tokens, builds a (tokens, experts, capacity) one-hot dispatch tensor,
+  and two ``lax.all_to_all``s move token activations to their expert's
+  host device and back — the ep-analogue of the pipeline's ppermute ring;
+- capacity is static: ``C = ceil(T/E * capacity_factor)`` slots per
+  expert per source device. Tokens beyond an expert's capacity are
+  dropped (their MLP branch contributes zero; the residual stream still
+  carries them) — the standard static-shape trade XLA needs;
+- the router is differentiable through the combine weights (the chosen
+  expert's probability scales its output), and the Switch auxiliary
+  load-balancing loss ``E * Σ_e f_e·P_e`` is returned alongside so the
+  trainer can regularize routing collapse.
+
+Gradient flow needs no custom rules: dispatch/combine are einsums against
+a stop-gradient one-hot, and ``all_to_all`` transposes to the reverse
+``all_to_all``. Exactness of the ep-sharded layer vs its single-device
+execution is tested in tests/test_moe.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_ddp.parallel.mesh import EXPERT_AXIS
+
+
+def switch_route(router_logits, num_experts: int, capacity: int):
+    """Top-1 routing: (T, E) logits -> (dispatch, combine, aux).
+
+    ``dispatch``: (T, E, C) one-hot of (expert, slot) per kept token.
+    ``combine``: dispatch scaled by the router probability (differentiable
+    path into the router weights). ``aux``: Switch load-balance loss.
+    """
+    T = router_logits.shape[0]
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                     # (T,)
+    onehot = jax.nn.one_hot(expert, num_experts,
+                            dtype=jnp.float32)              # (T, E)
+    # Slot index of each token within its expert's queue, in token order.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0         # (T, E)
+    kept = onehot * (pos < capacity)                        # (T, E)
+    slot = jax.nn.one_hot(jnp.sum(pos * kept, axis=-1).astype(jnp.int32),
+                          capacity, dtype=jnp.float32)      # (T, C)
+    dispatch = kept[:, :, None] * slot[:, None, :]          # (T, E, C)
+    gate = jnp.sum(probs * onehot, axis=-1)                 # (T,)
+    combine = lax.stop_gradient(dispatch) * gate[:, None, None]
+    # Load balance: fraction routed to e times mean router prob of e.
+    f = jnp.mean(onehot, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(f * p)
+    return lax.stop_gradient(dispatch), combine, aux
+
+
+def moe_mlp(y, router_w, w1, w2, *, num_experts: int,
+            capacity_factor: float = 1.25, ep_axis: str = EXPERT_AXIS,
+            ep_size: int = 1, activation=None,
+            tp_in=None, tp_out=None):
+    """Switch MoE MLP: (B, L, dm) -> ((B, L, dm), aux).
+
+    ``w1``: (E_local, dm, dff_local), ``w2``: (E_local, dff_local, dm) —
+    stacked expert weights, already sharded over ``ep`` (and optionally
+    ``mp`` via the ``tp_in``/``tp_out`` Megatron hooks). Must run inside
+    a shard_map over ``ep_axis`` when ``ep_size > 1``.
+    """
+    b, L, dm = y.shape
+    T = b * L
+    E = num_experts
+    e_loc = w1.shape[0]
+    if e_loc * max(ep_size, 1) != E:
+        raise ValueError(f"{w1.shape[0]} local experts x ep={ep_size} "
+                         f"!= num_experts={E}")
+    cap = max(1, int(-(-T * capacity_factor // E)))
+    act = activation or (lambda h: jax.nn.gelu(h.astype(jnp.float32)))
+    cd = y.dtype
+
+    x = y.reshape(T, dm)
+    logits = jnp.dot(x, router_w.astype(cd),
+                     preferred_element_type=jnp.float32)    # (T, E)
+    dispatch, combine, aux = switch_route(logits, E, cap)
+
+    # (T, E, C) x (T, dm) -> (E, C, dm): gather each expert's slot queue.
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cd), x,
+                           preferred_element_type=jnp.float32).astype(cd)
+    if ep_size > 1:
+        # Exchange: split the expert axis across ep peers, concatenate
+        # the per-source queues -> (E_local, ep*C, dm) on each device.
+        expert_in = lax.all_to_all(expert_in, ep_axis, split_axis=0,
+                                   concat_axis=1, tiled=True)
+    h_in = tp_in(expert_in) if tp_in is not None else expert_in
+    h = jnp.einsum("ecd,edf->ecf", h_in, w1.astype(cd),
+                   preferred_element_type=jnp.float32)
+    h = act(h).astype(cd)
+    out = jnp.einsum("ecf,efd->ecd", h, w2.astype(cd),
+                     preferred_element_type=jnp.float32)
+    out = (tp_out(out) if tp_out is not None else out).astype(cd)
+    if ep_size > 1:
+        # Reverse exchange: every token's output returns to its source.
+        out = lax.all_to_all(out, ep_axis, split_axis=1, concat_axis=0,
+                             tiled=True)
+    # (T, E, C) x (E, C, dm) -> (T, dm): weight by router prob; dropped
+    # tokens (no slot) get zeros and ride the residual stream unchanged.
+    y_out = jnp.einsum("tec,ecd->td", combine.astype(cd), out,
+                       preferred_element_type=jnp.float32).astype(cd)
+    return y_out.reshape(b, L, dm), aux

@@ -37,8 +37,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_ddp.ops.loss import softmax_cross_entropy
 from tpu_ddp.ops.optim import AdamW
-from tpu_ddp.parallel.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
-                                   SEQ_AXIS)
+from tpu_ddp.parallel.mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS,
+                                   PIPE_AXIS, SEQ_AXIS)
+
+
+def _spec_axes(spec) -> set:
+    """Mesh axis names a PartitionSpec shards over."""
+    names = set()
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            names.update(entry)
+        else:
+            names.add(entry)
+    return names
 
 
 @dataclasses.dataclass
@@ -58,85 +71,36 @@ def _is_spec(x):
     return isinstance(x, P)
 
 
-class LMTrainer:
-    """Wires a TransformerLM + AdamW into a dp x sp x tp sharded step."""
+class _MeshTrainer:
+    """Shared wiring for shard_map'd LM trainers: sharding trees from
+    spec trees, train-step compilation, and the step loop. Subclasses set
+    ``mesh``/``optimizer``/``_param_specs``/``_opt_specs`` and implement
+    ``_base_step`` (the per-shard step body)."""
 
-    def __init__(self, model, mesh: Mesh, optimizer: AdamW | None = None):
-        self.mesh = mesh
-        self.dp = mesh.shape[DATA_AXIS]
-        self.sp = mesh.shape[SEQ_AXIS]
-        self.tp = mesh.shape.get(MODEL_AXIS, 1)
-        if self.sp > 1:
-            model = model.with_sequence_parallel(SEQ_AXIS, self.sp)
-        if self.tp > 1:
-            model = model.with_tensor_parallel(MODEL_AXIS, self.tp)
-        self.model = model
-        self.optimizer = optimizer or AdamW()
-        self._param_specs = self.model.param_specs()
-        self._opt_specs = self.optimizer.state_specs(self._param_specs)
-        self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
-        self._param_shardings = jax.tree.map(
-            lambda s: NamedSharding(mesh, s), self._param_specs,
-            is_leaf=_is_spec)
-        self._opt_shardings = jax.tree.map(
-            lambda s: NamedSharding(mesh, s), self._opt_specs,
-            is_leaf=_is_spec)
-        self._train_step = self._build_train_step()
+    def _shardings(self, specs):
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
+                            is_leaf=_is_spec)
 
-    def init_state(self, seed: int = 0) -> LMTrainState:
-        """Init GLOBAL params from the seed, then place every leaf in its
-        spec's sharding (tp leaves split over ``mp``, rest replicated)."""
-        params = self.model.init(jax.random.key(seed))
-        opt_state = self.optimizer.init(params)
-        params = jax.device_put(params, self._param_shardings)
-        opt_state = jax.device_put(opt_state, self._opt_shardings)
-        return LMTrainState(params=params, opt_state=opt_state)
-
-    def _base_step(self, params, opt_state, inputs, targets):
-        def loss_fn(p):
-            logits = self.model.apply(p, inputs)        # (B, Lc, V) f32
-            nll = softmax_cross_entropy(
-                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1))
-            local_sum = jnp.sum(nll)
-            local_n = jnp.float32(nll.size)
-            total = lax.psum(local_n, (DATA_AXIS, SEQ_AXIS))
-            n_shards = lax.psum(1.0, (DATA_AXIS, SEQ_AXIS))
-            # Scale so pmean-of-grads == grad of the GLOBAL token mean.
-            # mp shards hold the same tokens and compute the same loss.
-            loss_for_grad = n_shards * local_sum / total
-            return loss_for_grad, local_sum / local_n
-        (_, local_mean), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
-        # Sync over the data axes only: each mp shard owns its tp slice
-        # (replicated leaves' grads are identical across mp by the
-        # tensor-parallel backward construction — tensor_parallel.tp_input).
-        grads = lax.pmean(grads, (DATA_AXIS, SEQ_AXIS))
-        params, opt_state = self.optimizer.apply(params, grads, opt_state)
-        # (1, 1) per shard -> (dp, sp) global: every shard's own chunk mean.
-        return params, opt_state, local_mean.reshape(1, 1)
-
-    def _build_train_step(self):
+    def _compile_step(self, batch_spec, loss_spec):
         mapped = jax.shard_map(
             self._base_step,
             mesh=self.mesh,
-            in_specs=(self._param_specs, self._opt_specs,
-                      P(DATA_AXIS, SEQ_AXIS), P(DATA_AXIS, SEQ_AXIS)),
-            out_specs=(self._param_specs, self._opt_specs,
-                       P(DATA_AXIS, SEQ_AXIS)),
+            in_specs=(self._param_specs, self._opt_specs, batch_spec,
+                      batch_spec),
+            out_specs=(self._param_specs, self._opt_specs, loss_spec),
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
-    def put_batch(self, inputs, targets):
-        inputs = np.ascontiguousarray(inputs, np.int32)
-        targets = np.ascontiguousarray(targets, np.int32)
-        b, L = inputs.shape
-        if b % self.dp:
-            raise ValueError(f"batch {b} not divisible by dp={self.dp}")
-        if L % self.sp:
-            raise ValueError(f"seq len {L} not divisible by sp={self.sp}")
-        return (jax.device_put(inputs, self._batch_sharding),
-                jax.device_put(targets, self._batch_sharding))
+    def _place_state(self, params, opt_state) -> LMTrainState:
+        params = jax.device_put(params, self._param_shardings)
+        opt_state = jax.device_put(opt_state, self._opt_shardings)
+        return LMTrainState(params=params, opt_state=opt_state)
+
+    def _decay_mask(self, params):
+        """The optimizer's decay policy on ITS view of the leaves;
+        overridden where the trainer re-lays-out parameters."""
+        return self.optimizer.decay_mask(params)
 
     def train_step(self, state: LMTrainState, inputs, targets):
         params, opt_state, loss = self._train_step(
@@ -144,7 +108,101 @@ class LMTrainer:
         return LMTrainState(params, opt_state, state.step + 1), loss
 
 
-class PipelineLMTrainer:
+class LMTrainer(_MeshTrainer):
+    """Wires a TransformerLM + AdamW into a dp x sp x tp x ep sharded
+    step. Token batches are data-parallel over BOTH ``dp`` and ``ep``
+    (expert weights shard over ``ep``; tokens reach their expert's device
+    via the MoE layer's all_to_all, tpu_ddp/parallel/moe.py)."""
+
+    def __init__(self, model, mesh: Mesh, optimizer: AdamW | None = None,
+                 moe_aux_coef: float = 0.01):
+        self.mesh = mesh
+        self.dp = mesh.shape[DATA_AXIS]
+        self.sp = mesh.shape[SEQ_AXIS]
+        self.tp = mesh.shape.get(MODEL_AXIS, 1)
+        self.ep = mesh.shape.get(EXPERT_AXIS, 1)
+        self.moe_aux_coef = moe_aux_coef
+        if self.sp > 1:
+            model = model.with_sequence_parallel(SEQ_AXIS, self.sp)
+        if self.tp > 1:
+            model = model.with_tensor_parallel(MODEL_AXIS, self.tp)
+        if self.ep > 1:
+            model = model.with_expert_parallel(EXPERT_AXIS, self.ep)
+        self.model = model
+        # All axes the batch (and therefore the loss) is sharded over.
+        self._data_axes = (DATA_AXIS, SEQ_AXIS, EXPERT_AXIS)
+        self.optimizer = optimizer or AdamW()
+        self._param_specs = self.model.param_specs()
+        self._opt_specs = self.optimizer.state_specs(self._param_specs)
+        batch_spec = P((DATA_AXIS, EXPERT_AXIS), SEQ_AXIS)
+        self._batch_sharding = NamedSharding(mesh, batch_spec)
+        self._param_shardings = self._shardings(self._param_specs)
+        self._opt_shardings = self._shardings(self._opt_specs)
+        self._train_step = self._compile_step(batch_spec, batch_spec)
+
+    def init_state(self, seed: int = 0) -> LMTrainState:
+        """Init GLOBAL params from the seed, then place every leaf in its
+        spec's sharding (tp leaves split over ``mp``, rest replicated)."""
+        params = self.model.init(jax.random.key(seed))
+        return self._place_state(params, self.optimizer.init(params))
+
+    def _sync_grads(self, grads):
+        """Mean over the data axes, per leaf. A leaf sharded over ``ep``
+        (stacked expert weights) owns its slice, so no ep-collective —
+        BUT its gradient already holds the SUM over every token shard's
+        contribution (the backward all_to_all delivered them), so the
+        mean over those excluded axes becomes a plain division.
+        mp-replicated leaves are already identical across mp by the
+        tensor-parallel backward construction (tp_input)."""
+        def leaf(g, spec):
+            sharded = _spec_axes(spec)
+            sync = tuple(a for a in self._data_axes if a not in sharded)
+            g = lax.pmean(g, sync)
+            excluded = int(np.prod([self.mesh.shape[a]
+                                    for a in self._data_axes
+                                    if a in sharded]))
+            return g / excluded if excluded > 1 else g
+        return jax.tree.map(leaf, grads, self._param_specs)
+
+    def _base_step(self, params, opt_state, inputs, targets):
+        def loss_fn(p):
+            if self.model.moe_experts:
+                logits, aux = self.model.apply_with_aux(p, inputs)
+            else:
+                logits, aux = self.model.apply(p, inputs), 0.0
+            nll = softmax_cross_entropy(
+                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1))
+            local_sum = jnp.sum(nll)
+            local_n = jnp.float32(nll.size)
+            total = lax.psum(local_n, self._data_axes)
+            n_shards = lax.psum(1.0, self._data_axes)
+            # Scale so pmean-of-grads == grad of the GLOBAL token mean.
+            # mp shards hold the same tokens and compute the same loss.
+            loss_for_grad = (n_shards * local_sum / total
+                             + self.moe_aux_coef * aux)
+            return loss_for_grad, local_sum / local_n
+        (_, local_mean), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = self._sync_grads(grads)
+        params, opt_state = self.optimizer.apply(
+            params, grads, opt_state, decay_mask=self._decay_mask(params))
+        # (1, 1) per shard -> (dp*ep, sp) global: each shard's chunk mean.
+        return params, opt_state, local_mean.reshape(1, 1)
+
+    def put_batch(self, inputs, targets):
+        inputs = np.ascontiguousarray(inputs, np.int32)
+        targets = np.ascontiguousarray(targets, np.int32)
+        b, L = inputs.shape
+        if b % (self.dp * self.ep):
+            raise ValueError(f"batch {b} not divisible by dp*ep="
+                             f"{self.dp * self.ep}")
+        if L % self.sp:
+            raise ValueError(f"seq len {L} not divisible by sp={self.sp}")
+        return (jax.device_put(inputs, self._batch_sharding),
+                jax.device_put(targets, self._batch_sharding))
+
+
+class PipelineLMTrainer(_MeshTrainer):
     """GPipe-style pipeline engine over a dp x pp (x tp) mesh.
 
     The layer stack shards into ``pp`` stages (stacked block params,
@@ -165,6 +223,11 @@ class PipelineLMTrainer:
         if mesh.shape[SEQ_AXIS] != 1:
             raise ValueError("PipelineLMTrainer does not compose with "
                              "sequence parallelism (sp must be 1)")
+        if mesh.shape.get(EXPERT_AXIS, 1) != 1:
+            raise ValueError("PipelineLMTrainer does not compose with "
+                             "expert parallelism (ep must be 1); MoE "
+                             "models do run under pp, with experts "
+                             "stage-local")
         if model.num_layers % self.pp:
             raise ValueError(f"num_layers={model.num_layers} not "
                              f"divisible by pp={self.pp}")
@@ -176,23 +239,25 @@ class PipelineLMTrainer:
         self._param_specs = pipeline_param_specs(model)
         self._opt_specs = self.optimizer.state_specs(self._param_specs)
         self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
-        self._param_shardings = jax.tree.map(
-            lambda s: NamedSharding(mesh, s), self._param_specs,
-            is_leaf=_is_spec)
-        self._opt_shardings = jax.tree.map(
-            lambda s: NamedSharding(mesh, s), self._opt_specs,
-            is_leaf=_is_spec)
-        self._train_step = self._build_train_step()
+        self._param_shardings = self._shardings(self._param_specs)
+        self._opt_shardings = self._shardings(self._opt_specs)
+        self._train_step = self._compile_step(P(DATA_AXIS), P(DATA_AXIS))
 
     def init_state(self, seed: int = 0) -> LMTrainState:
         """Same seed -> same parameters as the dense model, re-laid-out:
         blocks stacked on a leading layer axis, sharded over pp."""
         from tpu_ddp.parallel.pipeline import stack_block_params
         params = stack_block_params(self.model.init(jax.random.key(seed)))
-        opt_state = self.optimizer.init(params)
-        params = jax.device_put(params, self._param_shardings)
-        opt_state = jax.device_put(opt_state, self._opt_shardings)
-        return LMTrainState(params=params, opt_state=opt_state)
+        return self._place_state(params, self.optimizer.init(params))
+
+    def _decay_mask(self, params):
+        """Evaluate the optimizer's decay policy on the ORIGINAL per-layer
+        leaf shapes: stacking raised every block leaf's rank by one, which
+        would otherwise weight-decay the (num_layers, dm) LayerNorm
+        scales/biases that the dense trainer exempts."""
+        proto = dict(params)
+        proto["blocks"] = jax.tree.map(lambda p: p[0], params["blocks"])
+        return self.optimizer.decay_mask(proto)
 
     def _sync_grads(self, grads):
         """Stacked block leaves are stage-local (mean over dp only);
@@ -221,22 +286,12 @@ class PipelineLMTrainer:
         (_, local_mean), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         grads = self._sync_grads(grads)
-        params, opt_state = self.optimizer.apply(params, grads, opt_state)
+        params, opt_state = self.optimizer.apply(
+            params, grads, opt_state, decay_mask=self._decay_mask(params))
         # Real chunk mean lives on the last stage; share it with everyone
         # (outside the differentiated path).
         mean = lax.psum(local_mean, PIPE_AXIS)
         return params, opt_state, mean.reshape(1)
-
-    def _build_train_step(self):
-        mapped = jax.shard_map(
-            self._base_step,
-            mesh=self.mesh,
-            in_specs=(self._param_specs, self._opt_specs, P(DATA_AXIS),
-                      P(DATA_AXIS)),
-            out_specs=(self._param_specs, self._opt_specs, P(DATA_AXIS)),
-            check_vma=False,
-        )
-        return jax.jit(mapped, donate_argnums=(0, 1))
 
     def put_batch(self, inputs, targets):
         inputs = np.ascontiguousarray(inputs, np.int32)
@@ -247,8 +302,3 @@ class PipelineLMTrainer:
                              f"{self.dp * self.num_micro}")
         return (jax.device_put(inputs, self._batch_sharding),
                 jax.device_put(targets, self._batch_sharding))
-
-    def train_step(self, state: LMTrainState, inputs, targets):
-        params, opt_state, loss = self._train_step(
-            state.params, state.opt_state, inputs, targets)
-        return LMTrainState(params, opt_state, state.step + 1), loss
