@@ -217,23 +217,19 @@ class TransformerLM:
         residual stream stays replicated, with one ``psum`` after each of
         the two row-parallel projections.
         """
+        return self.apply_with_aux(params, tokens)[0]
+
+    def apply_with_aux(self, params, tokens):
+        """Like :meth:`apply`, additionally returning the mean Switch
+        load-balance auxiliary loss over MoE blocks (0.0 when dense).
+        This is the single full-forward implementation — :meth:`apply`
+        is its aux-discarding wrapper, so validation lives here once."""
         cd = self.compute_dtype
-        b, lc = tokens.shape
+        lc = tokens.shape[1]
         if lc * self.sp_size > self.max_seq_len:
             raise ValueError(
                 f"global sequence length {lc * self.sp_size} (local {lc} x "
                 f"sp {self.sp_size}) exceeds max_seq_len={self.max_seq_len}")
-        pos = self._positions(lc)
-        x = params["embed"][tokens].astype(cd)          # (B, L, dm)
-        for blk in params["blocks"]:
-            x = self.block_apply(blk, x, pos)
-        return self.head_apply(params, x)
-
-    def apply_with_aux(self, params, tokens):
-        """Like :meth:`apply`, additionally returning the mean Switch
-        load-balance auxiliary loss over MoE blocks (0.0 when dense)."""
-        cd = self.compute_dtype
-        lc = tokens.shape[1]
         pos = self._positions(lc)
         x = params["embed"][tokens].astype(cd)
         aux = jnp.float32(0.0)

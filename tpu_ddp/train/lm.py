@@ -107,6 +107,75 @@ class _MeshTrainer:
             state.params, state.opt_state, inputs, targets)
         return LMTrainState(params, opt_state, state.step + 1), loss
 
+    def _put_sharded(self, array, sharding):
+        """Place a host array: single process puts the global batch;
+        multi process assembles each process's shard into a global array
+        (same contract as the DP engine's put_batch,
+        tpu_ddp/train/engine.py)."""
+        if jax.process_count() == 1:
+            return jax.device_put(array, sharding)
+        return jax.make_array_from_process_local_data(sharding, array)
+
+    @staticmethod
+    def _global_batch(local_b: int) -> int:
+        """Divisibility constraints apply to the ASSEMBLED batch: in a
+        multi-process launch each process's put_batch sees only its own
+        shard of the batch axis."""
+        return local_b * jax.process_count()
+
+    # ---- checkpoint / resume (no reference equivalent, SURVEY.md §5) ---
+
+    def save_checkpoint(self, directory: str, state: LMTrainState,
+                        keep_last: int | None = None) -> str | None:
+        """Gather leaves to host LEAF BY LEAF (each gather is a collective
+        all processes must enter), then process 0 writes. Per-leaf keeps
+        the transient device-memory peak at one leaf's replicated size —
+        a whole-tree replication would materialize the full params +
+        optimizer state on every device at once, OOMing exactly the
+        tp/pp/ZeRO-sharded models that needed sharding to fit."""
+        gathered = self._gather_to_host((state.params, state.opt_state))
+        if jax.process_index() != 0:
+            return None
+        from tpu_ddp.utils import checkpoint as ckpt
+        params, opt_state = gathered
+        tree = {"params": params, "opt_state": opt_state,
+                "step": np.int64(state.step)}
+        return ckpt.save_checkpoint(directory, tree, step=state.step,
+                                    keep_last=keep_last)
+
+    def restore_checkpoint(self, directory: str,
+                           step: int | None = None) -> LMTrainState:
+        """Load a checkpoint (latest by default) and re-place every leaf
+        in its spec's sharding, as :meth:`init_state` does."""
+        from tpu_ddp.utils import checkpoint as ckpt
+        shapes = jax.eval_shape(
+            lambda: (lambda s: {"params": s.params,
+                                "opt_state": s.opt_state})(
+                self.init_state()))
+        template = {**shapes, "step": np.int64(0)}
+        restored, _ = ckpt.restore_checkpoint(directory, template, step)
+        placed = self._place_state(restored["params"],
+                                   restored["opt_state"])
+        return LMTrainState(params=placed.params,
+                            opt_state=placed.opt_state,
+                            step=int(restored["step"]))
+
+    def _gather_to_host(self, tree):
+        cached = getattr(self, "_gather_leaf_fn", None)
+        if cached is None:
+            repl = NamedSharding(self.mesh, P())
+            cached = jax.jit(lambda x: x, out_shardings=repl)
+            self._gather_leaf_fn = cached
+        writer = jax.process_index() == 0
+
+        def leaf(x):
+            g = cached(x)
+            host = np.asarray(g) if writer else None
+            g.delete()  # free the replicated copy before the next leaf
+            return host
+
+        return jax.tree.map(leaf, tree)
+
 
 class LMTrainer(_MeshTrainer):
     """Wires a TransformerLM + AdamW into a dp x sp x tp x ep sharded
@@ -193,13 +262,14 @@ class LMTrainer(_MeshTrainer):
         inputs = np.ascontiguousarray(inputs, np.int32)
         targets = np.ascontiguousarray(targets, np.int32)
         b, L = inputs.shape
-        if b % (self.dp * self.ep):
-            raise ValueError(f"batch {b} not divisible by dp*ep="
+        gb = self._global_batch(b)
+        if gb % (self.dp * self.ep):
+            raise ValueError(f"global batch {gb} not divisible by dp*ep="
                              f"{self.dp * self.ep}")
         if L % self.sp:
             raise ValueError(f"seq len {L} not divisible by sp={self.sp}")
-        return (jax.device_put(inputs, self._batch_sharding),
-                jax.device_put(targets, self._batch_sharding))
+        return (self._put_sharded(inputs, self._batch_sharding),
+                self._put_sharded(targets, self._batch_sharding))
 
 
 class PipelineLMTrainer(_MeshTrainer):
@@ -297,8 +367,9 @@ class PipelineLMTrainer(_MeshTrainer):
         inputs = np.ascontiguousarray(inputs, np.int32)
         targets = np.ascontiguousarray(targets, np.int32)
         b = inputs.shape[0]
-        if b % (self.dp * self.num_micro):
-            raise ValueError(f"batch {b} not divisible by dp*num_micro="
-                             f"{self.dp * self.num_micro}")
-        return (jax.device_put(inputs, self._batch_sharding),
-                jax.device_put(targets, self._batch_sharding))
+        gb = self._global_batch(b)
+        if gb % (self.dp * self.num_micro):
+            raise ValueError(f"global batch {gb} not divisible by "
+                             f"dp*num_micro={self.dp * self.num_micro}")
+        return (self._put_sharded(inputs, self._batch_sharding),
+                self._put_sharded(targets, self._batch_sharding))
