@@ -1,0 +1,123 @@
+"""Flash attention Pallas kernel: exact vs the jnp reference — values and
+gradients, causal and not, lane-aligned and padded shapes — plus the
+model-level use_flash path.
+
+Runs in Pallas interpreter mode on the CPU test platform; the same code
+compiles on TPU (tpu_ddp/ops/pallas/__init__.py:interpret_mode).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.ops.pallas import flash_attention
+from tpu_ddp.parallel.ring_attention import full_attention
+
+
+def _qkv(key, b=1, L=128, h=2, d=128):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, L, h, d), jnp.float32)
+                 for k in ks)
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_aligned_matches_reference(self, causal):
+        q, k, v = _qkv(jax.random.key(0))
+        got = flash_attention(q, k, v, causal)
+        want = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("L,d", [(100, 64), (130, 32), (48, 16)])
+    def test_padded_shapes_match(self, L, d):
+        """Sequence/head dims needing padding to the 128 block."""
+        q, k, v = _qkv(jax.random.key(1), L=L, d=d)
+        got = flash_attention(q, k, v, True)
+        want = full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-5, atol=3e-5)
+
+    def test_multi_block_sequence(self):
+        """L spanning several 128-blocks exercises the online-softmax
+        state across kv sweep steps."""
+        q, k, v = _qkv(jax.random.key(2), L=384, d=32)
+        got = flash_attention(q, k, v, True)
+        want = full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-5, atol=3e-5)
+
+    def test_bfloat16(self):
+        q, k, v = (x.astype(jnp.bfloat16)
+                   for x in _qkv(jax.random.key(3), L=64, d=64))
+        got = flash_attention(q, k, v, True)
+        want = full_attention(q, k, v, causal=True)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=2e-2, atol=2e-2)
+
+
+class TestFlashBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("L,d", [(128, 128), (100, 32)])
+    def test_grads_match_reference(self, causal, L, d):
+        q, k, v = _qkv(jax.random.key(4), L=L, d=d)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+                err_msg=f"d{name} causal={causal} L={L} d={d}")
+
+    def test_mixed_dtype_differentiable(self):
+        """Cotangent dtypes must match each primal's own dtype
+        (regression: dk/dv once inherited q's dtype)."""
+        q, k, v = _qkv(jax.random.key(6), L=64, d=32)
+        q = q.astype(jnp.bfloat16)
+        k = k.astype(jnp.bfloat16)  # v stays float32
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True)
+                           .astype(jnp.float32) ** 2)
+
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        assert dq.dtype == jnp.bfloat16
+        assert dk.dtype == jnp.bfloat16
+        assert dv.dtype == jnp.float32
+
+    def test_multi_block_grads(self):
+        q, k, v = _qkv(jax.random.key(5), L=256, d=32)
+        gf = jax.grad(lambda *a: jnp.sum(
+            flash_attention(*a, True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: jnp.sum(
+            full_attention(*a, causal=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-4)
+
+
+class TestModelIntegration:
+    def test_use_flash_matches_dense_model(self):
+        from tpu_ddp.models.transformer import make_transformer
+        tokens = jnp.asarray(np.random.default_rng(0).integers(
+            0, 1024, size=(2, 32)))
+        base = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                compute_dtype=jnp.float32)
+        flash = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32,
+                                 use_flash=True)
+        params = base.init(jax.random.key(0))
+        want = base.apply(params, tokens)
+        got = flash.apply(params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)

@@ -90,6 +90,10 @@ class TransformerLM:
     # Expert parallelism: mesh axis name/extent the expert axis shards on.
     ep_axis: str | None = None
     ep_size: int = 1
+    # Use the Pallas flash-attention kernel for non-sp attention
+    # (tpu_ddp/ops/pallas/flash_attention.py); the sp>1 path always uses
+    # ring attention.
+    use_flash: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -264,7 +268,7 @@ class TransformerLM:
         k = rope(qkv[:, :, 1], pos)
         v = qkv[:, :, 2]
         o = attend(q, k, v, causal=True, axis_name=self.sp_axis,
-                   axis_size=self.sp_size)
+                   axis_size=self.sp_size, flash=self.use_flash)
         # Row-parallel output projection: partial sums psum'd over tp.
         wo = blk["wo"].astype(cd).reshape(h_loc * hd, self.d_model)
         o = self._tp_out(jnp.dot(

@@ -11,6 +11,8 @@ Pallas:
                     multi-op elementwise chain).
 - :mod:`bn_relu`  — fused BatchNorm(batch-stats)+ReLU forward/backward
                     with a custom VJP.
+- :mod:`flash_attention` — flash attention forward/backward: O(L·D) HBM
+                    traffic instead of the O(L²) score matrix.
 
 Every kernel runs compiled on TPU and falls back to interpreter mode on
 CPU (tests force the host platform, conftest.py), selected automatically.
@@ -28,5 +30,7 @@ def interpret_mode() -> bool:
 
 from tpu_ddp.ops.pallas.sgd import fused_sgd_step  # noqa: E402
 from tpu_ddp.ops.pallas.bn_relu import batch_norm_relu  # noqa: E402
+from tpu_ddp.ops.pallas.flash_attention import flash_attention  # noqa: E402
 
-__all__ = ["interpret_mode", "fused_sgd_step", "batch_norm_relu"]
+__all__ = ["interpret_mode", "fused_sgd_step", "batch_norm_relu",
+           "flash_attention"]

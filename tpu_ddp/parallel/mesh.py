@@ -66,3 +66,13 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def put_sharded(array, sharding: NamedSharding):
+    """Place a host array into ``sharding``: a single process puts the
+    global array; in a multi-process launch each process contributes its
+    LOCAL shard and the pieces assemble into one global array. The one
+    placement rule both train engines share."""
+    if jax.process_count() == 1:
+        return jax.device_put(array, sharding)
+    return jax.make_array_from_process_local_data(sharding, array)

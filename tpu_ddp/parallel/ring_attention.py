@@ -117,8 +117,9 @@ def full_attention(q, k, v, causal: bool = False):
 
 
 def attend(q, k, v, *, causal: bool = False, axis_name: str | None = None,
-           axis_size: int | None = None):
-    """Dispatch: ring attention when a sequence axis is given, else full."""
+           axis_size: int | None = None, flash: bool = False):
+    """Dispatch: ring attention when a sequence axis is given, else the
+    flash Pallas kernel (``flash=True``) or the jnp reference."""
     if axis_name is not None:
         if axis_size is None:
             # Falling back to full_attention here would silently compute
@@ -129,4 +130,7 @@ def attend(q, k, v, *, causal: bool = False, axis_name: str | None = None,
         if axis_size > 1:
             return ring_attention(q, k, v, axis_name, axis_size,
                                   causal=causal)
+    if flash:
+        from tpu_ddp.ops.pallas import flash_attention
+        return flash_attention(q, k, v, causal)
     return full_attention(q, k, v, causal=causal)

@@ -281,15 +281,10 @@ class Trainer:
             images = np.concatenate([images, images[sel]])
             labels = np.concatenate([labels, labels[sel]])
             weights = np.concatenate([weights, np.zeros(pad, np.float32)])
-        if jax.process_count() == 1:
-            return (jax.device_put(images, self._batch_sharding),
-                    jax.device_put(labels, self._batch_sharding),
-                    jax.device_put(weights, self._batch_sharding))
-        return (
-            jax.make_array_from_process_local_data(self._batch_sharding, images),
-            jax.make_array_from_process_local_data(self._batch_sharding, labels),
-            jax.make_array_from_process_local_data(self._batch_sharding, weights),
-        )
+        from tpu_ddp.parallel.mesh import put_sharded
+        return (put_sharded(images, self._batch_sharding),
+                put_sharded(labels, self._batch_sharding),
+                put_sharded(weights, self._batch_sharding))
 
     # ---- epoch loop (reference train_model, part1/main.py:52-93) -------
 

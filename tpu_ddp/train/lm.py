@@ -108,13 +108,8 @@ class _MeshTrainer:
         return LMTrainState(params, opt_state, state.step + 1), loss
 
     def _put_sharded(self, array, sharding):
-        """Place a host array: single process puts the global batch;
-        multi process assembles each process's shard into a global array
-        (same contract as the DP engine's put_batch,
-        tpu_ddp/train/engine.py)."""
-        if jax.process_count() == 1:
-            return jax.device_put(array, sharding)
-        return jax.make_array_from_process_local_data(sharding, array)
+        from tpu_ddp.parallel.mesh import put_sharded
+        return put_sharded(array, sharding)
 
     @staticmethod
     def _global_batch(local_b: int) -> int:
