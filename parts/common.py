@@ -138,17 +138,22 @@ def run_part(part: str, argv=None):
     trainer = Trainer(model, cfg, strategy=PART_TO_STRATEGY[part], mesh=mesh,
                       metrics=metrics_from_env(rank=rank))
     start_epoch = 0
+    start_iter = 0
     if args.resume:
         state = trainer.restore_checkpoint(args.ckpt_dir)
-        # Derive where to pick up: checkpoints are written at epoch ends,
-        # so completed epochs = step / iters-per-epoch. Training then
-        # continues to the requested --epochs total (not N more).
+        # Derive where to pick up from the restored step: completed
+        # epochs = step // iters-per-epoch, and a MID-epoch checkpoint
+        # (ckpt_every_iters > 0) additionally places the run step %
+        # iters-per-epoch batches into its epoch — those are skipped so
+        # no batch is double-trained and step accounting stays exact.
         iters_per_epoch = len(train_loader)
         if cfg.max_iters is not None:
             iters_per_epoch = min(iters_per_epoch, cfg.max_iters)
-        start_epoch = state.step // max(iters_per_epoch, 1)
+        iters_per_epoch = max(iters_per_epoch, 1)
+        start_epoch = state.step // iters_per_epoch
+        start_iter = state.step % iters_per_epoch
         print(f"[{part}] resumed from {args.ckpt_dir} at step {state.step} "
-              f"(epoch {start_epoch})")
+              f"(epoch {start_epoch}, iter {start_iter})")
     else:
         state = trainer.init_state()
 
@@ -161,9 +166,14 @@ def run_part(part: str, argv=None):
         train_loader.set_epoch(epoch)
         # Deep profiling (TPU_DDP_PROFILE_DIR): trace the first epoch.
         with profile_trace(profile_dir_from_env() if epoch == 0 else None):
-            state, stats = trainer.train_epoch(state, train_loader,
-                                               epoch=epoch)
-        if args.ckpt_dir:
+            state, stats = trainer.train_epoch(
+                state, train_loader, epoch=epoch, ckpt_dir=args.ckpt_dir,
+                start_iter=start_iter if epoch == start_epoch else 0)
+        # Epoch-end checkpoint — unless the in-loop cadence just wrote
+        # this exact step (avoids a duplicate write and, under ZeRO, a
+        # duplicate optimizer-state gather collective).
+        if args.ckpt_dir and not (cfg.ckpt_every_iters and state.step
+                                  % cfg.ckpt_every_iters == 0):
             path = trainer.save_checkpoint(args.ckpt_dir, state)
             if path:
                 print(f"[{part}] checkpoint saved: {path}")

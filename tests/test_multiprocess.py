@@ -118,3 +118,43 @@ def test_cli_surface():
     assert out.returncode == 0
     for part in PARTS:
         assert part in out.stdout
+
+
+@pytest.mark.slow
+def test_elastic_restart_resumes_from_checkpoint(tmp_path):
+    """Fault injection kills every rank at step 2; the elastic launcher
+    respawns the cluster, which resumes from the step-2 mid-epoch
+    checkpoint and finishes (SURVEY.md §5: the reference has no failure
+    handling at all — a dead rank hangs its cluster)."""
+    from tpu_ddp.launch import launch_elastic
+
+    env = dict(SMOKE_ENV)
+    env.update({
+        "TPU_DDP_CKPT_EVERY": "1",       # checkpoint every step
+        "TPU_DDP_FAIL_AT_STEP": "2",     # crash (exit 13) at step 2
+    })
+    res = launch_elastic(
+        "part3", nproc=2, max_restarts=1, echo=False, timeout=900,
+        extra_args=["--ckpt-dir", str(tmp_path)], env=env)
+    assert res.ok, "\n".join(w.output for w in res.workers)
+    assert res.restarts == 1
+    out0 = res.output_of(0)
+    assert "resumed from" in out0
+    assert "Test set: average loss" in out0
+
+
+@pytest.mark.slow
+def test_elastic_gives_up_after_max_restarts(tmp_path):
+    """A fault that fires before any checkpoint exists cannot be resumed
+    past; the launcher must stop after max_restarts and surface the
+    injected exit code, not loop forever."""
+    from tpu_ddp.launch import launch_elastic
+    from tpu_ddp.utils.invariants import FAULT_EXIT_CODE
+
+    env = dict(SMOKE_ENV)
+    env.update({"TPU_DDP_FAIL_AT_STEP": "1"})  # no --ckpt-dir -> no resume
+    res = launch_elastic("part2b", nproc=2, max_restarts=1, echo=False,
+                         timeout=900, env=env)
+    assert not res.ok
+    assert res.restarts == 1
+    assert res.returncode == FAULT_EXIT_CODE

@@ -56,6 +56,9 @@ class LaunchResult:
     # Exit code of the FIRST rank observed failing — the root cause, not
     # the -9 of bystander ranks reaped afterwards. 0 when all succeeded.
     first_failure: int = 0
+    # Number of cluster restarts performed before this (final) attempt —
+    # nonzero only for launch_elastic.
+    restarts: int = 0
 
     @property
     def returncode(self) -> int:
@@ -201,6 +204,48 @@ def launch(
     return result
 
 
+def launch_elastic(
+    part: str,
+    nproc: int,
+    max_restarts: int = 0,
+    extra_args: list | None = None,
+    **kwargs,
+) -> LaunchResult:
+    """:func:`launch` with elastic recovery — the failure-handling layer
+    the reference lacks entirely (SURVEY.md §5: a dead gloo rank just
+    hangs the cluster). On failure the whole cluster is respawned (fresh
+    coordinator port) up to ``max_restarts`` times; when the part was
+    given a ``--ckpt-dir`` and a checkpoint exists, retries append
+    ``--resume`` so training continues from the last saved step instead
+    of restarting from scratch.
+    """
+    extra = list(extra_args or [])
+    ckpt_dir = None
+    for idx, tok in enumerate(extra):
+        if tok == "--ckpt-dir":
+            if idx + 1 >= len(extra):
+                raise ValueError("--ckpt-dir requires a value")
+            ckpt_dir = extra[idx + 1]
+        elif tok.startswith("--ckpt-dir="):
+            ckpt_dir = tok.split("=", 1)[1]
+    res = None
+    for attempt in range(max_restarts + 1):
+        args = list(extra)
+        if attempt > 0 and ckpt_dir and "--resume" not in args:
+            from tpu_ddp.utils.checkpoint import latest_step
+            if latest_step(ckpt_dir) is not None:
+                args.append("--resume")
+        if attempt > 0:
+            print(f"[launch] attempt {attempt + 1}/{max_restarts + 1} "
+                  f"(resume={'--resume' in args})", flush=True)
+            kwargs.pop("port", None)  # fresh coordinator port per attempt
+        res = launch(part, nproc, extra_args=args, **kwargs)
+        res.restarts = attempt
+        if res.ok:
+            break
+    return res
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m tpu_ddp.launch",
@@ -215,12 +260,19 @@ def main(argv=None) -> int:
                    help="forced CPU device count per worker (cpu only)")
     p.add_argument("--port", type=int, default=None,
                    help="coordinator port (default: pick a free one)")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="respawn the cluster up to N times on failure, "
+                        "resuming from --ckpt-dir when possible")
     args, extra = p.parse_known_args(argv)
-    res = launch(args.part, args.nproc, extra_args=extra,
-                 platform=args.platform,
-                 devices_per_proc=args.devices_per_proc, port=args.port)
+    res = launch_elastic(args.part, args.nproc,
+                         max_restarts=args.max_restarts, extra_args=extra,
+                         platform=args.platform,
+                         devices_per_proc=args.devices_per_proc,
+                         port=args.port)
     for w in res.workers:
         print(f"[launch] rank {w.rank} exited {w.returncode}")
+    if res.restarts:
+        print(f"[launch] recovered after {res.restarts} restart(s)")
     return res.returncode
 
 

@@ -294,7 +294,13 @@ class Trainer:
         batches,
         epoch: int = 0,
         log: Callable[[str], None] = print,
+        ckpt_dir: str | None = None,
+        start_iter: int = 0,
     ) -> tuple[TrainState, dict]:
+        """``start_iter`` > 0 skips that many leading batches — the
+        mid-epoch resume path (the checkpoint's step places the run
+        ``step % iters_per_epoch`` batches into its epoch; replaying the
+        prefix would double-train those examples and inflate step)."""
         cfg = self.config
         timer = IterationTimer(cfg.timing_first_iter, cfg.timing_last_iter)
         running_loss = 0.0
@@ -311,6 +317,8 @@ class Trainer:
         for it, item in enumerate(stream):
             if cfg.max_iters is not None and it >= cfg.max_iters:
                 break
+            if it < start_iter:
+                continue
             timer.start()
             x, y, w = item if use_prefetch else self.put_batch(*item)
             state, loss = self.train_step(state, x, y, w)
@@ -341,6 +349,18 @@ class Trainer:
                 running_loss = 0.0
             if it == cfg.timing_last_iter:
                 log(timer.report(prefix=f"[epoch {epoch}] "))
+            # Aux subsystems (no reference equivalent — SURVEY.md §5):
+            # mid-epoch checkpoints, replica-invariant check, fault hook.
+            if (ckpt_dir and cfg.ckpt_every_iters
+                    and state.step % cfg.ckpt_every_iters == 0):
+                self.save_checkpoint(ckpt_dir, state)
+            if (cfg.check_replicas_every and self.mesh is not None
+                    and state.step % cfg.check_replicas_every == 0):
+                from tpu_ddp.utils.invariants import \
+                    check_replica_consistency
+                check_replica_consistency(state.params)
+            from tpu_ddp.utils.invariants import maybe_inject_failure
+            maybe_inject_failure(state.step)
         self.metrics.log("epoch", epoch=epoch, iters=n_iters,
                          avg_iter_s=timer.average_s,
                          last_loss=round(last_loss, 5))

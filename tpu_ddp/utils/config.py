@@ -73,6 +73,13 @@ class TrainConfig:
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
     max_iters: int | None = None
+    # Mid-epoch checkpoint cadence in steps (0 = epoch ends only); env
+    # TPU_DDP_CKPT_EVERY. Enables resume after mid-epoch failures
+    # (tpu_ddp/launch.py:launch_elastic).
+    ckpt_every_iters: int = 0
+    # Replica-consistency check cadence in steps (0 = off); env
+    # TPU_DDP_CHECK_REPLICAS_EVERY (tpu_ddp/utils/invariants.py).
+    check_replicas_every: int = 0
 
     def __post_init__(self):
         if self.max_iters is None:
@@ -89,6 +96,12 @@ class TrainConfig:
         env_pf = os.environ.get("TPU_DDP_PREFETCH")
         if env_pf:
             self.device_prefetch = int(env_pf)
+        env_ck = os.environ.get("TPU_DDP_CKPT_EVERY")
+        if env_ck:
+            self.ckpt_every_iters = int(env_ck)
+        env_rc = os.environ.get("TPU_DDP_CHECK_REPLICAS_EVERY")
+        if env_rc:
+            self.check_replicas_every = int(env_rc)
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.

@@ -1,0 +1,128 @@
+"""Runtime correctness invariants + fault injection.
+
+The reference's correctness rests on two invariants stated in its report
+(SURVEY.md §1 L1): (i) identical parameter init on every node, (ii)
+identical parameter updates via gradient sync. It has no machinery to
+CHECK them — a silent sync bug (the data-parallel analogue of a data
+race) shows up only as a mysteriously bad loss curve. This module makes
+the invariant checkable at runtime, plus a deterministic fault-injection
+hook for exercising failure/restart paths (the reference has neither —
+SURVEY.md §5 "Race detection: Absent", "Failure detection: Absent").
+
+- :func:`replica_divergence` — per-leaf maximum absolute difference
+  between device copies of replicated arrays: local shards are compared
+  directly; across processes a per-leaf digest is all-gathered and
+  compared. Zero everywhere iff every replica holds identical values.
+- :func:`check_replica_consistency` — raises ``ReplicaDivergenceError``
+  naming the worst leaf when divergence exceeds ``atol``. The engine
+  calls it every ``check_replicas_every`` steps when configured.
+- :func:`maybe_inject_failure` — kills the process with exit code 13
+  when the configured global step is reached (``TPU_DDP_FAIL_AT_STEP``),
+  used by the elastic-restart tests (tpu_ddp/launch.py:launch_elastic).
+  Replayed runs that resume PAST the step do not re-fire, so a
+  checkpointed run crashes exactly once.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+FAULT_EXIT_CODE = 13
+
+
+class ReplicaDivergenceError(RuntimeError):
+    pass
+
+
+def _leaf_paths(tree):
+    import jax.tree_util as jtu
+    return [(jtu.keystr(path), leaf)
+            for path, leaf in jtu.tree_flatten_with_path(tree)[0]]
+
+
+def _bitwise_digest(arr: np.ndarray) -> np.uint64:
+    """First 8 bytes of sha256 over the raw array bytes: equal iff (with
+    overwhelming probability) the arrays are bitwise equal — a sum-style
+    digest would miss divergences that preserve the sum (e.g. two
+    swapped elements)."""
+    import hashlib
+    h = hashlib.sha256(np.ascontiguousarray(arr).tobytes()).digest()
+    return np.frombuffer(h[:8], dtype=np.uint64)[0]
+
+
+def replica_divergence(tree) -> dict:
+    """{leaf path: max abs divergence} over replicated leaves.
+
+    Local device copies are compared element-wise (the values feed the
+    ``atol`` tolerance); ACROSS processes the comparison is a bitwise
+    digest — any cross-process difference reports ``inf`` (a tolerance
+    cannot be evaluated without shipping whole arrays between hosts).
+    Non-replicated (sharded) leaves are skipped — each device
+    legitimately holds different values there.
+    """
+    out = {}
+    digests = []
+    names = []
+    for name, leaf in _leaf_paths(tree):
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        if not getattr(leaf, "is_fully_replicated", False):
+            continue
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        worst = 0.0
+        for s in shards[1:]:
+            worst = max(worst,
+                        float(np.max(np.abs(s - shards[0]))) if s.size
+                        else 0.0)
+        out[name] = worst
+        digests.append(_bitwise_digest(shards[0]))
+        names.append(name)
+    if jax.process_count() > 1 and digests:
+        from jax.experimental import multihost_utils
+        all_digests = np.asarray(multihost_utils.process_allgather(
+            np.asarray(digests, np.uint64)))
+        for col, name in enumerate(names):
+            if len(np.unique(all_digests[:, col])) > 1:
+                out[name] = float("inf")
+    return out
+
+
+def check_replica_consistency(tree, atol: float = 0.0) -> dict:
+    """Raise :class:`ReplicaDivergenceError` if any replicated leaf's
+    copies differ by more than ``atol``; returns the divergence map."""
+    div = replica_divergence(tree)
+    bad = {k: v for k, v in div.items() if v > atol}
+    if bad:
+        worst = max(bad, key=bad.get)
+        raise ReplicaDivergenceError(
+            f"replica divergence on {len(bad)} leaves; worst "
+            f"{worst}: {bad[worst]:.3e} (invariant (ii) of the reference "
+            f"report: replicas must hold identical parameters)")
+    return div
+
+
+def maybe_inject_failure(step: int) -> None:
+    """Deterministic crash at a configured global step.
+
+    ``TPU_DDP_FAIL_AT_STEP=N``: when ``step == N``, print a marker and
+    hard-exit with :data:`FAULT_EXIT_CODE`. A run resumed from a
+    checkpoint at step >= N never reaches equality again, so the fault
+    fires exactly once per training history. ``TPU_DDP_FAIL_RANK``
+    (default 0) picks the process that dies; the default is the
+    checkpoint-writing process, which crashes only AFTER its step-N save
+    completed — so a mid-epoch checkpoint at the crash step is always
+    on disk. (Killing a non-writer instead races the launcher's reap of
+    the writer against the writer's in-flight save.)
+    """
+    at = os.environ.get("TPU_DDP_FAIL_AT_STEP")
+    if at is None or step != int(at):
+        return
+    rank = int(os.environ.get("TPU_DDP_FAIL_RANK", "0"))
+    if jax.process_index() != rank:
+        return
+    print(f"[fault-injection] killing process {jax.process_index()} at "
+          f"step {step}", flush=True)
+    os._exit(FAULT_EXIT_CODE)
