@@ -97,6 +97,57 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
     }
 
 
+def run_lm_bench(batch_size: int = 8, seq_len: int = 2048,
+                 timed_iters: int = 20) -> dict:
+    """Transformer-LM training throughput (tokens/sec) on one chip, with
+    the flash-attention Pallas kernel (tpu_ddp/ops/pallas). Not the
+    headline metric (the reference has no LM workload to baseline
+    against); selected via TPU_DDP_BENCH_CONFIG=transformer_lm."""
+    import jax
+
+    from tpu_ddp.models import make_transformer
+    from tpu_ddp.parallel.mesh import make_mesh
+    from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+    from tpu_ddp.utils.timing import IterationTimer
+
+    model = make_transformer("TransformerLM-small", max_seq_len=seq_len,
+                             use_flash=True)
+    trainer = LMTrainer(model, make_mesh(jax.devices()[:1]))
+    state = trainer.init_state()
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, model.vocab_size,
+                          size=(batch_size, seq_len + 1))
+    x, y = trainer.put_batch(*make_lm_batch(tokens))
+
+    timer = IterationTimer(first_iter=1, last_iter=timed_iters)
+    for it in range(timed_iters + 1):
+        timer.start()
+        state, loss = trainer.train_step(state, x, y)
+        jax.block_until_ready(loss)
+        timer.stop(it)
+
+    toks_per_sec = batch_size * seq_len / timer.average_s
+    return {
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": round(toks_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "extra": {
+            "avg_iter_s": round(timer.average_s, 6),
+            "batch_size": batch_size,
+            "seq_len": seq_len,
+            "model": model.name,
+            "flash_attention": True,
+            "platform": jax.devices()[0].platform,
+            "baseline": "no reference LM workload exists (SURVEY.md §5)",
+        },
+    }
+
+
 if __name__ == "__main__":
-    result = run_bench()
+    import os as _os
+    if _os.environ.get("TPU_DDP_BENCH_CONFIG") == "transformer_lm":
+        result = run_lm_bench()
+    else:
+        result = run_bench()
     print(json.dumps(result))

@@ -68,6 +68,34 @@ class TestReplicaConsistency:
 
 
 class TestTrainerIntegration:
+    def test_resume_skip_accounting(self, devices):
+        """start_iter skips batches without counting them as trained:
+        stats report only the iterations this run actually performed."""
+        from tpu_ddp.models import get_model
+        from tpu_ddp.train.engine import Trainer
+        from tpu_ddp.utils.config import TrainConfig
+
+        rng = np.random.default_rng(1)
+        batch = (rng.normal(size=(4, 32, 32, 3)).astype(np.float32),
+                 rng.integers(0, 10, size=4).astype(np.int32))
+        cfg = TrainConfig(global_batch_size=4, log_every=2)
+        tr = Trainer(get_model("VGG11", compute_dtype=np.float32), cfg,
+                     strategy="fused", mesh=make_mesh(devices[:4]))
+        state = tr.init_state()
+        state, stats = tr.train_epoch(state, [batch] * 3, start_iter=2,
+                                      log=lambda *_: None)
+        assert stats["iters"] == 1  # 2 of 3 skipped
+        assert state.step == 1
+
+    def test_fault_sentinel_suppresses_refire(self, tmp_path, monkeypatch):
+        from tpu_ddp.utils.invariants import maybe_inject_failure
+
+        sentinel = tmp_path / "fired"
+        sentinel.write_text("fired at step 2\n")
+        monkeypatch.setenv("TPU_DDP_FAIL_AT_STEP", "2")
+        monkeypatch.setenv("TPU_DDP_FAIL_SENTINEL", str(sentinel))
+        maybe_inject_failure(2)  # would os._exit(13) without the sentinel
+
     def test_engine_check_passes_on_healthy_run(self, devices):
         from tpu_ddp.models import get_model
         from tpu_ddp.train.engine import Trainer

@@ -219,6 +219,8 @@ def launch_elastic(
     ``--resume`` so training continues from the last saved step instead
     of restarting from scratch.
     """
+    if max_restarts < 0:
+        raise ValueError("max_restarts must be >= 0")
     extra = list(extra_args or [])
     ckpt_dir = None
     for idx, tok in enumerate(extra):

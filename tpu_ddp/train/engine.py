@@ -304,8 +304,14 @@ class Trainer:
         cfg = self.config
         timer = IterationTimer(cfg.timing_first_iter, cfg.timing_last_iter)
         running_loss = 0.0
+        window_n = 0
         last_loss = 0.0
         n_iters = 0
+        # Advance past the resumed prefix BEFORE prefetch wraps the
+        # stream, so skipped batches are never processed or transferred.
+        if start_iter:
+            import itertools
+            batches = itertools.islice(iter(batches), start_iter, None)
         # With device_prefetch > 0 upcoming batches' transfers are already
         # in flight when the step runs (tpu_ddp/data/prefetch.py); the
         # timer still brackets the same loop body as the reference
@@ -314,11 +320,9 @@ class Trainer:
         stream = prefetch_to_device(batches, self.put_batch,
                                     cfg.device_prefetch) \
             if use_prefetch else batches
-        for it, item in enumerate(stream):
+        for it, item in enumerate(stream, start=start_iter):
             if cfg.max_iters is not None and it >= cfg.max_iters:
                 break
-            if it < start_iter:
-                continue
             timer.start()
             x, y, w = item if use_prefetch else self.put_batch(*item)
             state, loss = self.train_step(state, x, y, w)
@@ -336,17 +340,22 @@ class Trainer:
             else:
                 local_loss = float(loss)
             running_loss += local_loss
+            window_n += 1
             last_loss = local_loss
-            n_iters = it + 1
+            n_iters += 1
             # Loss print cadence: every 20 mini-batches
-            # (reference part1/main.py:82-84).
+            # (reference part1/main.py:82-84). Divide by the iterations
+            # actually in the window — after a mid-epoch resume the first
+            # window is shorter than log_every.
             if it % cfg.log_every == cfg.log_every - 1:
+                window_loss = running_loss / max(window_n, 1)
                 log(f"[epoch {epoch}, iter {it + 1}] "
-                    f"loss: {running_loss / cfg.log_every:.3f}")
+                    f"loss: {window_loss:.3f}")
                 self.metrics.log("train_iter", epoch=epoch, iter=it + 1,
                                  step=state.step,
-                                 loss=round(running_loss / cfg.log_every, 5))
+                                 loss=round(window_loss, 5))
                 running_loss = 0.0
+                window_n = 0
             if it == cfg.timing_last_iter:
                 log(timer.report(prefix=f"[epoch {epoch}] "))
             # Aux subsystems (no reference equivalent — SURVEY.md §5):

@@ -108,14 +108,18 @@ def maybe_inject_failure(step: int) -> None:
     """Deterministic crash at a configured global step.
 
     ``TPU_DDP_FAIL_AT_STEP=N``: when ``step == N``, print a marker and
-    hard-exit with :data:`FAULT_EXIT_CODE`. A run resumed from a
-    checkpoint at step >= N never reaches equality again, so the fault
-    fires exactly once per training history. ``TPU_DDP_FAIL_RANK``
+    hard-exit with :data:`FAULT_EXIT_CODE`. ``TPU_DDP_FAIL_RANK``
     (default 0) picks the process that dies; the default is the
     checkpoint-writing process, which crashes only AFTER its step-N save
     completed — so a mid-epoch checkpoint at the crash step is always
     on disk. (Killing a non-writer instead races the launcher's reap of
     the writer against the writer's in-flight save.)
+
+    One-shot guarantee: a resumed run re-fires whenever its checkpoint
+    cadence left the restored step BELOW N (it replays step N). Set
+    ``TPU_DDP_FAIL_SENTINEL=/path`` to make the fault strictly
+    once-per-history regardless of cadence: the file is created before
+    dying and suppresses any later firing.
     """
     at = os.environ.get("TPU_DDP_FAIL_AT_STEP")
     if at is None or step != int(at):
@@ -123,6 +127,12 @@ def maybe_inject_failure(step: int) -> None:
     rank = int(os.environ.get("TPU_DDP_FAIL_RANK", "0"))
     if jax.process_index() != rank:
         return
+    sentinel = os.environ.get("TPU_DDP_FAIL_SENTINEL")
+    if sentinel:
+        if os.path.exists(sentinel):
+            return
+        with open(sentinel, "w") as f:
+            f.write(f"fired at step {step}\n")
     print(f"[fault-injection] killing process {jax.process_index()} at "
           f"step {step}", flush=True)
     os._exit(FAULT_EXIT_CODE)
