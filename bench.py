@@ -37,9 +37,12 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
 
     # Headline = the reference ladder's config; TPU_DDP_BENCH_CONFIG=
     # resnet50_imagenet runs the BASELINE.json stretch scale-up instead
-    # (no reference number exists for it -> vs_baseline is null).
+    # (no reference number exists for it -> vs_baseline is null), and
+    # transformer_lm dispatches to the LM tokens/sec bench.
     config = config or os.environ.get("TPU_DDP_BENCH_CONFIG",
                                       "vgg11_cifar10")
+    if config == "transformer_lm":
+        return run_lm_bench()
     cfg = TrainConfig.preset(config)
     if batch_size is None:
         batch_size = cfg.global_batch_size
@@ -145,9 +148,5 @@ def run_lm_bench(batch_size: int = 8, seq_len: int = 2048,
 
 
 if __name__ == "__main__":
-    import os as _os
-    if _os.environ.get("TPU_DDP_BENCH_CONFIG") == "transformer_lm":
-        result = run_lm_bench()
-    else:
-        result = run_bench()
+    result = run_bench()
     print(json.dumps(result))

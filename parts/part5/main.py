@@ -1,0 +1,23 @@
+"""Part 5 — FSDP / ZeRO-3: full parameter sharding, the ladder's top rung.
+
+Part 4 sharded the optimizer state; part 5 shards the PARAMETERS too
+(tpu_ddp/parallel/zero.py:ZeRO3): at rest each data-parallel worker
+holds 1/N of every tensor. The forward all_gathers each leaf on demand;
+the backward's transpose of that gather IS the gradient reduce_scatter —
+the sync falls out of the chain rule. Per-device memory for params +
+optimizer state drops from O(3P) (part3) to O(3P/N).
+
+Launch (per node):
+  python parts/part5/main.py --num-nodes N [--rank R --master-ip IP --master-port P]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from common import run_part  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(run_part("part5"))

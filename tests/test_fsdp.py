@@ -1,0 +1,93 @@
+"""FSDP / ZeRO-3 (part5): parameters sharded 1/N at rest, numerically
+equivalent to the fused rung, checkpoint round-trips, eval works from
+shards.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.models import get_model
+from tpu_ddp.parallel.mesh import DATA_AXIS, make_mesh
+from tpu_ddp.train.engine import Trainer
+from tpu_ddp.utils.config import TrainConfig
+from jax.sharding import PartitionSpec as P
+
+
+def _batch(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(n, 32, 32, 3)).astype(np.float32),
+            rng.integers(0, 10, size=n).astype(np.int32))
+
+
+def _trainer(devices, strategy, dp=4):
+    mesh = make_mesh(devices[:dp])
+    model = get_model("VGG11", compute_dtype=np.float32)
+    return Trainer(model, TrainConfig(), strategy=strategy, mesh=mesh)
+
+
+class TestFSDPEquivalence:
+    def test_steps_match_fused(self, devices):
+        """Three part5 steps produce the same model as part3 — verified
+        through the materialized (reassembled) parameters."""
+        x, y = _batch()
+        fused = _trainer(devices, "fused")
+        fs = _trainer(devices, "fsdp")
+        s_f = fused.init_state()
+        s_z = fs.init_state()
+        xb, yb, wb = fused.put_batch(x, y)
+        xz, yz, wz = fs.put_batch(x, y)
+        for _ in range(3):
+            s_f, l_f = fused.train_step(s_f, xb, yb, wb)
+            s_z, l_z = fs.train_step(s_z, xz, yz, wz)
+        np.testing.assert_allclose(np.asarray(l_z), np.asarray(l_f),
+                                   rtol=1e-4, atol=1e-5)
+        full = jax.device_get(fs._materialize_params(s_z.params))
+        want = jax.device_get(s_f.params)
+        for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(full)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=2e-4, atol=1e-5)
+
+    def test_params_actually_sharded(self, devices):
+        """At rest every leaf is flat and 1/dp per device — the memory
+        property that IS the point of FSDP."""
+        tr = _trainer(devices, "fsdp", dp=4)
+        state = tr.init_state()
+        for leaf in jax.tree.leaves(state.params):
+            assert leaf.ndim == 1
+            assert leaf.sharding.spec == P(DATA_AXIS)
+            assert leaf.addressable_shards[0].data.size == leaf.size // 4
+        for leaf in jax.tree.leaves(state.opt_state):
+            assert leaf.sharding.spec == P(DATA_AXIS)
+
+    def test_eval_from_shards(self, devices):
+        tr = _trainer(devices, "fsdp", dp=4)
+        state = tr.init_state()
+        x, y = _batch(n=8)
+        out = tr.evaluate(state, [(x, y)], log=lambda *_: None)
+        assert 0.0 <= out["test_accuracy"] <= 1.0
+        assert np.isfinite(out["test_loss"])
+
+    def test_checkpoint_roundtrip(self, devices, tmp_path):
+        tr = _trainer(devices, "fsdp", dp=4)
+        state = tr.init_state()
+        x, y = _batch()
+        xb, yb, wb = tr.put_batch(x, y)
+        state, _ = tr.train_step(state, xb, yb, wb)
+        path = tr.save_checkpoint(str(tmp_path), state)
+        assert path is not None
+        restored = tr.restore_checkpoint(str(tmp_path))
+        assert restored.step == state.step
+        # Restored shards land back in the dp-sharded flat layout.
+        leaf = jax.tree.leaves(restored.params)[0]
+        assert leaf.sharding.spec == P(DATA_AXIS)
+        s1, l1 = tr.train_step(state, xb, yb, wb)
+        s2, l2 = tr.train_step(restored, xb, yb, wb)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=1e-6)
+
+    def test_requires_mesh(self):
+        model = get_model("VGG11", compute_dtype=np.float32)
+        with pytest.raises(ValueError, match="mesh"):
+            Trainer(model, TrainConfig(), strategy="fsdp", mesh=None)

@@ -59,6 +59,21 @@ def test_two_process_part3_fused():
 
 
 @pytest.mark.slow
+def test_two_process_part5_fsdp():
+    """FSDP rung across REAL process boundaries: parameters live as
+    per-process shards; the in-step all_gather and its reduce_scatter
+    transpose span two jax.distributed processes."""
+    res = launch("part5", nproc=2, env=SMOKE_ENV, echo=False, timeout=600)
+    assert res.ok, "\n".join(w.output for w in res.workers)
+    for rank in (0, 1):
+        assert "strategy=fsdp" in res.output_of(rank)
+        assert "Test set: average loss" in res.output_of(rank)
+    line0 = [l for l in res.output_of(0).splitlines() if "Test set" in l]
+    line1 = [l for l in res.output_of(1).splitlines() if "Test set" in l]
+    assert line0 == line1
+
+
+@pytest.mark.slow
 def test_two_process_part4_zero():
     """ZeRO rung across REAL process boundaries: the reduce_scatter +
     all_gather pair and the dp-sharded optimizer state span two

@@ -33,7 +33,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 PARTS_DIR = Path(__file__).resolve().parent.parent / "parts"
-PARTS = ("part1", "part2a", "part2b", "part3", "part4")
+PARTS = ("part1", "part2a", "part2b", "part3", "part4", "part5")
 
 
 def find_free_port() -> int:

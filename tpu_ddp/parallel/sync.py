@@ -100,6 +100,11 @@ SYNC_STRATEGIES = {
     # unsynced and the engine wraps the optimizer in ZeRO1
     # (tpu_ddp/parallel/zero.py), so the grads->grads hook is identity.
     "zero": sync_none,
+    # FSDP/ZeRO-3: the gradient reduce_scatter is the TRANSPOSE of the
+    # forward's parameter all_gather — autodiff performs the sync, so
+    # the grads->grads hook is again identity (tpu_ddp/parallel/zero.py
+    # ZeRO3).
+    "fsdp": sync_none,
 }
 
 # The reference parts, by name. "part4" extends the ladder beyond the
@@ -112,6 +117,7 @@ PART_TO_STRATEGY = {
     "part2b": "all_reduce",
     "part3": "fused",
     "part4": "zero",
+    "part5": "fsdp",
 }
 
 

@@ -74,20 +74,29 @@ class Trainer:
             weight_decay=self.config.weight_decay,
             use_pallas=self.config.pallas_sgd,
         )
+        self.is_fsdp = canonical_strategy(strategy) == "fsdp"
+        self._dp = mesh.shape[DATA_AXIS] if mesh is not None else 1
         if self.is_zero:
             if mesh is None:
                 raise ValueError("strategy 'zero' shards optimizer state "
                                  "over the dp axis and requires a mesh")
             from tpu_ddp.parallel.zero import ZeRO1
-            self.optimizer = ZeRO1(self.optimizer, DATA_AXIS,
-                                   mesh.shape[DATA_AXIS])
+            self.optimizer = ZeRO1(self.optimizer, DATA_AXIS, self._dp)
+        if self.is_fsdp:
+            if mesh is None:
+                raise ValueError("strategy 'fsdp' shards parameters over "
+                                 "the dp axis and requires a mesh")
+            from tpu_ddp.parallel.zero import ZeRO3
+            template = jax.eval_shape(
+                lambda: self.model.init(jax.random.key(0)))
+            self.zero3 = ZeRO3(self.optimizer, DATA_AXIS, self._dp,
+                               template=template)
         if mesh is not None:
             self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
             self._repl_sharding = NamedSharding(mesh, P())
-            if self.is_zero:
-                # Compiled once; reused by every checkpoint save.
-                self._gather_opt_state = jax.jit(
-                    lambda t: t, out_shardings=self._repl_sharding)
+            self._param_put_sharding = (
+                NamedSharding(mesh, P(DATA_AXIS)) if self.is_fsdp
+                else self._repl_sharding)
         self._train_step = self._build_train_step()
         self._eval_step = jax.jit(self._eval_step_impl)
 
@@ -95,8 +104,16 @@ class Trainer:
 
     def _opt_spec(self):
         """shard_map prefix spec for the optimizer state: replicated for
-        the replicated strategies, dp-sharded flat leaves under ZeRO."""
+        the replicated strategies, dp-sharded flat leaves under ZeRO and
+        FSDP."""
+        if self.is_fsdp:
+            return self.zero3.state_specs()
         return self.optimizer.state_specs(P())
+
+    def _param_spec(self):
+        """shard_map prefix spec for the parameters: flat dp shards
+        under FSDP, replicated otherwise."""
+        return P(DATA_AXIS) if self.is_fsdp else P()
 
     def _opt_shardings(self, opt_state):
         """Broadcast the prefix spec over the concrete state tree."""
@@ -109,12 +126,18 @@ class Trainer:
     def init_state(self, seed: int | None = None) -> TrainState:
         """Parameter init from the shared seed — correctness invariant (i)
         of the reference (seed 89395 on every node, part1/main.py:115-117):
-        every replica deterministically builds identical parameters."""
+        every replica deterministically builds identical parameters.
+        Under FSDP the full tree is flattened and each worker keeps its
+        1/N shard of every leaf."""
         seed = self.config.seed if seed is None else seed
         params = self.model.init(jax.random.key(seed))
-        opt_state = self.optimizer.init(params)
+        if self.is_fsdp:
+            params = self.zero3.shard_params(params)
+            opt_state = self.zero3.init(params)
+        else:
+            opt_state = self.optimizer.init(params)
         if self.mesh is not None:
-            params = jax.device_put(params, self._repl_sharding)
+            params = jax.device_put(params, self._param_put_sharding)
             opt_state = jax.device_put(opt_state,
                                        self._opt_shardings(opt_state))
         return TrainState(params=params, opt_state=opt_state)
@@ -125,16 +148,22 @@ class Trainer:
                         keep_last: int | None = None) -> str | None:
         """Write ``state`` at its step; only process 0 writes (state under
         DP is replicated). Returns the path (None on non-zero processes)."""
+        params = state.params
         opt_state = state.opt_state
-        if self.mesh is not None and self.is_zero:
-            # ZeRO shards the optimizer state over dp; gather it to a
-            # replicated layout BEFORE the process-0 gate — the gather is
-            # a collective every process must enter.
-            opt_state = self._gather_opt_state(opt_state)
+        if self.mesh is not None and (self.is_zero or self.is_fsdp):
+            # ZeRO/FSDP shard state over dp; gather to host LEAF BY LEAF
+            # before the process-0 gate (each gather is a collective
+            # every process must enter; per-leaf keeps the device-memory
+            # peak at one replicated leaf, not the whole state tree).
+            from tpu_ddp.utils.checkpoint import gather_tree_to_host
+            opt_state = gather_tree_to_host(opt_state,
+                                            self._repl_sharding)
+            if self.is_fsdp:
+                params = gather_tree_to_host(params, self._repl_sharding)
         if jax.process_index() != 0:
             return None
         from tpu_ddp.utils import checkpoint as ckpt
-        tree = {"params": state.params, "opt_state": opt_state,
+        tree = {"params": params, "opt_state": opt_state,
                 "step": np.int64(state.step)}
         return ckpt.save_checkpoint(directory, tree, step=state.step,
                                     keep_last=keep_last)
@@ -152,7 +181,7 @@ class Trainer:
         restored, _ = ckpt.restore_checkpoint(directory, template, step)
         params, opt_state = restored["params"], restored["opt_state"]
         if self.mesh is not None:
-            params = jax.device_put(params, self._repl_sharding)
+            params = jax.device_put(params, self._param_put_sharding)
             opt_state = jax.device_put(opt_state,
                                        self._opt_shardings(opt_state))
         return TrainState(params=params, opt_state=opt_state,
@@ -177,34 +206,51 @@ class Trainer:
             return (x - jnp.asarray(mean)) / jnp.asarray(std)
         return images
 
+    def _loss_terms(self, logits, labels, weights):
+        """(loss_for_grad, local_mean) for a (possibly wrap-padded) local
+        batch. ``weights`` is 1.0 for real examples, 0.0 for padding
+        added by :meth:`put_batch`. The differentiated loss is scaled so
+        that mean-of-replica-gradients == the gradient of the GLOBAL
+        batch-mean loss regardless of padding: per replica we use
+        ``R * sum(w*l) / total`` where ``total = psum(sum(w))`` — the
+        mean over R replicas then telescopes to ``sum_all(l)/total``.
+        With equal unpadded shards this reduces to the plain local batch
+        mean, i.e. the reference's semantics
+        (part2/part2b/main.py:124-132) exactly."""
+        per_ex = softmax_cross_entropy(logits, labels)
+        wsum = jnp.sum(weights * per_ex)
+        n_local = jnp.sum(weights)
+        if self.mesh is not None:
+            n_total = lax.psum(n_local, DATA_AXIS)
+            n_replicas = lax.psum(1.0, DATA_AXIS)
+            loss_for_grad = n_replicas * wsum / n_total
+        else:
+            loss_for_grad = wsum / jnp.maximum(n_local, 1.0)
+        local_mean = wsum / jnp.maximum(n_local, 1.0)
+        return loss_for_grad, local_mean
+
     def _base_step(self, params, opt_state, images, labels, weights):
-        """One step over (possibly wrap-padded) local batch.
-
-        ``weights`` is 1.0 for real examples, 0.0 for padding added by
-        :meth:`put_batch` to satisfy even sharding. The differentiated loss
-        is scaled so that mean-of-replica-gradients == the gradient of the
-        GLOBAL batch-mean loss regardless of padding: per replica we use
-        ``R * sum(w*l) / total`` where ``total = psum(sum(w))`` — the mean
-        over R replicas then telescopes to ``sum_all(l)/total``. With equal
-        unpadded shards this reduces to the plain local batch mean, i.e. the
-        reference's semantics (part2/part2b/main.py:124-132) exactly.
-        """
-
         images = self._maybe_normalize(images)
 
+        if self.is_fsdp:
+            def loss_fn(flat):
+                # all_gather materializes full params transiently; its
+                # AD transpose reduce-scatters the cotangent, delivering
+                # this worker's SUMMED gradient shard directly.
+                p = self.zero3.gather_params(flat)
+                return self._loss_terms(self.model.apply(p, images),
+                                        labels, weights)
+
+            (_, loss), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            # psum_scatter summed over workers; recover the replica mean.
+            grads = jax.tree.map(lambda g: g / float(self._dp), grads)
+            params, opt_state = self.zero3.apply(params, grads, opt_state)
+            return params, opt_state, loss
+
         def loss_fn(p):
-            logits = self.model.apply(p, images)
-            per_ex = softmax_cross_entropy(logits, labels)
-            wsum = jnp.sum(weights * per_ex)
-            n_local = jnp.sum(weights)
-            if self.mesh is not None:
-                n_total = lax.psum(n_local, DATA_AXIS)
-                n_replicas = lax.psum(1.0, DATA_AXIS)
-                loss_for_grad = n_replicas * wsum / n_total
-            else:
-                loss_for_grad = wsum / jnp.maximum(n_local, 1.0)
-            local_mean = wsum / jnp.maximum(n_local, 1.0)
-            return loss_for_grad, local_mean
+            return self._loss_terms(self.model.apply(p, images),
+                                    labels, weights)
 
         (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         # Under ZeRO sync_fn is the identity: the optimizer's own
@@ -227,12 +273,13 @@ class Trainer:
             return params, opt_state, loss.reshape(1)
 
         opt_spec = self._opt_spec()
+        param_spec = self._param_spec()
         mapped = jax.shard_map(
             sharded_body,
             mesh=self.mesh,
-            in_specs=(P(), opt_spec, P(DATA_AXIS), P(DATA_AXIS),
+            in_specs=(param_spec, opt_spec, P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS)),
-            out_specs=(P(), opt_spec, P(DATA_AXIS)),
+            out_specs=(param_spec, opt_spec, P(DATA_AXIS)),
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
@@ -365,9 +412,19 @@ class Trainer:
                 self.save_checkpoint(ckpt_dir, state)
             if (cfg.check_replicas_every and self.mesh is not None
                     and state.step % cfg.check_replicas_every == 0):
-                from tpu_ddp.utils.invariants import \
-                    check_replica_consistency
-                check_replica_consistency(state.params)
+                if self.is_fsdp:
+                    # FSDP has NO replicated parameter leaves — there is
+                    # no redundancy to cross-check, and silently passing
+                    # would fake coverage. Warn once and skip.
+                    if not getattr(self, "_warned_fsdp_check", False):
+                        self._warned_fsdp_check = True
+                        log("[invariants] check_replicas_every has no "
+                            "replicated leaves to check under fsdp; "
+                            "skipping")
+                else:
+                    from tpu_ddp.utils.invariants import \
+                        check_replica_consistency
+                    check_replica_consistency(state.params)
             from tpu_ddp.utils.invariants import maybe_inject_failure
             maybe_inject_failure(state.step)
         self.metrics.log("epoch", epoch=epoch, iters=n_iters,
@@ -390,6 +447,22 @@ class Trainer:
         # part1/main.py:108) + top-1 correct count.
         return cross_entropy_loss(logits, labels), top1_correct(logits, labels)
 
+    def _materialize_params(self, params):
+        """FSDP: reassemble the flat dp shards into full replicated
+        leaves for evaluation (XLA inserts the gather); identity for all
+        other strategies."""
+        if not self.is_fsdp:
+            return params
+        fn = getattr(self, "_materialize_fn", None)
+        if fn is None:
+            meta = self.zero3.meta
+            fn = jax.jit(
+                lambda t: jax.tree.map(
+                    lambda x, m: x[:m.size].reshape(m.shape), t, meta),
+                out_shardings=self._repl_sharding)
+            self._materialize_fn = fn
+        return fn(params)
+
     def evaluate(
         self,
         state: TrainState,
@@ -403,13 +476,14 @@ class Trainer:
         correct = 0
         seen = 0
         n_batches = 0
+        eval_params = self._materialize_params(state.params)
         for images, labels in batches:
             if self.mesh is not None:
                 images = jax.device_put(images, self._repl_sharding)
                 labels = jax.device_put(labels, self._repl_sharding)
             else:
                 images, labels = jnp.asarray(images), jnp.asarray(labels)
-            loss, corr = self._eval_step(state.params, images, labels)
+            loss, corr = self._eval_step(eval_params, images, labels)
             total_loss += float(loss)
             correct += int(corr)
             seen += int(labels.shape[0])

@@ -156,20 +156,8 @@ class _MeshTrainer:
                             step=int(restored["step"]))
 
     def _gather_to_host(self, tree):
-        cached = getattr(self, "_gather_leaf_fn", None)
-        if cached is None:
-            repl = NamedSharding(self.mesh, P())
-            cached = jax.jit(lambda x: x, out_shardings=repl)
-            self._gather_leaf_fn = cached
-        writer = jax.process_index() == 0
-
-        def leaf(x):
-            g = cached(x)
-            host = np.asarray(g) if writer else None
-            g.delete()  # free the replicated copy before the next leaf
-            return host
-
-        return jax.tree.map(leaf, tree)
+        from tpu_ddp.utils.checkpoint import gather_tree_to_host
+        return gather_tree_to_host(tree, NamedSharding(self.mesh, P()))
 
 
 class LMTrainer(_MeshTrainer):

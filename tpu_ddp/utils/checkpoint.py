@@ -21,6 +21,7 @@ resume. Design:
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import re
@@ -75,6 +76,32 @@ def save_checkpoint(directory: str, state, step: int,
             shutil.rmtree(os.path.join(directory, f"step_{step_i:08d}"),
                           ignore_errors=True)
     return final
+
+
+@functools.lru_cache(maxsize=8)
+def _replicating_identity(sharding):
+    return jax.jit(lambda x: x, out_shardings=sharding)
+
+
+def gather_tree_to_host(tree, repl_sharding):
+    """Gather a (possibly sharded) tree to host memory LEAF BY LEAF.
+
+    Each leaf's gather is a collective all processes must enter; doing it
+    per leaf keeps the transient device-memory peak at ONE replicated
+    leaf rather than the whole tree — the difference between a checkpoint
+    and an OOM for ZeRO/FSDP-sharded state. Returns host numpy arrays on
+    process 0 and a None-leaved tree elsewhere.
+    """
+    fn = _replicating_identity(repl_sharding)
+    writer = jax.process_index() == 0
+
+    def leaf(x):
+        g = fn(x)
+        host = np.asarray(g) if writer else None
+        g.delete()  # free the replicated copy before the next leaf
+        return host
+
+    return jax.tree_util.tree_map(leaf, tree)
 
 
 def all_steps(directory: str) -> list[int]:
