@@ -31,6 +31,18 @@ def _tokens(b=4, L=33, seed=11):
     return rng.integers(0, 1024, size=(b, L))
 
 
+def _one_moe_step(devices, dp, ep, tokens, **model_kw):
+    """One SGD step of a MoE LM on a dp x ep mesh; returns (params,
+    mean loss). Shared by the top-1 and top-2 equivalence tests."""
+    model = _moe(**model_kw)
+    mesh = make_mesh(devices[:dp * ep], dp=dp, sp=1, mp=1, pp=1, ep=ep)
+    tr = LMTrainer(model, mesh, optimizer=_sgd())
+    state = tr.init_state(seed=3)
+    x, y = tr.put_batch(*make_lm_batch(tokens))
+    state, loss = tr.train_step(state, x, y)
+    return jax.device_get(state.params), float(np.mean(np.asarray(loss)))
+
+
 class TestSwitchRouting:
     def test_dispatch_shapes_and_capacity(self):
         logits = jnp.asarray(np.random.default_rng(0).normal(
@@ -49,6 +61,60 @@ class TestSwitchRouting:
         logits = jnp.zeros((8, 4), jnp.float32)
         _, _, aux = switch_route(logits, 4, capacity=8)
         assert abs(float(aux) - 1.0) < 1e-5
+
+
+class TestTopKRouting:
+    def _route(self, T=16, E=4, C=32, k=2, seed=0):
+        from tpu_ddp.parallel.moe import topk_route
+        logits = jnp.asarray(np.random.default_rng(seed).normal(
+            size=(T, E)).astype(np.float32))
+        return topk_route(logits, E, C, top_k=k)
+
+    def test_top2_two_assignments_per_token(self):
+        dispatch, combine, aux = self._route()
+        per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+        # Generous capacity: every token keeps both its choices.
+        np.testing.assert_array_equal(per_token, 2.0)
+        # Each (expert, slot) pair holds at most one token.
+        per_slot = np.asarray(jnp.sum(dispatch, axis=0))
+        assert per_slot.max() <= 1.0 + 1e-6
+        assert np.isfinite(float(aux))
+
+    def test_top2_gates_normalized(self):
+        dispatch, combine, _ = self._route()
+        # Kept tokens' combine weights sum to ~1 over their two slots.
+        w = np.asarray(jnp.sum(combine, axis=(1, 2)))
+        np.testing.assert_allclose(w, 1.0, rtol=1e-5)
+
+    def test_top1_reduces_to_switch(self):
+        from tpu_ddp.parallel.moe import switch_route, topk_route
+        logits = jnp.asarray(np.random.default_rng(3).normal(
+            size=(16, 4)).astype(np.float32))
+        d1, c1, a1 = switch_route(logits, 4, 8)
+        d2, c2, a2 = topk_route(logits, 4, 8, top_k=1)
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+        assert float(a1) == float(a2)
+
+    def test_top2_ep_sharded_step_matches_unsharded(self, devices):
+        """The ep equivalence holds for k=2 routing too."""
+        tokens = _tokens(seed=21)
+        ref_p, ref_loss = _one_moe_step(devices, 4, 1, tokens,
+                                        moe_top_k=2)
+        got_p, got_loss = _one_moe_step(devices, 1, 4, tokens,
+                                        moe_top_k=2)
+        assert abs(got_loss - ref_loss) < 1e-4
+        for a, b in zip(jax.tree.leaves(ref_p), jax.tree.leaves(got_p)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=3e-4, atol=3e-5)
+
+    def test_top_k_validation(self):
+        from tpu_ddp.parallel.moe import topk_route
+        logits = jnp.zeros((8, 4), jnp.float32)
+        with pytest.raises(ValueError, match="top_k"):
+            topk_route(logits, 4, 8, top_k=0)
+        with pytest.raises(ValueError, match="top_k"):
+            topk_route(logits, 4, 8, top_k=5)
 
 
 class TestMoEForward:
@@ -81,21 +147,11 @@ class TestMoEForward:
 
 
 class TestExpertParallelEquivalence:
-    def _one_step(self, devices, dp, ep, tokens):
-        model = _moe()
-        mesh = make_mesh(devices[:dp * ep], dp=dp, sp=1, mp=1, pp=1, ep=ep)
-        tr = LMTrainer(model, mesh, optimizer=_sgd())
-        state = tr.init_state(seed=3)
-        x, y = tr.put_batch(*make_lm_batch(tokens))
-        state, loss = tr.train_step(state, x, y)
-        return (jax.device_get(state.params),
-                float(np.mean(np.asarray(loss))))
-
     @pytest.mark.parametrize("dp,ep", [(1, 4), (2, 2), (1, 2)])
     def test_step_matches_unsharded(self, devices, dp, ep):
         tokens = _tokens()
-        ref_p, ref_loss = self._one_step(devices, dp * ep, 1, tokens)
-        got_p, got_loss = self._one_step(devices, dp, ep, tokens)
+        ref_p, ref_loss = _one_moe_step(devices, dp * ep, 1, tokens)
+        got_p, got_loss = _one_moe_step(devices, dp, ep, tokens)
         assert abs(got_loss - ref_loss) < 1e-4, (dp, ep)
         for a, b in zip(jax.tree.leaves(ref_p), jax.tree.leaves(got_p)):
             np.testing.assert_allclose(

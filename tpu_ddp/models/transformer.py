@@ -83,10 +83,12 @@ class TransformerLM:
     # Tensor parallelism: mesh axis name/extent block params are sharded on.
     tp_axis: str | None = None
     tp_size: int = 1
-    # Mixture of experts: when > 0 every block's MLP is a Switch top-1
-    # routed MoE with this many experts (tpu_ddp/parallel/moe.py).
+    # Mixture of experts: when > 0 every block's MLP is a routed MoE
+    # with this many experts (tpu_ddp/parallel/moe.py); top_k=1 is
+    # Switch routing, top_k=2 the GShard scheme.
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1
     # Expert parallelism: mesh axis name/extent the expert axis shards on.
     ep_axis: str | None = None
     ep_size: int = 1
@@ -282,6 +284,7 @@ class TransformerLM:
                 y, blk["router"], blk["w1"], blk["w2"],
                 num_experts=self.moe_experts,
                 capacity_factor=self.moe_capacity_factor,
+                top_k=self.moe_top_k,
                 ep_axis=self.ep_axis or "ep", ep_size=self._ep,
                 tp_in=self._tp_in, tp_out=self._tp_out)
             return x + y, aux

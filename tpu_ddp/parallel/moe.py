@@ -2,9 +2,10 @@
 
 No reference counterpart (the reference implements data parallelism only —
 SURVEY.md §2 "Absent parallelism strategies"); included because multi-axis
-model sharding is first-class in this framework. The layer is a top-1
-routed MoE MLP (Fedus et al., "Switch Transformers", arXiv:2101.03961 —
-reimplemented from the paper's routing algebra, not from any code),
+model sharding is first-class in this framework. The layer is a top-k
+routed MoE MLP — top-1 per Switch Transformers (Fedus et al.,
+arXiv:2101.03961), top-2 per GShard (Lepikhin et al., arXiv:2006.16668);
+both reimplemented from the papers' routing algebra, not from any code —
 expressed the SPMD way:
 
 - expert weights are STACKED on a leading expert axis and sharded over
@@ -13,10 +14,11 @@ expressed the SPMD way:
   tokens, builds a (tokens, experts, capacity) one-hot dispatch tensor,
   and two ``lax.all_to_all``s move token activations to their expert's
   host device and back — the ep-analogue of the pipeline's ppermute ring;
-- capacity is static: ``C = ceil(T/E * capacity_factor)`` slots per
-  expert per source device. Tokens beyond an expert's capacity are
-  dropped (their MLP branch contributes zero; the residual stream still
-  carries them) — the standard static-shape trade XLA needs;
+- capacity is static: ``C = ceil(T * capacity_factor * top_k / E)``
+  slots per expert per source device, shared by a token's k choices.
+  Assignments beyond an expert's capacity are dropped (that branch
+  contributes zero; the residual stream still carries the token) — the
+  standard static-shape trade XLA needs;
 - the router is differentiable through the combine weights (the chosen
   expert's probability scales its output), and the Switch auxiliary
   load-balancing loss ``E * Σ_e f_e·P_e`` is returned alongside so the
@@ -37,38 +39,77 @@ from jax import lax
 from tpu_ddp.parallel.mesh import EXPERT_AXIS
 
 
-def switch_route(router_logits, num_experts: int, capacity: int):
-    """Top-1 routing: (T, E) logits -> (dispatch, combine, aux).
+def topk_route(router_logits, num_experts: int, capacity: int,
+               top_k: int = 1):
+    """Top-k routing: (T, E) logits -> (dispatch, combine, aux).
 
-    ``dispatch``: (T, E, C) one-hot of (expert, slot) per kept token.
-    ``combine``: dispatch scaled by the router probability (differentiable
-    path into the router weights). ``aux``: Switch load-balance loss.
+    ``dispatch``: (T, E, C) one-hots of each kept token's (expert, slot)
+    assignments — up to ``top_k`` per token. ``combine``: dispatch scaled
+    by the router gates (the differentiable path into the router).
+    ``aux``: load-balance loss over the FIRST choice (the Switch form).
+
+    ``top_k == 1`` is Switch routing with the raw probability as gate;
+    ``top_k > 1`` is the GShard scheme (arXiv:2006.16668 — reimplemented
+    from the paper's algebra, not from any code): iterative argmax over
+    masked probabilities, gates renormalized over the chosen experts,
+    and later choices queue in an expert's capacity AFTER the slots the
+    earlier choices kept (so slots never collide).
     """
-    T = router_logits.shape[0]
+    if not 1 <= top_k <= num_experts:
+        raise ValueError(f"top_k={top_k} must be in [1, num_experts="
+                         f"{num_experts}] (beyond E the argmax of the "
+                         "fully-masked probabilities would silently "
+                         "re-route everything to expert 0)")
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                     # (T,)
-    onehot = jax.nn.one_hot(expert, num_experts,
+    remaining = probs
+    onehots, gates = [], []
+    for _ in range(top_k):
+        expert = jnp.argmax(remaining, axis=-1)             # (T,)
+        oh = jax.nn.one_hot(expert, num_experts,
                             dtype=jnp.float32)              # (T, E)
-    # Slot index of each token within its expert's queue, in token order.
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0         # (T, E)
-    kept = onehot * (pos < capacity)                        # (T, E)
-    slot = jax.nn.one_hot(jnp.sum(pos * kept, axis=-1).astype(jnp.int32),
-                          capacity, dtype=jnp.float32)      # (T, C)
-    dispatch = kept[:, :, None] * slot[:, None, :]          # (T, E, C)
-    gate = jnp.sum(probs * onehot, axis=-1)                 # (T,)
-    combine = lax.stop_gradient(dispatch) * gate[:, None, None]
-    # Load balance: fraction routed to e times mean router prob of e.
-    f = jnp.mean(onehot, axis=0)
+        onehots.append(oh)
+        gates.append(jnp.sum(probs * oh, axis=-1))          # (T,)
+        remaining = remaining * (1.0 - oh)
+    if top_k == 1:
+        weights = gates                                     # raw (Switch)
+    else:
+        denom = sum(gates) + 1e-9
+        weights = [g / denom for g in gates]                # normalized
+
+    base = jnp.zeros((num_experts,), jnp.float32)  # slots already taken
+    dispatch = jnp.zeros((router_logits.shape[0], num_experts, capacity),
+                         jnp.float32)
+    combine = dispatch
+    for oh, w in zip(onehots, weights):
+        # Slot of each token within its expert's queue, in token order,
+        # offset past the slots earlier choices kept.
+        pos = (jnp.cumsum(oh, axis=0) - 1.0 + base[None, :]) * oh
+        kept = oh * (pos < capacity)                        # (T, E)
+        slot = jax.nn.one_hot(
+            jnp.sum(pos * kept, axis=-1).astype(jnp.int32),
+            capacity, dtype=jnp.float32)                    # (T, C)
+        d = kept[:, :, None] * slot[:, None, :]             # (T, E, C)
+        dispatch = dispatch + d
+        combine = combine + lax.stop_gradient(d) * w[:, None, None]
+        base = base + jnp.sum(kept, axis=0)
+    # Load balance: fraction first-routed to e times mean prob of e.
+    f = jnp.mean(onehots[0], axis=0)
     p = jnp.mean(probs, axis=0)
     aux = num_experts * jnp.sum(f * p)
     return lax.stop_gradient(dispatch), combine, aux
 
 
+def switch_route(router_logits, num_experts: int, capacity: int):
+    """Top-1 (Switch) routing — see :func:`topk_route`."""
+    return topk_route(router_logits, num_experts, capacity, top_k=1)
+
+
 def moe_mlp(y, router_w, w1, w2, *, num_experts: int,
-            capacity_factor: float = 1.25, ep_axis: str = EXPERT_AXIS,
+            capacity_factor: float = 1.25, top_k: int = 1,
+            ep_axis: str = EXPERT_AXIS,
             ep_size: int = 1, activation=None,
             tp_in=None, tp_out=None):
-    """Switch MoE MLP: (B, L, dm) -> ((B, L, dm), aux).
+    """Top-k routed MoE MLP: (B, L, dm) -> ((B, L, dm), aux).
 
     ``w1``: (E_local, dm, dff_local), ``w2``: (E_local, dff_local, dm) —
     stacked expert weights, already sharded over ``ep`` (and optionally
@@ -82,14 +123,15 @@ def moe_mlp(y, router_w, w1, w2, *, num_experts: int,
     if e_loc * max(ep_size, 1) != E:
         raise ValueError(f"{w1.shape[0]} local experts x ep={ep_size} "
                          f"!= num_experts={E}")
-    cap = max(1, int(-(-T * capacity_factor // E)))
+    # top_k choices per token share the capacity budget.
+    cap = max(1, int(-(-T * capacity_factor * max(top_k, 1) // E)))
     act = activation or (lambda h: jax.nn.gelu(h.astype(jnp.float32)))
     cd = y.dtype
 
     x = y.reshape(T, dm)
     logits = jnp.dot(x, router_w.astype(cd),
                      preferred_element_type=jnp.float32)    # (T, E)
-    dispatch, combine, aux = switch_route(logits, E, cap)
+    dispatch, combine, aux = topk_route(logits, E, cap, top_k=top_k)
 
     # (T, E, C) x (T, dm) -> (E, C, dm): gather each expert's slot queue.
     expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cd), x,
