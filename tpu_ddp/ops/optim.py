@@ -47,6 +47,12 @@ class SGD:
         part1/main.py:124-125) — no mask needed."""
         return None
 
+    def map_param_like(self, state: SGDState, fn):
+        """Apply ``fn`` to each params-shaped subtree of the state
+        (ZeRO/FSDP re-layout hook); scalars would pass through unchanged
+        (SGD has none)."""
+        return {"momentum": fn(state["momentum"])}
+
     def _new_buf(self, p, g, buf):
         g = g.astype(p.dtype)
         if self.weight_decay:
@@ -111,6 +117,12 @@ class AdamW:
         """The decay policy, queryable by wrappers (ZeRO) that re-lay-out
         leaves and must evaluate it on the ORIGINAL shapes."""
         return jax.tree.map(lambda p: p.ndim >= 2, params)
+
+    def map_param_like(self, state, fn):
+        """Apply ``fn`` to each params-shaped subtree of the state
+        (ZeRO/FSDP re-layout hook); the step count passes through."""
+        return {"mu": fn(state["mu"]), "nu": fn(state["nu"]),
+                "count": state["count"]}
 
     def apply(self, params, grads, state, decay_mask=None):
         """``decay_mask``: optional bool pytree overriding the ndim>=2

@@ -58,7 +58,54 @@ class _LeafMeta:
             self.size *= int(d)
 
 
-class ZeRO1:
+class _FlatLayout:
+    """Shared flat-padded layout machinery: leaves flatten to
+    (ceil(size/N)*N,) and pad with zeros so every worker owns an equal
+    contiguous slice. ``self.meta`` (from a params template) is the
+    single source of truth for the original shapes, and makes the
+    checkpoint representation CANONICAL — flat layouts never reach disk,
+    so a checkpoint restores at any dp size or into a replicated
+    trainer."""
+
+    def _chunk(self, size: int) -> int:
+        return -(-size // self.axis_size)  # ceil div
+
+    def _require_meta(self):
+        if getattr(self, "meta", None) is None:
+            raise ValueError(f"{type(self).__name__} needs a params "
+                             "template for layout conversions")
+
+    def shard_params(self, params):
+        """Canonical-shape tree -> global flat padded tree (place with
+        ``P(dp)``); host-side at init/restore time. Deliberately numpy:
+        the full-size tree must stay HOST-resident until device_put
+        shards it — a jnp pad would commit every unsharded leaf to one
+        device first, the exact OOM FSDP exists to avoid."""
+        self._require_meta()
+
+        def flat(p, m):
+            pad = self._chunk(m.size) * self.axis_size - m.size
+            return np.pad(np.asarray(p).reshape(-1), (0, pad))
+        return jax.tree.map(flat, params, self.meta)
+
+    def unshard_host(self, host_tree):
+        """Host flat padded arrays -> canonical shapes (checkpoint
+        write path)."""
+        self._require_meta()
+        return jax.tree.map(
+            lambda x, m: np.asarray(x)[:m.size].reshape(m.shape),
+            host_tree, self.meta)
+
+    def canonicalize_opt_host(self, state):
+        """Flat host optimizer state -> canonical shapes per leaf."""
+        return self.inner.map_param_like(state, self.unshard_host)
+
+    def flatten_opt(self, state):
+        """Canonical optimizer state -> flat padded (restore path)."""
+        return self.inner.map_param_like(state, self.shard_params)
+
+
+class ZeRO1(_FlatLayout):
     """Wrap an elementwise optimizer; shard its state over ``axis_name``.
 
     ``init``/``state_specs`` run OUTSIDE shard_map (global view: every
@@ -68,15 +115,15 @@ class ZeRO1:
     """
 
     def __init__(self, inner, axis_name: str = DATA_AXIS,
-                 axis_size: int | None = None):
+                 axis_size: int | None = None, template=None):
         if axis_size is None or axis_size < 1:
             raise ValueError("ZeRO1 needs the static dp axis size")
         self.inner = inner
         self.axis_name = axis_name
         self.axis_size = axis_size
-
-    def _chunk(self, size: int) -> int:
-        return -(-size // self.axis_size)  # ceil div
+        # Optional: enables canonical checkpoint layout conversions.
+        self.meta = (jax.tree.map(_LeafMeta, template)
+                     if template is not None else None)
 
     def init(self, params):
         """Global flat state: inner state over (padded_size,) zero leaves."""
@@ -128,7 +175,7 @@ class ZeRO1:
         return jax.tree.map(reassemble, params, new_p_sh), new_state
 
 
-class ZeRO3:
+class ZeRO3(_FlatLayout):
     """Fully-sharded parameters — FSDP / ZeRO stage 3 (part5).
 
     One step beyond :class:`ZeRO1`: PARAMETERS (not just optimizer state)
@@ -163,19 +210,6 @@ class ZeRO3:
         # Shape/dtype per leaf, wrapped in an unregistered type so the
         # metadata rides pytrees as LEAVES; rank drives the decay policy.
         self.meta = jax.tree.map(_LeafMeta, template)
-
-    def _chunk(self, size: int) -> int:
-        return -(-size // self.axis_size)
-
-    def shard_params(self, params):
-        """GLOBAL full tree -> global flat padded tree (place with
-        ``P(dp)``); runs on host at init/restore time. Sizes come from
-        ``self.meta`` — the single source of truth for the flat layout
-        (``gather_params`` slices with the same values)."""
-        def flat(p, m):
-            pad = self._chunk(m.size) * self.axis_size - m.size
-            return jnp.pad(jnp.asarray(p).reshape(-1), (0, pad))
-        return jax.tree.map(flat, params, self.meta)
 
     def init(self, flat_params):
         return self.inner.init(flat_params)

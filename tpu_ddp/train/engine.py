@@ -81,16 +81,15 @@ class Trainer:
                 raise ValueError("strategy 'zero' shards optimizer state "
                                  "over the dp axis and requires a mesh")
             from tpu_ddp.parallel.zero import ZeRO1
-            self.optimizer = ZeRO1(self.optimizer, DATA_AXIS, self._dp)
+            self.optimizer = ZeRO1(self.optimizer, DATA_AXIS, self._dp,
+                                   template=self._params_template())
         if self.is_fsdp:
             if mesh is None:
                 raise ValueError("strategy 'fsdp' shards parameters over "
                                  "the dp axis and requires a mesh")
             from tpu_ddp.parallel.zero import ZeRO3
-            template = jax.eval_shape(
-                lambda: self.model.init(jax.random.key(0)))
             self.zero3 = ZeRO3(self.optimizer, DATA_AXIS, self._dp,
-                               template=template)
+                               template=self._params_template())
         if mesh is not None:
             self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
             self._repl_sharding = NamedSharding(mesh, P())
@@ -101,6 +100,10 @@ class Trainer:
         self._eval_step = jax.jit(self._eval_step_impl)
 
     # ---- state ---------------------------------------------------------
+
+    def _params_template(self):
+        """Abstract canonical-shape params tree (no compute)."""
+        return jax.eval_shape(lambda: self.model.init(jax.random.key(0)))
 
     def _opt_spec(self):
         """shard_map prefix spec for the optimizer state: replicated for
@@ -162,6 +165,13 @@ class Trainer:
                 params = gather_tree_to_host(params, self._repl_sharding)
         if jax.process_index() != 0:
             return None
+        # Checkpoints hold CANONICAL shapes, never the flat dp-padded
+        # layout — so they restore at any dp size or into any strategy.
+        if self.is_zero:
+            opt_state = self.optimizer.canonicalize_opt_host(opt_state)
+        if self.is_fsdp:
+            params = self.zero3.unshard_host(params)
+            opt_state = self.zero3.canonicalize_opt_host(opt_state)
         from tpu_ddp.utils import checkpoint as ckpt
         tree = {"params": params, "opt_state": opt_state,
                 "step": np.int64(state.step)}
@@ -171,15 +181,27 @@ class Trainer:
     def restore_checkpoint(self, directory: str,
                            step: int | None = None) -> TrainState:
         """Load a checkpoint (latest by default) placed like
-        :meth:`init_state` places fresh state (replicated on the mesh)."""
+        :meth:`init_state` places fresh state. Checkpoints hold CANONICAL
+        shapes; sharded strategies re-flatten for THIS trainer's dp, so
+        a checkpoint moves freely between dp sizes and strategies."""
         from tpu_ddp.utils import checkpoint as ckpt
-        # Shape-only template: eval_shape skips the real init + placement.
-        shapes = jax.eval_shape(
-            lambda: (lambda s: {"params": s.params,
-                                "opt_state": s.opt_state})(self.init_state()))
-        template = {**shapes, "step": np.int64(0)}
+        params_t = self._params_template()
+        if self.is_zero:
+            inner = self.optimizer.inner
+        elif self.is_fsdp:
+            inner = self.zero3.inner
+        else:
+            inner = self.optimizer
+        opt_t = jax.eval_shape(inner.init, params_t)
+        template = {"params": params_t, "opt_state": opt_t,
+                    "step": np.int64(0)}
         restored, _ = ckpt.restore_checkpoint(directory, template, step)
         params, opt_state = restored["params"], restored["opt_state"]
+        if self.is_zero:
+            opt_state = self.optimizer.flatten_opt(opt_state)
+        if self.is_fsdp:
+            params = self.zero3.shard_params(params)
+            opt_state = self.zero3.flatten_opt(opt_state)
         if self.mesh is not None:
             params = jax.device_put(params, self._param_put_sharding)
             opt_state = jax.device_put(opt_state,

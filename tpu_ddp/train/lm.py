@@ -133,6 +133,11 @@ class _MeshTrainer:
             return None
         from tpu_ddp.utils import checkpoint as ckpt
         params, opt_state = gathered
+        if getattr(self, "is_fsdp", False):
+            # Checkpoints hold CANONICAL shapes, never the flat dp-padded
+            # layout — so they restore at any dp size or as replicated.
+            params = self.zero3.unshard_host(params)
+            opt_state = self.zero3.canonicalize_opt_host(opt_state)
         tree = {"params": params, "opt_state": opt_state,
                 "step": np.int64(state.step)}
         return ckpt.save_checkpoint(directory, tree, step=state.step,
@@ -141,16 +146,25 @@ class _MeshTrainer:
     def restore_checkpoint(self, directory: str,
                            step: int | None = None) -> LMTrainState:
         """Load a checkpoint (latest by default) and re-place every leaf
-        in its spec's sharding, as :meth:`init_state` does."""
+        in its spec's sharding, as :meth:`init_state` does. FSDP
+        re-flattens the canonical on-disk shapes for THIS trainer's dp."""
         from tpu_ddp.utils import checkpoint as ckpt
-        shapes = jax.eval_shape(
-            lambda: (lambda s: {"params": s.params,
-                                "opt_state": s.opt_state})(
-                self.init_state()))
+        if getattr(self, "is_fsdp", False):
+            params_t = self._params_template
+            opt_t = jax.eval_shape(self.zero3.inner.init, params_t)
+            shapes = {"params": params_t, "opt_state": opt_t}
+        else:
+            shapes = jax.eval_shape(
+                lambda: (lambda s: {"params": s.params,
+                                    "opt_state": s.opt_state})(
+                    self.init_state()))
         template = {**shapes, "step": np.int64(0)}
         restored, _ = ckpt.restore_checkpoint(directory, template, step)
-        placed = self._place_state(restored["params"],
-                                   restored["opt_state"])
+        params, opt_state = restored["params"], restored["opt_state"]
+        if getattr(self, "is_fsdp", False):
+            params = self.zero3.shard_params(params)
+            opt_state = self.zero3.flatten_opt(opt_state)
+        placed = self._place_state(params, opt_state)
         return LMTrainState(params=placed.params,
                             opt_state=placed.opt_state,
                             step=int(restored["step"]))
@@ -167,13 +181,24 @@ class LMTrainer(_MeshTrainer):
     via the MoE layer's all_to_all, tpu_ddp/parallel/moe.py)."""
 
     def __init__(self, model, mesh: Mesh, optimizer: AdamW | None = None,
-                 moe_aux_coef: float = 0.01):
+                 moe_aux_coef: float = 0.01,
+                 param_sharding: str = "replicated"):
         self.mesh = mesh
         self.dp = mesh.shape[DATA_AXIS]
         self.sp = mesh.shape[SEQ_AXIS]
         self.tp = mesh.shape.get(MODEL_AXIS, 1)
         self.ep = mesh.shape.get(EXPERT_AXIS, 1)
         self.moe_aux_coef = moe_aux_coef
+        if param_sharding not in ("replicated", "fsdp"):
+            raise ValueError(f"unknown param_sharding {param_sharding!r}; "
+                             "choose 'replicated' or 'fsdp'")
+        self.is_fsdp = param_sharding == "fsdp"
+        if self.is_fsdp and (self.tp > 1 or self.ep > 1):
+            raise ValueError(
+                "param_sharding='fsdp' flattens every leaf over dp and "
+                "does not compose with tensor (mp) or expert (ep) "
+                "sharding — those leaves already have a structured "
+                "layout; use mp/ep alone or fsdp with dp x sp")
         if self.sp > 1:
             model = model.with_sequence_parallel(SEQ_AXIS, self.sp)
         if self.tp > 1:
@@ -184,8 +209,17 @@ class LMTrainer(_MeshTrainer):
         # All axes the batch (and therefore the loss) is sharded over.
         self._data_axes = (DATA_AXIS, SEQ_AXIS, EXPERT_AXIS)
         self.optimizer = optimizer or AdamW()
-        self._param_specs = self.model.param_specs()
-        self._opt_specs = self.optimizer.state_specs(self._param_specs)
+        if self.is_fsdp:
+            from tpu_ddp.parallel.zero import ZeRO3
+            self._params_template = jax.eval_shape(
+                lambda: self.model.init(jax.random.key(0)))
+            self.zero3 = ZeRO3(self.optimizer, DATA_AXIS, self.dp,
+                               template=self._params_template)
+            self._param_specs = P(DATA_AXIS)   # flat leaves, dp shards
+            self._opt_specs = self.zero3.state_specs()
+        else:
+            self._param_specs = self.model.param_specs()
+            self._opt_specs = self.optimizer.state_specs(self._param_specs)
         batch_spec = P((DATA_AXIS, EXPERT_AXIS), SEQ_AXIS)
         self._batch_sharding = NamedSharding(mesh, batch_spec)
         self._param_shardings = self._shardings(self._param_specs)
@@ -194,8 +228,12 @@ class LMTrainer(_MeshTrainer):
 
     def init_state(self, seed: int = 0) -> LMTrainState:
         """Init GLOBAL params from the seed, then place every leaf in its
-        spec's sharding (tp leaves split over ``mp``, rest replicated)."""
+        spec's sharding (tp leaves split over ``mp``, rest replicated;
+        under fsdp every leaf is flattened into dp shards)."""
         params = self.model.init(jax.random.key(seed))
+        if self.is_fsdp:
+            params = self.zero3.shard_params(params)
+            return self._place_state(params, self.zero3.init(params))
         return self._place_state(params, self.optimizer.init(params))
 
     def _sync_grads(self, grads):
@@ -217,7 +255,7 @@ class LMTrainer(_MeshTrainer):
         return jax.tree.map(leaf, grads, self._param_specs)
 
     def _base_step(self, params, opt_state, inputs, targets):
-        def loss_fn(p):
+        def loss_terms(p):
             if self.model.moe_experts:
                 logits, aux = self.model.apply_with_aux(p, inputs)
             else:
@@ -233,8 +271,25 @@ class LMTrainer(_MeshTrainer):
             loss_for_grad = (n_shards * local_sum / total
                              + self.moe_aux_coef * aux)
             return loss_for_grad, local_sum / local_n
+
+        if self.is_fsdp:
+            def loss_fn(flat):
+                # all_gather over dp materializes full leaves transiently;
+                # the AD transpose reduce-scatters cotangents, delivering
+                # this worker's dp-SUMMED gradient shard directly.
+                return loss_terms(self.zero3.gather_params(flat))
+
+            (_, local_mean), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            # Mean over sp (each sequence shard contributed its chunk's
+            # grads); the dp sum already happened — divide it out.
+            grads = jax.tree.map(
+                lambda g: lax.pmean(g, SEQ_AXIS) / float(self.dp), grads)
+            params, opt_state = self.zero3.apply(params, grads, opt_state)
+            return params, opt_state, local_mean.reshape(1, 1)
+
         (_, local_mean), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
+            loss_terms, has_aux=True)(params)
         grads = self._sync_grads(grads)
         params, opt_state = self.optimizer.apply(
             params, grads, opt_state, decay_mask=self._decay_mask(params))
