@@ -16,6 +16,7 @@ from tpu_ddp.models.vgg import (  # noqa: F401
     make_vgg,
 )
 from tpu_ddp.models.resnet import ResNetModel, resnet50, make_resnet  # noqa: F401
+from tpu_ddp.models.generate import generate  # noqa: F401
 from tpu_ddp.models.transformer import (  # noqa: F401
     TransformerLM,
     make_transformer,

@@ -1,0 +1,187 @@
+"""Autoregressive generation for TransformerLM — KV-cache decode.
+
+No reference counterpart (the reference is an image classifier); this
+completes the LM family's API surface: train with tpu_ddp/train/lm.py,
+sample with :func:`generate`.
+
+Design, TPU-first:
+- the whole decode loop is ONE jitted ``lax.scan`` over positions —
+  no per-token Python dispatch, static shapes throughout;
+- the KV cache is a preallocated (B, max_len, H, hd) buffer per block,
+  written with ``lax.dynamic_update_slice`` and attended over with a
+  position mask (the standard static-shape decode pattern);
+- prefill runs the prompt through the same math as
+  ``TransformerLM.apply`` while capturing K/V (exactness vs ``apply``
+  is tested in tests/test_generate.py), so generation continues exactly
+  the distribution the trainer optimized.
+
+Single-device dense models only: generation is a serving concern and the
+sharded-training configs (sp/tp/ep) hold their parameters in training
+layouts; materialize full params first (the trainers' checkpoints are
+canonical, tpu_ddp/train/engine.py save_checkpoint).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_ddp.models.transformer import layer_norm, rope
+
+_NEG_INF = -1e30
+
+
+def _check_dense(model):
+    if model.sp_axis is not None or model.tp_axis is not None \
+            or model.ep_axis is not None:
+        raise ValueError(
+            "generate() runs dense single-device models; drop the "
+            "sp/tp/ep configuration (training checkpoints are canonical "
+            "and load into a dense model)")
+    if model.moe_experts:
+        # Incremental decode cannot reproduce training-time MoE routing:
+        # capacity competition is over ALL positions in apply() but only
+        # over the new tokens per decode step, so the distributions
+        # diverge. Refusing keeps the exactness guarantee honest.
+        raise ValueError("generate() does not support MoE models: "
+                         "per-step expert capacity cannot match "
+                         "apply()'s whole-sequence slot competition")
+
+
+def _block_kv(model, blk, y, pos):
+    """QKV for positions ``pos`` of (B, L, dm) normalized input ``y``:
+    returns rotated q, k and raw v, each (B, L, H, hd). The same math
+    as TransformerLM.block_apply's attention head."""
+    cd = model.compute_dtype
+    b, L = y.shape[0], y.shape[1]
+    h, hd = model.num_heads, model.head_dim
+    wqkv = blk["wqkv"].astype(cd).reshape(model.d_model, -1)
+    qkv = jnp.dot(y, wqkv, preferred_element_type=jnp.float32)
+    qkv = qkv.astype(cd).reshape(b, L, 3, h, hd)
+    q = rope(qkv[:, :, 0], pos)
+    k = rope(qkv[:, :, 1], pos)
+    return q, k, qkv[:, :, 2]
+
+
+def _mlp(model, blk, y):
+    cd = model.compute_dtype
+    y = jnp.dot(y, blk["w1"].astype(cd),
+                preferred_element_type=jnp.float32)
+    y = jax.nn.gelu(y.astype(jnp.float32)).astype(cd)
+    return jnp.dot(y, blk["w2"].astype(cd),
+                   preferred_element_type=jnp.float32).astype(cd)
+
+
+def _attend_cached(model, q, ck, cv, q_pos):
+    """q: (B, Lq, H, hd) at absolute positions ``q_pos``; ck/cv: full
+    (B, max_len, H, hd) caches. Attends each query over cache positions
+    <= its own — the causal mask also covers not-yet-written slots
+    (their positions exceed every live query's)."""
+    scale = 1.0 / (model.head_dim ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                        preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(ck.shape[1])
+    mask = k_pos[None, None, None, :] > q_pos[None, None, :, None]
+    scores = jnp.where(mask, _NEG_INF, scores)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _forward_cached(model, params, tokens, caches, start: int):
+    """Run ``tokens`` (B, L) occupying absolute positions
+    ``start..start+L-1`` against (and updating) the caches. Returns
+    (last-position logits (B, V), new caches)."""
+    cd = model.compute_dtype
+    b, L = tokens.shape
+    pos = start + jnp.arange(L)
+    x = params["embed"][tokens].astype(cd)
+    new_caches = []
+    for blk, (ck, cv) in zip(params["blocks"], caches):
+        y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+        q, k, v = _block_kv(model, blk, y, pos)
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (0, start, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (0, start, 0, 0))
+        o = _attend_cached(model, q, ck, cv, pos)
+        wo = blk["wo"].astype(cd).reshape(-1, model.d_model)
+        o = jnp.dot(o.reshape(b, L, -1), wo,
+                    preferred_element_type=jnp.float32).astype(cd)
+        x = x + o
+        y = layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+        x = x + _mlp(model, blk, y)
+        new_caches.append((ck, cv))
+    logits = model.head_apply(params, x[:, -1:])[:, 0]
+    return logits, tuple(new_caches)
+
+
+def init_cache(model, batch: int, max_len: int):
+    """Per-block (K, V) buffers: (B, max_len, H, hd) each."""
+    shape = (batch, max_len, model.num_heads, model.head_dim)
+    zeros = jnp.zeros(shape, model.compute_dtype)
+    return tuple((zeros, zeros) for _ in range(model.num_layers))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("model", "max_new_tokens"))
+def _generate_impl(model, params, prompt, max_new_tokens, temperature,
+                   key):
+    b, p_len = prompt.shape
+    total = p_len + max_new_tokens
+    caches = init_cache(model, b, total)
+    logits, caches = _forward_cached(model, params, prompt, caches, 0)
+
+    def pick(logits, key):
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        key, sub = jax.random.split(key)
+        sampled = jax.random.categorical(
+            sub, logits / jnp.maximum(temperature, 1e-6), axis=-1
+        ).astype(jnp.int32)
+        return jnp.where(temperature > 0, sampled, greedy), key
+
+    tok0, key = pick(logits, key)
+
+    def step(carry, i):
+        caches, tok, key = carry
+        logits, caches = _forward_cached(model, params, tok[:, None],
+                                         caches, p_len + i)
+        nxt, key = pick(logits, key)
+        return (caches, nxt, key), tok
+
+    (_, last, _), toks = lax.scan(
+        step, (caches, tok0, key), jnp.arange(max_new_tokens - 1))
+    # toks: (max_new-1, B) emitted BEFORE each step; append the final one.
+    return jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+
+
+def generate(model, params, prompt, max_new_tokens: int,
+             temperature: float = 0.0, key=None):
+    """Sample ``max_new_tokens`` continuations of ``prompt`` (B, P).
+
+    ``temperature == 0`` is greedy argmax decoding; otherwise softmax
+    sampling at the given temperature (``key`` required). Returns the
+    (B, max_new_tokens) generated tokens. The prompt plus generation
+    must fit ``model.max_seq_len``.
+    """
+    _check_dense(model)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2 or prompt.shape[1] < 1:
+        raise ValueError("prompt must be (batch, prompt_len >= 1)")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    total = prompt.shape[1] + max_new_tokens
+    if total > model.max_seq_len:
+        raise ValueError(f"prompt + generation = {total} exceeds "
+                         f"max_seq_len={model.max_seq_len}")
+    if temperature > 0 and key is None:
+        raise ValueError("temperature sampling needs a PRNG key")
+    if key is None:
+        key = jax.random.key(0)
+    return _generate_impl(model, params, prompt, max_new_tokens,
+                          jnp.float32(temperature), key)
