@@ -108,15 +108,25 @@ def launch(
     emulates an ``nproc``-node cluster with ``nproc * devices_per_proc``
     total dp slots. Extra env wins over the computed defaults.
     """
-    if part not in PARTS:
-        raise ValueError(f"unknown part {part!r}; available: {PARTS}")
     if nproc < 1:
         raise ValueError("nproc must be >= 1")
-    script = PARTS_DIR / part / "main.py"
+    if part in PARTS:
+        script = PARTS_DIR / part / "main.py"
+    elif part.endswith(".py"):
+        # Any CLI honouring the reference launch contract
+        # (--num-nodes/--rank/--master-ip/--master-port) can be
+        # clustered, e.g. examples/lm_train.py. Relative paths resolve
+        # against the repo root — the same cwd the workers get — so the
+        # call works from any directory.
+        p = Path(part)
+        script = (p if p.is_absolute() else PARTS_DIR.parent / p).resolve()
+    else:
+        raise ValueError(f"unknown part {part!r}; available: {PARTS} "
+                         "or a path to a *.py CLI")
     if not script.exists():
         raise FileNotFoundError(
-            f"{script}: the launcher runs the parts/ CLIs and therefore "
-            "needs a source checkout (parts/ is not part of the installed "
+            f"{script}: the launcher runs source-checkout CLIs "
+            "(parts/ and examples/ are not part of the installed "
             "package)")
     port = port or find_free_port()
 
@@ -252,7 +262,9 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m tpu_ddp.launch",
         description="spawn an N-process local cluster running one part")
-    p.add_argument("part", choices=PARTS)
+    p.add_argument("part", metavar="part|script.py",
+                   help=f"one of {', '.join(PARTS)}, or a path to a "
+                        "*.py CLI honouring the launch contract")
     p.add_argument("--nproc", type=int, required=True,
                    help="number of rank processes (the --num-nodes value)")
     p.add_argument("--platform", default="cpu",
@@ -266,11 +278,15 @@ def main(argv=None) -> int:
                    help="respawn the cluster up to N times on failure, "
                         "resuming from --ckpt-dir when possible")
     args, extra = p.parse_known_args(argv)
-    res = launch_elastic(args.part, args.nproc,
-                         max_restarts=args.max_restarts, extra_args=extra,
-                         platform=args.platform,
-                         devices_per_proc=args.devices_per_proc,
-                         port=args.port)
+    try:
+        res = launch_elastic(args.part, args.nproc,
+                             max_restarts=args.max_restarts,
+                             extra_args=extra,
+                             platform=args.platform,
+                             devices_per_proc=args.devices_per_proc,
+                             port=args.port)
+    except (ValueError, FileNotFoundError) as e:
+        p.error(str(e))  # clean usage error, not a traceback
     for w in res.workers:
         print(f"[launch] rank {w.rank} exited {w.returncode}")
     if res.restarts:
