@@ -189,6 +189,7 @@ def test_two_process_lm_train():
         for rank in (0, 1):
             out = res.output_of(rank)
             assert f"rank={rank} world=2 dp=2" in out
+            assert f"fsdp={fsdp == '1'}" in out  # mode actually engaged
             assert "step 3/3 loss" in out
         # Params are synchronized; both ranks' shard losses track the
         # same model, and the run must have made progress.

@@ -96,6 +96,10 @@ class TransformerLM:
     # (tpu_ddp/ops/pallas/flash_attention.py); the sp>1 path always uses
     # ring attention.
     use_flash: bool = False
+    # Rematerialize each block in the backward pass (jax.checkpoint):
+    # trades ~num_layers x activation memory for one extra forward —
+    # the standard long-context memory lever on HBM-bound chips.
+    remat_blocks: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -239,8 +243,11 @@ class TransformerLM:
         pos = self._positions(lc)
         x = params["embed"][tokens].astype(cd)
         aux = jnp.float32(0.0)
+        blk_fn = self.block_apply_aux
+        if self.remat_blocks:
+            blk_fn = jax.checkpoint(blk_fn)
         for blk in params["blocks"]:
-            x, a = self.block_apply_aux(blk, x, pos)
+            x, a = blk_fn(blk, x, pos)
             aux = aux + a
         return self.head_apply(params, x), aux / max(self.num_layers, 1)
 

@@ -112,9 +112,14 @@ def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
     x_embed = params["embed"][micro].astype(cd)      # (M, mb, L, dm)
 
     def run_stage(x):
-        """This stage's layer slice, scanned layer by layer."""
+        """This stage's layer slice, scanned layer by layer. With
+        ``remat_blocks`` each layer recomputes in the backward pass —
+        essential under GPipe, whose T = M + pp - 1 ticks would otherwise
+        stash every tick's activations."""
         def body(h, layer):
             return model.block_apply(layer, h, pos), None
+        if model.remat_blocks:
+            body = jax.checkpoint(body)
         h, _ = lax.scan(body, x, params["blocks"])
         return h
 
