@@ -16,6 +16,7 @@ state lives in the same sharding as the parameters.
 from __future__ import annotations
 
 import dataclasses
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -91,9 +92,14 @@ class AdamW:
     No reference counterpart (the reference uses SGD only,
     part1/main.py:124-125); added for the transformer/long-context models,
     same pure-pytree-transform shape as :class:`SGD`.
+
+    ``learning_rate`` may be a float or a SCHEDULE — any callable
+    ``step (f32 scalar, 1-based) -> lr`` (e.g. :func:`warmup_cosine`);
+    it is evaluated inside the jitted step from the state's own count,
+    so resume continues the schedule exactly.
     """
 
-    learning_rate: float = 3e-4
+    learning_rate: Any = 3e-4
     b1: float = 0.9
     b2: float = 0.95
     eps: float = 1e-8
@@ -132,6 +138,8 @@ class AdamW:
         c = count.astype(jnp.float32)
         bc1 = 1.0 - self.b1 ** c
         bc2 = 1.0 - self.b2 ** c
+        lr = (self.learning_rate(c) if callable(self.learning_rate)
+              else self.learning_rate)
         if decay_mask is None:
             decay_mask = self.decay_mask(params)
         # Separate tree.maps per output (the SGD style above): structure-
@@ -144,8 +152,29 @@ class AdamW:
             + (1 - self.b2) * jnp.square(g.astype(p.dtype)),
             params, grads, state["nu"])
         new_p = jax.tree.map(
-            lambda p, mu, nu, dk: p - self.learning_rate * (
+            lambda p, mu, nu, dk: p - lr * (
                 (mu / bc1) / (jnp.sqrt(nu / bc2) + self.eps)
                 + (self.weight_decay * p if dk else 0.0)),
             params, new_mu, new_nu, decay_mask)
         return new_p, {"mu": new_mu, "nu": new_nu, "count": count}
+
+
+def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
+                  floor: float = 0.0):
+    """Linear warmup to ``peak_lr`` then cosine decay to ``floor`` — the
+    standard transformer LM schedule. Returns a jit-safe callable
+    ``step (1-based f32) -> lr`` for :class:`AdamW`'s ``learning_rate``.
+    """
+    if not 0 < warmup_steps < total_steps:
+        raise ValueError(f"need 0 < warmup_steps={warmup_steps} < "
+                         f"total_steps={total_steps}")
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = peak_lr * step / warmup_steps
+        frac = jnp.clip((step - warmup_steps)
+                        / (total_steps - warmup_steps), 0.0, 1.0)
+        cos = floor + (peak_lr - floor) * 0.5 * (1 + jnp.cos(jnp.pi * frac))
+        return jnp.where(step < warmup_steps, warm, cos)
+
+    return schedule

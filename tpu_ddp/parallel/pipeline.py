@@ -119,7 +119,9 @@ def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
         def body(h, layer):
             return model.block_apply(layer, h, pos), None
         if model.remat_blocks:
-            body = jax.checkpoint(body)
+            # prevent_cse=False: scan's loop structure already prevents
+            # the problematic CSE, so keep XLA free to fuse.
+            body = jax.checkpoint(body, prevent_cse=False)
         h, _ = lax.scan(body, x, params["blocks"])
         return h
 
