@@ -231,9 +231,25 @@ class TransformerLM:
 
     def apply_with_aux(self, params, tokens):
         """Like :meth:`apply`, additionally returning the mean Switch
-        load-balance auxiliary loss over MoE blocks (0.0 when dense).
-        This is the single full-forward implementation — :meth:`apply`
-        is its aux-discarding wrapper, so validation lives here once."""
+        load-balance auxiliary loss over MoE blocks (0.0 when dense)."""
+        x, aux = self.trunk_with_aux(params, tokens)
+        return self.project(params, x), aux
+
+    def project(self, params, x):
+        """Vocabulary projection of post-LN activations — the ONE place
+        the head matmul's precision is decided."""
+        logits = jnp.dot(x, params["head"].astype(self.compute_dtype),
+                         preferred_element_type=jnp.float32)
+        return logits.astype(jnp.float32)
+
+    def trunk_with_aux(self, params, tokens):
+        """Everything but the vocabulary projection: embed -> blocks ->
+        final LayerNorm, returning ((B, L, dm) activations, aux). The
+        split exists so the LM loss can fuse the head matmul into a
+        chunked-vocab cross-entropy without materializing (T, V) logits
+        (tpu_ddp/ops/loss.py chunked_vocab_cross_entropy). This is the
+        single full-forward implementation — :meth:`apply` /
+        :meth:`apply_with_aux` wrap it, so validation lives here once."""
         cd = self.compute_dtype
         lc = tokens.shape[1]
         if lc * self.sp_size > self.max_seq_len:
@@ -249,7 +265,8 @@ class TransformerLM:
         for blk in params["blocks"]:
             x, a = blk_fn(blk, x, pos)
             aux = aux + a
-        return self.head_apply(params, x), aux / max(self.num_layers, 1)
+        x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        return x, aux / max(self.num_layers, 1)
 
     def block_apply(self, blk, x, pos):
         """One transformer block: (B, L, dm) -> (B, L, dm).
@@ -307,11 +324,8 @@ class TransformerLM:
 
     def head_apply(self, params, x):
         """Final LayerNorm + LM head: (B, L, dm) -> (B, L, V) float32."""
-        cd = self.compute_dtype
         x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
-        logits = jnp.dot(x, params["head"].astype(cd),
-                         preferred_element_type=jnp.float32)
-        return logits.astype(jnp.float32)
+        return self.project(params, x)
 
     def num_params(self, params=None, key=None) -> int:
         if params is None:

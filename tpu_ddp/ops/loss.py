@@ -5,7 +5,9 @@ part1/main.py:119, applied to logits + integer labels with mean reduction).
 Implemented directly over ``logsumexp`` so XLA fuses it into the train step.
 """
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.scipy.special import logsumexp
 
 
@@ -22,3 +24,62 @@ def cross_entropy_loss(logits, labels):
     """Mean-reduced CE — the exact semantics of torch's default
     ``CrossEntropyLoss`` used at reference part1/main.py:74-75."""
     return jnp.mean(softmax_cross_entropy(logits, labels))
+
+
+def chunked_vocab_cross_entropy(hidden, head, labels, chunk: int):
+    """Per-token CE of ``hidden @ head`` WITHOUT materializing the full
+    (T, V) logits tensor.
+
+    ``hidden``: (T, dm) final-LayerNorm activations; ``head``: (dm, V);
+    ``labels``: (T,) int. A ``lax.scan`` over vocab chunks keeps an
+    online logsumexp (running max / scaled sum) plus the label logit, so
+    peak memory is O(T * chunk) instead of O(T * V) — at 32k+ vocab and
+    long context the logits tensor is the train step's largest buffer
+    (e.g. (8*4096, 32k) f32 = 4 GB). The head matmul itself fuses into
+    the scan chunk by chunk.
+
+    The scan body is wrapped in ``jax.checkpoint``: without it, scan's
+    autodiff would SAVE each chunk's logits as residuals — O(T * V)
+    again, precisely what this function exists to avoid — so the
+    backward instead recomputes each chunk's matmul. Numerically
+    identical to ``softmax_cross_entropy(hidden @ head, labels)``
+    (tested).
+
+    This is a MEMORY lever, not a speed one: the serialized chunk scan
+    plus backward recompute measurably underruns the dense path when the
+    dense path fits — enable it when the (T, V) logits buffer is what
+    keeps a long-context configuration from fitting, and prefer the
+    largest chunk that fits.
+    """
+    T, dm = hidden.shape
+    V = head.shape[1]
+    if V % chunk:
+        raise ValueError(f"vocab {V} not divisible by chunk {chunk}")
+    labels = labels.astype(jnp.int32)
+    n_chunks = V // chunk
+    # Same matmul precision as the dense path: operands in the model's
+    # compute dtype (bf16 rides the MXU fast path), f32 accumulation.
+    head_c = jnp.moveaxis(
+        head.astype(hidden.dtype).reshape(dm, n_chunks, chunk), 1, 0)
+
+    def body(carry, inputs):
+        m, s, picked = carry
+        idx, w = inputs                       # chunk index, (dm, chunk)
+        logits = jnp.dot(hidden, w, preferred_element_type=jnp.float32)
+        cm = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, cm)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        local = labels - idx * chunk
+        in_chunk = (local >= 0) & (local < chunk)
+        lab = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=-1)[:, 0]
+        picked = jnp.where(in_chunk, lab, picked)
+        return (m_new, s, picked), None
+
+    init = (jnp.full((T,), -jnp.inf, jnp.float32),
+            jnp.zeros((T,), jnp.float32),
+            jnp.zeros((T,), jnp.float32))
+    (m, s, picked), _ = lax.scan(jax.checkpoint(body, prevent_cse=False),
+                                 init, (jnp.arange(n_chunks), head_c))
+    return m + jnp.log(s) - picked

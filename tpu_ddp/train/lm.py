@@ -35,7 +35,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_ddp.ops.loss import softmax_cross_entropy
+from tpu_ddp.ops.loss import (chunked_vocab_cross_entropy,
+                              softmax_cross_entropy)
 from tpu_ddp.ops.optim import AdamW
 from tpu_ddp.parallel.mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS,
                                    PIPE_AXIS, SEQ_AXIS)
@@ -182,13 +183,21 @@ class LMTrainer(_MeshTrainer):
 
     def __init__(self, model, mesh: Mesh, optimizer: AdamW | None = None,
                  moe_aux_coef: float = 0.01,
-                 param_sharding: str = "replicated"):
+                 param_sharding: str = "replicated",
+                 vocab_chunk: int = 0):
         self.mesh = mesh
         self.dp = mesh.shape[DATA_AXIS]
         self.sp = mesh.shape[SEQ_AXIS]
         self.tp = mesh.shape.get(MODEL_AXIS, 1)
         self.ep = mesh.shape.get(EXPERT_AXIS, 1)
         self.moe_aux_coef = moe_aux_coef
+        # > 0: compute the loss via chunked-vocab CE, never materializing
+        # the (T, V) logits (tpu_ddp/ops/loss.py) — the train step's
+        # largest buffer at long context. Value = vocab slice width.
+        self.vocab_chunk = vocab_chunk
+        if vocab_chunk and model.vocab_size % vocab_chunk:
+            raise ValueError(f"vocab_size={model.vocab_size} not "
+                             f"divisible by vocab_chunk={vocab_chunk}")
         if param_sharding not in ("replicated", "fsdp"):
             raise ValueError(f"unknown param_sharding {param_sharding!r}; "
                              "choose 'replicated' or 'fsdp'")
@@ -256,12 +265,16 @@ class LMTrainer(_MeshTrainer):
 
     def _base_step(self, params, opt_state, inputs, targets):
         def loss_terms(p):
-            if self.model.moe_experts:
-                logits, aux = self.model.apply_with_aux(p, inputs)
+            if self.vocab_chunk:
+                hidden, aux = self.model.trunk_with_aux(p, inputs)
+                nll = chunked_vocab_cross_entropy(
+                    hidden.reshape(-1, hidden.shape[-1]), p["head"],
+                    targets.reshape(-1), self.vocab_chunk)
             else:
-                logits, aux = self.model.apply(p, inputs), 0.0
-            nll = softmax_cross_entropy(
-                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1))
+                logits, aux = self.model.apply_with_aux(p, inputs)
+                nll = softmax_cross_entropy(
+                    logits.reshape(-1, logits.shape[-1]),
+                    targets.reshape(-1))
             local_sum = jnp.sum(nll)
             local_n = jnp.float32(nll.size)
             total = lax.psum(local_n, self._data_axes)
