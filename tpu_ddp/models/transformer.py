@@ -78,8 +78,12 @@ class TransformerLM:
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     # Sequence parallelism: mesh axis name/extent the LOCAL chunk lives on.
+    # ``sp_mode`` picks the scheme: "ring" (K/V rotation via ppermute,
+    # tpu_ddp/parallel/ring_attention.py) or "ulysses" (all-to-all head
+    # re-sharding, tpu_ddp/parallel/ulysses.py). Both are exact.
     sp_axis: str | None = None
     sp_size: int = 1
+    sp_mode: str = "ring"
     # Tensor parallelism: mesh axis name/extent block params are sharded on.
     tp_axis: str | None = None
     tp_size: int = 1
@@ -92,9 +96,11 @@ class TransformerLM:
     # Expert parallelism: mesh axis name/extent the expert axis shards on.
     ep_axis: str | None = None
     ep_size: int = 1
-    # Use the Pallas flash-attention kernel for non-sp attention
-    # (tpu_ddp/ops/pallas/flash_attention.py); the sp>1 path always uses
-    # ring attention.
+    # Use the Pallas flash-attention kernel
+    # (tpu_ddp/ops/pallas/flash_attention.py). Honored when attention is
+    # local: sp==1, or sp>1 with sp_mode="ulysses" (the kernel runs on
+    # the all-to-all-gathered sequence). The ring path (sp>1, "ring")
+    # has its own blockwise online softmax and ignores this flag.
     use_flash: bool = False
     # Rematerialize each block in the backward pass (jax.checkpoint):
     # trades ~num_layers x activation memory for one extra forward —
@@ -294,7 +300,8 @@ class TransformerLM:
         k = rope(qkv[:, :, 1], pos)
         v = qkv[:, :, 2]
         o = attend(q, k, v, causal=True, axis_name=self.sp_axis,
-                   axis_size=self.sp_size, flash=self.use_flash)
+                   axis_size=self.sp_size, flash=self.use_flash,
+                   mode=self.sp_mode)
         # Row-parallel output projection: partial sums psum'd over tp.
         wo = blk["wo"].astype(cd).reshape(h_loc * hd, self.d_model)
         o = self._tp_out(jnp.dot(
@@ -332,10 +339,17 @@ class TransformerLM:
             params = self.init(key if key is not None else jax.random.key(0))
         return sum(int(p.size) for p in jax.tree.leaves(params))
 
-    def with_sequence_parallel(self, axis_name: str,
-                               axis_size: int) -> "TransformerLM":
+    def with_sequence_parallel(self, axis_name: str, axis_size: int,
+                               mode: str = "ring") -> "TransformerLM":
+        if mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown sequence-parallel mode {mode!r}; "
+                             "expected 'ring' or 'ulysses'")
+        if mode == "ulysses" and (self.num_heads // self._tp) % axis_size:
+            raise ValueError(
+                f"ulysses needs (num_heads/tp) % sp == 0 (got heads="
+                f"{self.num_heads}/{self._tp} per tp shard, sp={axis_size})")
         return dataclasses.replace(self, sp_axis=axis_name,
-                                   sp_size=axis_size)
+                                   sp_size=axis_size, sp_mode=mode)
 
     def with_tensor_parallel(self, axis_name: str,
                              axis_size: int) -> "TransformerLM":
@@ -345,6 +359,15 @@ class TransformerLM:
         if self.d_ff % axis_size:
             raise ValueError(f"d_ff={self.d_ff} not divisible by "
                              f"tp={axis_size}")
+        # Re-validate an already-configured Ulysses sp against the PER-TP
+        # head count (trainers apply sp before tp, so the sp-time check
+        # ran with tp=1) — fail at construction, not inside the jit trace.
+        if (self.sp_mode == "ulysses" and self.sp_size > 1
+                and (self.num_heads // axis_size) % self.sp_size):
+            raise ValueError(
+                f"ulysses needs (num_heads/tp) % sp == 0 (got heads="
+                f"{self.num_heads}/{axis_size} per tp shard, "
+                f"sp={self.sp_size})")
         return dataclasses.replace(self, tp_axis=axis_name,
                                    tp_size=axis_size)
 

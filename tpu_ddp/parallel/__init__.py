@@ -13,6 +13,8 @@ from tpu_ddp.parallel.bootstrap import (  # noqa: F401
     test_distributed_setup,
 )
 from tpu_ddp.parallel.mesh import make_mesh, data_parallel_specs  # noqa: F401
+from tpu_ddp.parallel.ring_attention import attend, ring_attention  # noqa: F401
+from tpu_ddp.parallel.ulysses import ulysses_attention  # noqa: F401
 from tpu_ddp.parallel.sync import (  # noqa: F401
     SYNC_STRATEGIES,
     get_sync_strategy,

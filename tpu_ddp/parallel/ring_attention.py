@@ -39,12 +39,13 @@ _NEG_INF = -1e30  # mask value; avoids NaN from (-inf) - (-inf)
 
 
 def _block_attn(q, k, v, m_prev, l_prev, acc_prev, q_pos, k_pos, causal,
-                scale):
+                scale, k_valid=None):
     """One blockwise-attention update of the online softmax state.
 
     q: (B, Lq, H, D); k/v: (B, Lk, H, D); positions: (Lq,), (Lk,).
     State: m (B, H, Lq) running max, l (B, H, Lq) running sum,
     acc (B, Lq, H, D) unnormalized output. All state float32.
+    ``k_valid`` (bool (Lk,), optional) masks out padded key positions.
     """
     # scores: (B, H, Lq, Lk) in f32 (MXU accumulates f32 from bf16 inputs).
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -52,6 +53,8 @@ def _block_attn(q, k, v, m_prev, l_prev, acc_prev, q_pos, k_pos, causal,
     if causal:
         mask = k_pos[None, None, None, :] > q_pos[None, None, :, None]
         scores = jnp.where(mask, _NEG_INF, scores)
+    if k_valid is not None:
+        scores = jnp.where(k_valid[None, None, None, :], scores, _NEG_INF)
     m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))      # (B,H,Lq)
     p = jnp.exp(scores - m_new[..., None])                     # (B,H,Lq,Lk)
     correction = jnp.exp(m_prev - m_new)                       # (B,H,Lq)
@@ -100,6 +103,51 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     return out.astype(q.dtype)
 
 
+def blockwise_attention(q, k, v, causal: bool = False,
+                        block_size: int = 512):
+    """Exact attention with K/V streamed in blocks (online softmax).
+
+    Same math as :func:`full_attention` but the score buffer is
+    (B, H, L, block) instead of (B, H, L, L) — the memory-bounded jnp
+    path for long local sequences (the Ulysses local attention uses this
+    when the Pallas flash kernel is off, tpu_ddp/parallel/ulysses.py).
+    """
+    b, L, h, d = q.shape
+    bs = min(block_size, L)
+    n = -(-L // bs)
+    pad = n * bs - L
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    scale = 1.0 / (d ** 0.5)
+    q_pos = jnp.arange(L)
+    # (n, B, bs, H, D) so lax.scan carries the online-softmax state over
+    # key blocks; XLA keeps only one block's scores live at a time.
+    kb = jnp.moveaxis(k.reshape(b, n, bs, h, d), 1, 0)
+    vb = jnp.moveaxis(v.reshape(b, n, bs, h, d), 1, 0)
+
+    # Remat the block update: without it, scan's VJP stacks every block's
+    # (B, H, L, bs) probabilities — O(L^2) residuals, the exact buffer
+    # this function exists to avoid. Checkpointing recomputes them in the
+    # backward sweep (the standard blockwise-transformer trade).
+    @jax.checkpoint
+    def body(carry, inp):
+        m_prev, l_prev, acc_prev = carry
+        kc, vc, idx = inp
+        k_pos = idx * bs + jnp.arange(bs)
+        state = _block_attn(q, kc, vc, m_prev, l_prev, acc_prev,
+                            q_pos, k_pos, causal, scale,
+                            k_valid=k_pos < L)
+        return state, None
+
+    init = (jnp.full((b, h, L), _NEG_INF, jnp.float32),
+            jnp.zeros((b, h, L), jnp.float32),
+            jnp.zeros((b, L, h, d), jnp.float32))
+    (m, l, acc), _ = lax.scan(body, init, (kb, vb, jnp.arange(n)))
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
 def full_attention(q, k, v, causal: bool = False):
     """Single-device reference: same math, whole sequence resident."""
     b, L, h, d = q.shape
@@ -117,8 +165,11 @@ def full_attention(q, k, v, causal: bool = False):
 
 
 def attend(q, k, v, *, causal: bool = False, axis_name: str | None = None,
-           axis_size: int | None = None, flash: bool = False):
-    """Dispatch: ring attention when a sequence axis is given, else the
+           axis_size: int | None = None, flash: bool = False,
+           mode: str = "ring"):
+    """Dispatch: sequence-parallel attention when a sequence axis is given
+    (``mode`` picks the scheme: ``"ring"`` K/V rotation or ``"ulysses"``
+    all-to-all head re-sharding, tpu_ddp/parallel/ulysses.py), else the
     flash Pallas kernel (``flash=True``) or the jnp reference."""
     if axis_name is not None:
         if axis_size is None:
@@ -128,6 +179,13 @@ def attend(q, k, v, *, causal: bool = False, axis_name: str | None = None,
                 "attend: axis_name given without axis_size; pass the sp "
                 "mesh extent (loop bounds must be static under jit)")
         if axis_size > 1:
+            if mode == "ulysses":
+                from tpu_ddp.parallel.ulysses import ulysses_attention
+                return ulysses_attention(q, k, v, axis_name, axis_size,
+                                         causal=causal, flash=flash)
+            if mode != "ring":
+                raise ValueError(f"attend: unknown sequence-parallel mode "
+                                 f"{mode!r}; expected 'ring' or 'ulysses'")
             return ring_attention(q, k, v, axis_name, axis_size,
                                   causal=causal)
     if flash:

@@ -1,0 +1,100 @@
+"""Ulysses sequence parallelism: all-to-all head/sequence re-sharding.
+
+No reference counterpart — the reference's workload is 32x32 image
+classification with no sequence dimension (SURVEY.md §5 "Long-context /
+sequence parallelism: Absent") — but long-context training is first-class
+in this framework, and ring attention (tpu_ddp/parallel/ring_attention.py)
+is only one of the two standard schemes. This module implements the other:
+DeepSpeed-Ulysses (Jacobs et al., arXiv:2309.14509 — reimplemented from
+the paper's description, not from any code).
+
+Scheme: activations arrive sequence-sharded over the ``sp`` mesh axis,
+shape (B, L/sp, H, D) per device. One ``lax.all_to_all`` re-shards from
+sequence to heads — every device ends up with the FULL sequence for H/sp
+of the heads, (B, L, H/sp, D) — then attention runs entirely locally
+(dense, or the Pallas flash kernel: no inter-device traffic during the
+softmax), and a second all-to-all restores the sequence sharding.
+
+Trade-off vs ring attention (why both exist):
+
+- Ulysses moves 2 all-to-alls of the QKV/O activations per attention
+  call; total bytes on the wire are O(B.L.H.D / sp) per device, CONSTANT
+  in sp — it scales better than ring's ppermute chain when sp is large
+  and heads are plentiful, and the local attention can use the flash
+  Pallas kernel unchanged.
+- Ring keeps heads intact (works for H < sp, e.g. MQA/GQA with few KV
+  heads) and overlaps its K/V hops with compute; Ulysses requires
+  ``H % sp == 0`` and its all-to-alls sit on the critical path, but XLA
+  lowers them to a single ICI all-to-all, the cheapest collective per
+  byte on a torus.
+
+Both compute EXACT attention — tests/test_ulysses.py checks this one
+against the same single-device reference as ring.
+
+Differentiable: ``lax.all_to_all`` is its own transpose (with split/concat
+axes swapped), so ``jax.grad`` through a ``shard_map``'d call just works.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_ddp.parallel.mesh import SEQ_AXIS
+from tpu_ddp.parallel.ring_attention import blockwise_attention
+
+
+def _heads_to_seq(x, axis_name, stacked: bool = False):
+    """(B, L/sp, H, D) -> (B, L, H/sp, D): scatter heads, gather sequence.
+
+    With ``tiled=True`` the split axis is cut into sp blocks (block i ->
+    device i) and received blocks concatenate along the concat axis in
+    source-device order — so the gathered sequence axis comes out in
+    global order because device j held chunk j. ``stacked`` shifts both
+    axes by one for a (3, B, ...) QKV stack.
+    """
+    off = 1 if stacked else 0
+    return lax.all_to_all(x, axis_name, split_axis=2 + off,
+                          concat_axis=1 + off, tiled=True)
+
+
+def _seq_to_heads(x, axis_name):
+    """(B, L, H/sp, D) -> (B, L/sp, H, D): the inverse re-shard."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
+                      axis_size: int | None = None, causal: bool = False,
+                      flash: bool = False):
+    """Exact multi-head attention with sequence sharded over ``axis_name``.
+
+    Must be called inside a ``shard_map`` over a mesh with that axis.
+    ``q``/``k``/``v``: local chunks (B, L/sp, H, D) with RoPE (or any
+    position encoding) already applied at the chunks' GLOBAL positions.
+    Returns the local output chunk (B, L/sp, H, D) in ``q``'s dtype.
+    """
+    if axis_size is None:
+        raise ValueError("axis_size (the sp mesh extent) is required — "
+                         "loop bounds must be static under jit")
+    h = q.shape[2]
+    if h % axis_size:
+        raise ValueError(
+            f"ulysses_attention needs num_heads % sp == 0 (got heads={h}, "
+            f"sp={axis_size}); use ring attention for head-poor models")
+    # One collective for all three tensors: same bytes as three separate
+    # all_to_alls but a single launch on the critical path.
+    qkv = _heads_to_seq(jnp.stack([q, k, v]), axis_name, stacked=True)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    # Full sequence is now resident: local positions ARE global positions,
+    # so the plain causal mask is exact. Local attention must stay
+    # memory-bounded — the gathered L here is sp x the resident chunk, and
+    # materializing (L, L) scores would forfeit what sp is for — so it's
+    # the Pallas flash kernel or the blockwise jnp path, never
+    # full_attention.
+    if flash:
+        from tpu_ddp.ops.pallas import flash_attention
+        out = flash_attention(q, k, v, causal)
+    else:
+        out = blockwise_attention(q, k, v, causal=causal)
+    return _seq_to_heads(out, axis_name)

@@ -7,8 +7,10 @@ a (dp, sp, mp) mesh:
 
 - token/target batches (B, L) are sharded batch-over-``dp`` AND
   sequence-over-``sp`` (replicated over ``mp``);
-- attention inside the model runs as ring attention over ``sp``
-  (tpu_ddp/parallel/ring_attention.py) so each device only ever holds its
+- attention inside the model runs sequence-parallel over ``sp`` — ring
+  K/V rotation (tpu_ddp/parallel/ring_attention.py, the default) or
+  Ulysses all-to-all head re-sharding (tpu_ddp/parallel/ulysses.py,
+  ``sp_mode="ulysses"``) — so the residual stream only ever holds its
   L/sp chunk;
 - block parameters shard over ``mp`` per the model's ``param_specs()``
   (Megatron column/row layout, tpu_ddp/parallel/tensor_parallel.py);
@@ -184,7 +186,7 @@ class LMTrainer(_MeshTrainer):
     def __init__(self, model, mesh: Mesh, optimizer: AdamW | None = None,
                  moe_aux_coef: float = 0.01,
                  param_sharding: str = "replicated",
-                 vocab_chunk: int = 0):
+                 vocab_chunk: int = 0, sp_mode: str = "ring"):
         self.mesh = mesh
         self.dp = mesh.shape[DATA_AXIS]
         self.sp = mesh.shape[SEQ_AXIS]
@@ -209,7 +211,10 @@ class LMTrainer(_MeshTrainer):
                 "sharding — those leaves already have a structured "
                 "layout; use mp/ep alone or fsdp with dp x sp")
         if self.sp > 1:
-            model = model.with_sequence_parallel(SEQ_AXIS, self.sp)
+            # "ring" rotates K/V over sp; "ulysses" re-shards heads<->
+            # sequence with two all_to_alls (tpu_ddp/parallel/ulysses.py).
+            model = model.with_sequence_parallel(SEQ_AXIS, self.sp,
+                                                 mode=sp_mode)
         if self.tp > 1:
             model = model.with_tensor_parallel(MODEL_AXIS, self.tp)
         if self.ep > 1:
