@@ -14,7 +14,8 @@ or run it per node like any part::
 Each process contributes its local devices as dp slots; batches are
 synthetic tokens (zero egress), per-process shards assembled into global
 arrays by the trainer. Env knobs: TPU_DDP_LM_STEPS, TPU_DDP_LM_PRESET,
-TPU_DDP_LM_FSDP=1, TPU_DDP_GLOBAL_BATCH.
+TPU_DDP_LM_FSDP=1, TPU_DDP_GLOBAL_BATCH, TPU_DDP_LM_ACCUM (gradient-
+accumulation microbatches), TPU_DDP_LM_SP_MODE (ring|ulysses).
 """
 
 import os
@@ -57,6 +58,8 @@ def main(argv=None) -> int:
     steps = int(os.environ.get("TPU_DDP_LM_STEPS", "5"))
     preset = os.environ.get("TPU_DDP_LM_PRESET", "TransformerLM-tiny")
     fsdp = os.environ.get("TPU_DDP_LM_FSDP", "0") == "1"
+    accum = int(os.environ.get("TPU_DDP_LM_ACCUM", "1"))
+    sp_mode = os.environ.get("TPU_DDP_LM_SP_MODE", "ring")
     global_batch = int(os.environ.get("TPU_DDP_GLOBAL_BATCH", "8"))
     if global_batch % world:
         raise ValueError(f"TPU_DDP_GLOBAL_BATCH={global_batch} not "
@@ -68,10 +71,11 @@ def main(argv=None) -> int:
     mesh = make_mesh()
     trainer = LMTrainer(
         model, mesh,
-        param_sharding="fsdp" if fsdp else "replicated")
+        param_sharding="fsdp" if fsdp else "replicated",
+        grad_accum=accum, sp_mode=sp_mode)
     state = trainer.init_state(seed=0)
     print(f"[lm_train] rank={rank} world={world} dp={trainer.dp} "
-          f"sp={trainer.sp} fsdp={fsdp} preset={preset}")
+          f"sp={trainer.sp} fsdp={fsdp} accum={accum} preset={preset}")
 
     # Deterministic synthetic tokens, identical on every process; each
     # process feeds ITS contiguous shard of the global batch.

@@ -1,0 +1,85 @@
+"""Gradient accumulation (microbatching) in the LM engine.
+
+The decisive property: a step with grad_accum=A on batch B produces the
+SAME parameter update as one plain step on the full batch — accumulation
+is a memory lever, not a different optimizer. (No reference counterpart:
+its fixed global batch of 256 needs no splitting — SURVEY.md §5.)
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_ddp.models.transformer import make_transformer
+from tpu_ddp.parallel.mesh import make_mesh
+from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+
+import jax.numpy as jnp
+
+
+def _model():
+    return make_transformer("TransformerLM-tiny", max_seq_len=32,
+                            compute_dtype=jnp.float32)
+
+
+def _tokens(b=8):
+    rng = np.random.default_rng(5)
+    return rng.integers(0, 1024, size=(b, 33))
+
+
+def _step(devices, grad_accum, param_sharding="replicated", dp=2, sp=1):
+    # SGD, not AdamW: the update is LINEAR in the gradient, so the
+    # accumulated and single-shot steps must agree to fp-roundoff — AdamW's
+    # g/sqrt(v) normalization amplifies harmless summation-order noise
+    # unboundedly wherever a gradient element is ~0.
+    from tpu_ddp.ops.optim import SGD
+    mesh = make_mesh(devices[:dp * sp], dp=dp, sp=sp)
+    tr = LMTrainer(_model(), mesh, grad_accum=grad_accum,
+                   param_sharding=param_sharding,
+                   optimizer=SGD(learning_rate=0.1, momentum=0.9,
+                                 weight_decay=1e-4))
+    state = tr.init_state(seed=21)
+    x, y = tr.put_batch(*make_lm_batch(_tokens()))
+    state, loss = tr.train_step(state, x, y)
+    params = jax.device_get(state.params)
+    if param_sharding == "fsdp":
+        params = tr.zero3.unshard_host(params)
+    return params, float(np.mean(np.asarray(loss)))
+
+
+class TestGradAccum:
+    @pytest.mark.parametrize("accum", [2, 4])
+    def test_matches_single_step(self, devices, accum):
+        p1, l1 = _step(devices, 1)
+        pa, la = _step(devices, accum)
+        assert abs(l1 - la) < 1e-5
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(pa)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=1e-6)
+
+    def test_matches_under_fsdp(self, devices):
+        p1, l1 = _step(devices, 1, param_sharding="fsdp")
+        pa, la = _step(devices, 2, param_sharding="fsdp")
+        assert abs(l1 - la) < 1e-5
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(pa)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=1e-6)
+
+    def test_matches_under_sp(self, devices):
+        p1, l1 = _step(devices, 1, dp=2, sp=2)
+        pa, la = _step(devices, 2, dp=2, sp=2)
+        assert abs(l1 - la) < 1e-5
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(pa)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=1e-6)
+
+    def test_divisibility_enforced(self, devices):
+        mesh = make_mesh(devices[:2], dp=2)
+        tr = LMTrainer(_model(), mesh, grad_accum=3)
+        with pytest.raises(ValueError, match="grad_accum"):
+            tr.put_batch(*make_lm_batch(_tokens(b=8)))
+
+    def test_invalid_accum_rejected(self, devices):
+        mesh = make_mesh(devices[:2], dp=2)
+        with pytest.raises(ValueError, match="grad_accum"):
+            LMTrainer(_model(), mesh, grad_accum=0)

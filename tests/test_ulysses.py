@@ -146,13 +146,18 @@ class TestUlyssesModel:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-4)
 
-    def test_mode_validation(self):
+    def test_mode_validation(self, devices):
         model = make_transformer("TransformerLM-tiny")
         with pytest.raises(ValueError, match="mode"):
             model.with_sequence_parallel(SEQ_AXIS, 2, mode="spiral")
         with pytest.raises(ValueError, match="ulysses"):
             # 4 heads, sp=8: ulysses impossible, ring would be fine.
             model.with_sequence_parallel(SEQ_AXIS, 8, mode="ulysses")
+        # A typo'd mode fails at construction even on an sp=1 mesh where
+        # it would be inert — not only after scaling sp up.
+        with pytest.raises(ValueError, match="mode"):
+            LMTrainer(model, make_mesh(devices[:2], dp=2),
+                      sp_mode="ulyses")
 
 
 class TestUlyssesTrainer:

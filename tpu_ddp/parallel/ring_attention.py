@@ -137,7 +137,7 @@ def blockwise_attention(q, k, v, causal: bool = False,
         k_pos = idx * bs + jnp.arange(bs)
         state = _block_attn(q, kc, vc, m_prev, l_prev, acc_prev,
                             q_pos, k_pos, causal, scale,
-                            k_valid=k_pos < L)
+                            k_valid=(k_pos < L) if pad else None)
         return state, None
 
     init = (jnp.full((b, h, L), _NEG_INF, jnp.float32),

@@ -186,13 +186,24 @@ class LMTrainer(_MeshTrainer):
     def __init__(self, model, mesh: Mesh, optimizer: AdamW | None = None,
                  moe_aux_coef: float = 0.01,
                  param_sharding: str = "replicated",
-                 vocab_chunk: int = 0, sp_mode: str = "ring"):
+                 vocab_chunk: int = 0, sp_mode: str = "ring",
+                 grad_accum: int = 1):
         self.mesh = mesh
         self.dp = mesh.shape[DATA_AXIS]
         self.sp = mesh.shape[SEQ_AXIS]
         self.tp = mesh.shape.get(MODEL_AXIS, 1)
         self.ep = mesh.shape.get(EXPERT_AXIS, 1)
         self.moe_aux_coef = moe_aux_coef
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        # Validate even on sp=1 meshes (where the mode is inert), so a
+        # typo'd config fails at first use, not after scaling sp up.
+        if sp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown sequence-parallel mode {sp_mode!r};"
+                             " expected 'ring' or 'ulysses'")
+        # > 1: each step scans this many microbatches, accumulating f32
+        # gradients before the (single) sync + optimizer update.
+        self.grad_accum = grad_accum
         # > 0: compute the loss via chunked-vocab CE, never materializing
         # the (T, V) logits (tpu_ddp/ops/loss.py) — the train step's
         # largest buffer at long context. Value = vocab slice width.
@@ -268,8 +279,48 @@ class LMTrainer(_MeshTrainer):
             return g / excluded if excluded > 1 else g
         return jax.tree.map(leaf, grads, self._param_specs)
 
+    def _accumulate(self, grad_fn, params, inputs, targets):
+        """(local_mean_loss, grads) over ``grad_accum`` microbatches.
+
+        A=1 is one plain forward/backward. A>1 splits the local batch
+        into A microbatches and ``lax.scan``s forward+backward over them,
+        summing gradients in f32 — peak activation memory drops by ~A
+        while, for DENSE models, the optimizer sees exactly the
+        full-batch gradient (microbatch shards are equal-sized, so
+        mean-of-microbatch-means == the global token mean;
+        tests/test_grad_accum.py). MoE models route per microbatch:
+        expert capacity and the load-balance aux loss are computed from
+        each microbatch's token mix, so the accumulated step is the mean
+        of A smaller routing problems, not bit-equal to one big one —
+        inherent to accumulation (routing is nonlinear in batch
+        composition), and how every major MoE stack behaves. The standard
+        big-batch lever when the per-step batch no longer fits HBM; no
+        reference counterpart (its global batch of 256 CIFAR images needs
+        no splitting, part2/part2b/main.py:177).
+        """
+        A = self.grad_accum
+        if A == 1:
+            (_, local_mean), grads = grad_fn(params, inputs, targets)
+            return local_mean, grads
+        mb = inputs.shape[0] // A
+        xs = (inputs.reshape(A, mb, inputs.shape[1]),
+              targets.reshape(A, mb, targets.shape[1]))
+
+        def body(carry, xt):
+            g_acc, l_acc = carry
+            (_, lm), g = grad_fn(params, xt[0], xt[1])
+            g_acc = jax.tree.map(
+                lambda a, b: a + b.astype(jnp.float32), g_acc, g)
+            return (g_acc, l_acc + lm), None
+
+        g0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                          params)
+        (g_sum, l_sum), _ = lax.scan(body, (g0, jnp.float32(0.0)), xs)
+        inv = 1.0 / float(A)
+        return l_sum * inv, jax.tree.map(lambda g: g * inv, g_sum)
+
     def _base_step(self, params, opt_state, inputs, targets):
-        def loss_terms(p):
+        def loss_terms(p, inputs, targets):
             if self.vocab_chunk:
                 hidden, aux = self.model.trunk_with_aux(p, inputs)
                 nll = chunked_vocab_cross_entropy(
@@ -291,14 +342,22 @@ class LMTrainer(_MeshTrainer):
             return loss_for_grad, local_sum / local_n
 
         if self.is_fsdp:
-            def loss_fn(flat):
+            def grad_fn(p, x, y):
                 # all_gather over dp materializes full leaves transiently;
                 # the AD transpose reduce-scatters cotangents, delivering
                 # this worker's dp-SUMMED gradient shard directly.
-                return loss_terms(self.zero3.gather_params(flat))
+                return jax.value_and_grad(
+                    lambda flat: loss_terms(self.zero3.gather_params(flat),
+                                            x, y), has_aux=True)(p)
+        else:
+            def grad_fn(p, x, y):
+                return jax.value_and_grad(
+                    lambda q: loss_terms(q, x, y), has_aux=True)(p)
 
-            (_, local_mean), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+        local_mean, grads = self._accumulate(grad_fn, params, inputs,
+                                             targets)
+
+        if self.is_fsdp:
             # Mean over sp (each sequence shard contributed its chunk's
             # grads); the dp sum already happened — divide it out.
             grads = jax.tree.map(
@@ -306,8 +365,6 @@ class LMTrainer(_MeshTrainer):
             params, opt_state = self.zero3.apply(params, grads, opt_state)
             return params, opt_state, local_mean.reshape(1, 1)
 
-        (_, local_mean), grads = jax.value_and_grad(
-            loss_terms, has_aux=True)(params)
         grads = self._sync_grads(grads)
         params, opt_state = self.optimizer.apply(
             params, grads, opt_state, decay_mask=self._decay_mask(params))
@@ -322,6 +379,10 @@ class LMTrainer(_MeshTrainer):
         if gb % (self.dp * self.ep):
             raise ValueError(f"global batch {gb} not divisible by dp*ep="
                              f"{self.dp * self.ep}")
+        if (gb // (self.dp * self.ep)) % self.grad_accum:
+            raise ValueError(
+                f"per-shard batch {gb // (self.dp * self.ep)} not "
+                f"divisible by grad_accum={self.grad_accum}")
         if L % self.sp:
             raise ValueError(f"seq len {L} not divisible by sp={self.sp}")
         return (self._put_sharded(inputs, self._batch_sharding),
