@@ -67,6 +67,76 @@ class TestCheckpointCore:
             ckpt.restore_checkpoint(str(tmp_path), bad_shape)
 
 
+class TestAsyncWriter:
+    def test_roundtrip_after_wait(self, tmp_path):
+        w = ckpt.AsyncCheckpointWriter()
+        tree = _tree()
+        path = w.submit(str(tmp_path), tree, step=3)
+        w.wait()
+        assert os.path.isdir(path)
+        restored, step = ckpt.restore_checkpoint(str(tmp_path), tree)
+        assert step == 3
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), tree, restored)
+
+    def test_submits_are_ordered_and_keep_last_applies(self, tmp_path):
+        w = ckpt.AsyncCheckpointWriter()
+        for s in (1, 2, 3):
+            w.submit(str(tmp_path), _tree(seed=s), step=s, keep_last=2)
+        w.wait()
+        assert ckpt.all_steps(str(tmp_path)) == [2, 3]
+
+    def test_snapshot_is_consistent(self, tmp_path):
+        """The host snapshot happens at submit time: mutating the source
+        arrays afterwards must not leak into the written checkpoint."""
+        tree = {"a": np.zeros((1000, 100), np.float32)}
+        w = ckpt.AsyncCheckpointWriter()
+        w.submit(str(tmp_path), {"a": jax.numpy.asarray(tree["a"])},
+                 step=1)
+        tree["a"][:] = 7.0  # the device array snapshot is independent
+        w.wait()
+        restored, _ = ckpt.restore_checkpoint(str(tmp_path), tree)
+        assert float(np.abs(restored["a"]).max()) == 0.0
+
+    def test_write_failure_surfaces(self, tmp_path):
+        w = ckpt.AsyncCheckpointWriter()
+        target = tmp_path / "f"
+        target.write_text("not a directory")
+        w.submit(str(target), _tree(), step=1)  # mkdir over a file fails
+        with pytest.raises(RuntimeError, match="background checkpoint"):
+            w.wait()
+        # The error is consumed: the writer is reusable afterwards.
+        w.submit(str(tmp_path), _tree(), step=2)
+        w.wait()
+        assert ckpt.all_steps(str(tmp_path)) == [2]
+
+    def test_trainer_background_save(self, tmp_path, devices):
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.parallel.mesh import make_mesh
+        from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+
+        model = make_transformer("TransformerLM-tiny", max_seq_len=16,
+                                 compute_dtype=jax.numpy.float32)
+        tr = LMTrainer(model, make_mesh(devices[:2], dp=2))
+        state = tr.init_state(seed=0)
+        tokens = np.random.default_rng(0).integers(0, 1024, size=(2, 17))
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        state, _ = tr.train_step(state, x, y)
+        # Snapshot BEFORE the next step: train_step donates its input
+        # state's buffers, so `state.params` is dead after stepping on it.
+        want = jax.tree.map(lambda x: np.array(x, copy=True),
+                            jax.device_get(state.params))
+        saved_step = state.step
+        tr.save_checkpoint(str(tmp_path), state, background=True)
+        state2, _ = tr.train_step(state, x, y)  # train while it writes
+        tr.wait_for_checkpoints()
+        restored = tr.restore_checkpoint(str(tmp_path))
+        assert restored.step == saved_step
+        for a, b in zip(jax.tree.leaves(want),
+                        jax.tree.leaves(jax.device_get(restored.params))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestTrainerResume:
     def _batch(self, n=8):
         rng = np.random.default_rng(0)

@@ -148,9 +148,17 @@ class Trainer:
     # ---- checkpoint / resume (no reference equivalent, SURVEY.md §5) ---
 
     def save_checkpoint(self, directory: str, state: TrainState,
-                        keep_last: int | None = None) -> str | None:
+                        keep_last: int | None = None,
+                        background: bool = False) -> str | None:
         """Write ``state`` at its step; only process 0 writes (state under
-        DP is replicated). Returns the path (None on non-zero processes)."""
+        DP is replicated). Returns the path (None on non-zero processes).
+
+        ``background=True`` snapshots to host synchronously, then hands
+        serialization + disk I/O to a writer thread
+        (utils/checkpoint.py:AsyncCheckpointWriter) — call
+        :meth:`wait_for_checkpoints` before reading the file back or
+        exiting. Any gather collectives for sharded state still run
+        synchronously on every process."""
         params = state.params
         opt_state = state.opt_state
         if self.mesh is not None and (self.is_zero or self.is_fsdp):
@@ -175,8 +183,19 @@ class Trainer:
         from tpu_ddp.utils import checkpoint as ckpt
         tree = {"params": params, "opt_state": opt_state,
                 "step": np.int64(state.step)}
+        if background:
+            if not hasattr(self, "_async_writer"):
+                self._async_writer = ckpt.AsyncCheckpointWriter()
+            return self._async_writer.submit(directory, tree, state.step,
+                                             keep_last=keep_last)
         return ckpt.save_checkpoint(directory, tree, step=state.step,
                                     keep_last=keep_last)
+
+    def wait_for_checkpoints(self) -> None:
+        """Block until any background checkpoint write is durable."""
+        writer = getattr(self, "_async_writer", None)
+        if writer is not None:
+            writer.wait()
 
     def restore_checkpoint(self, directory: str,
                            step: int | None = None) -> TrainState:

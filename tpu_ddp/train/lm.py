@@ -124,7 +124,8 @@ class _MeshTrainer:
     # ---- checkpoint / resume (no reference equivalent, SURVEY.md §5) ---
 
     def save_checkpoint(self, directory: str, state: LMTrainState,
-                        keep_last: int | None = None) -> str | None:
+                        keep_last: int | None = None,
+                        background: bool = False) -> str | None:
         """Gather leaves to host LEAF BY LEAF (each gather is a collective
         all processes must enter), then process 0 writes. Per-leaf keeps
         the transient device-memory peak at one leaf's replicated size —
@@ -143,8 +144,21 @@ class _MeshTrainer:
             opt_state = self.zero3.canonicalize_opt_host(opt_state)
         tree = {"params": params, "opt_state": opt_state,
                 "step": np.int64(state.step)}
+        if background:
+            # Gathers above already ran synchronously (collectives);
+            # only serialization + I/O move off-thread.
+            if not hasattr(self, "_async_writer"):
+                self._async_writer = ckpt.AsyncCheckpointWriter()
+            return self._async_writer.submit(directory, tree, state.step,
+                                             keep_last=keep_last)
         return ckpt.save_checkpoint(directory, tree, step=state.step,
                                     keep_last=keep_last)
+
+    def wait_for_checkpoints(self) -> None:
+        """Block until any background checkpoint write is durable."""
+        writer = getattr(self, "_async_writer", None)
+        if writer is not None:
+            writer.wait()
 
     def restore_checkpoint(self, directory: str,
                            step: int | None = None) -> LMTrainState:

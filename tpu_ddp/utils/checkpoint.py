@@ -27,6 +27,7 @@ import os
 import re
 import shutil
 import tempfile
+import threading
 
 import jax
 import numpy as np
@@ -76,6 +77,65 @@ def save_checkpoint(directory: str, state, step: int,
             shutil.rmtree(os.path.join(directory, f"step_{step_i:08d}"),
                           ignore_errors=True)
     return final
+
+
+class AsyncCheckpointWriter:
+    """Background-thread checkpoint writes so the train loop never stalls
+    on serialization + disk I/O (typically the dominant cost — the
+    device->host copy is cheap by comparison and stays synchronous so the
+    snapshot is consistent).
+
+    Contract:
+    - ``submit`` snapshots the tree to host numpy SYNCHRONOUSLY (the
+      caller may donate/mutate device state immediately after), then
+      hands the npz write + atomic rename to the writer thread and
+      returns the path the checkpoint WILL occupy.
+    - At most one write is in flight: a new ``submit`` first joins the
+      previous write, preserving checkpoint ordering (and bounding host
+      memory at one extra state copy).
+    - A failed background write re-raises from the NEXT ``submit``/
+      ``wait`` call — a crashed writer can't be silently ignored.
+    - ``wait()`` blocks until the in-flight write is durable; call it
+      before reading the checkpoint back or exiting the process.
+    """
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+
+    def submit(self, directory: str, state, step: int,
+               keep_last: int | None = None) -> str:
+        # Join the previous write BEFORE snapshotting, so peak host
+        # memory stays at one extra state copy (the in-flight write's),
+        # per the class contract.
+        self.wait()
+        # np.array(copy=True), not bare device_get: on the CPU backend
+        # device_get can return views aliasing the source buffer (donated
+        # or mutated by the very next train step) — the snapshot must own
+        # its memory.
+        host_state = jax.tree_util.tree_map(
+            lambda x: np.array(x, copy=True), jax.device_get(state))
+
+        def write():
+            try:
+                save_checkpoint(directory, host_state, step,
+                                keep_last=keep_last)
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                self._error = e
+
+        self._thread = threading.Thread(target=write, daemon=True,
+                                        name=f"ckpt-write-{step}")
+        self._thread.start()
+        return os.path.join(directory, f"step_{step:08d}")
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("background checkpoint write failed") \
+                from err
 
 
 @functools.lru_cache(maxsize=8)
