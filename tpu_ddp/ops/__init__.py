@@ -6,5 +6,11 @@ implementations that fuse into the jitted train step.
 """
 
 from tpu_ddp.ops.loss import cross_entropy_loss, softmax_cross_entropy  # noqa: F401
-from tpu_ddp.ops.optim import SGD, SGDState  # noqa: F401
+from tpu_ddp.ops.optim import (  # noqa: F401
+    SGD,
+    SGDState,
+    AdamW,
+    Adafactor,
+    warmup_cosine,
+)
 from tpu_ddp.ops.metrics import top1_correct  # noqa: F401

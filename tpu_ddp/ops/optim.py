@@ -159,6 +159,153 @@ class AdamW:
         return new_p, {"mu": new_mu, "nu": new_nu, "count": count}
 
 
+@dataclasses.dataclass(frozen=True)
+class Adafactor:
+    """Adafactor — sublinear-memory second moments (Shazeer & Stern,
+    arXiv:1804.04235; reimplemented from the paper, not from any code).
+
+    The TPU-frugal LM optimizer: for matrix leaves the second moment is
+    stored FACTORED as a row vector + a column vector (O(n+m) instead of
+    O(nm) — for an embedding table that is ~vocab_size x smaller), with
+    the rank-1 reconstruction ``V[i,j] ≈ vr[i]·vc[j] / mean_i(vr)``
+    (mean-form accumulators: vr/vc are per-row/per-column MEANS of the
+    EMA'd g², so the normalizer is the row-moment mean — equivalent to
+    the paper's sum-form ``R·C / 1ᵀR``). Vectors/scalars keep
+    an exact full second moment. Per the paper: update clipping by RMS
+    (``clip_threshold``), increasing decay ``beta2_t = 1 - t^-decay_rate``
+    and, when ``learning_rate`` is None, the relative step size
+    ``min(1e-2, 1/sqrt(t)) * max(eps2, RMS(param))``.
+
+    Same pure-pytree-transform shape as :class:`SGD`/:class:`AdamW`. Not
+    composable with ZeRO/FSDP re-layout or tensor-sharded parameters —
+    the factored state is shape-coupled to whole leaves; those stacks
+    use :class:`AdamW` (``map_param_like``/``state_specs`` refuse
+    loudly rather than silently misfactor).
+    """
+
+    learning_rate: Any = None       # None -> relative step size schedule
+    min_dim_size_to_factor: int = 128
+    decay_rate: float = 0.8
+    eps1: float = 1e-30             # regularizer inside sqrt
+    eps2: float = 1e-3              # RMS(param) floor for relative steps
+    clip_threshold: float = 1.0
+    b1: float | None = None        # optional first moment (off = paper default)
+    weight_decay: float = 0.0
+
+    def _factored(self, shape) -> bool:
+        return (len(shape) >= 2
+                and min(shape[-2:]) >= self.min_dim_size_to_factor)
+
+    def init(self, params) -> dict:
+        one = lambda: jnp.zeros((1,), jnp.float32)  # noqa: E731
+
+        def vr(p):
+            return (jnp.zeros(p.shape[:-1], jnp.float32)
+                    if self._factored(p.shape) else one())
+
+        def vc(p):
+            return (jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32)
+                    if self._factored(p.shape) else one())
+
+        def v(p):
+            return (one() if self._factored(p.shape)
+                    else jnp.zeros_like(p, jnp.float32))
+
+        def mu(p):
+            return jnp.zeros_like(p) if self.b1 is not None else one()
+
+        return {"vr": jax.tree.map(vr, params),
+                "vc": jax.tree.map(vc, params),
+                "v": jax.tree.map(v, params),
+                "mu": jax.tree.map(mu, params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def state_specs(self, param_specs):
+        """Factored moments have REDUCED shapes; only replicated
+        parameters are supported (see class docstring)."""
+        def check(spec):
+            if tuple(x for x in spec if x is not None):
+                raise NotImplementedError(
+                    "Adafactor's factored state does not compose with "
+                    f"sharded parameter leaves (got spec {spec}); use "
+                    "AdamW for tp/ep-sharded models")
+            return spec
+        jax.tree.map(check, param_specs,
+                     is_leaf=lambda x: isinstance(x, PartitionSpec))
+        repl = jax.tree.map(lambda _: PartitionSpec(), param_specs,
+                            is_leaf=lambda x: isinstance(x, PartitionSpec))
+        return {"vr": repl, "vc": repl, "v": repl, "mu": repl,
+                "count": PartitionSpec()}
+
+    def decay_mask(self, params):
+        return jax.tree.map(lambda p: p.ndim >= 2, params)
+
+    def map_param_like(self, state, fn):
+        raise NotImplementedError(
+            "Adafactor's factored state is shape-coupled to its original "
+            "leaves and cannot be re-laid-out by ZeRO/FSDP; use AdamW "
+            "there")
+
+    def apply(self, params, grads, state, decay_mask=None):
+        count = state["count"] + 1
+        c = count.astype(jnp.float32)
+        beta2t = 1.0 - c ** (-self.decay_rate)
+        if self.learning_rate is None:
+            rho = jnp.minimum(1e-2, 1.0 / jnp.sqrt(c))
+            lr = None
+        else:
+            lr = (self.learning_rate(c) if callable(self.learning_rate)
+                  else self.learning_rate)
+        if decay_mask is None:
+            decay_mask = self.decay_mask(params)
+
+        def upd(p, g, vr, vc, v, mu, dk):
+            g32 = g.astype(jnp.float32)
+            g2 = jnp.square(g32) + self.eps1
+            if self._factored(p.shape):
+                new_vr = beta2t * vr + (1 - beta2t) * jnp.mean(g2, axis=-1)
+                new_vc = beta2t * vc + (1 - beta2t) * jnp.mean(g2, axis=-2)
+                new_v = v
+                # V[i,j] ≈ vr[i]·vc[j] / mean_i(vr) — exact for rank-1
+                # g² (with mean-form accumulators the normalizer is the
+                # row-moment MEAN, not its sum); rsqrt applied factored
+                # so the (n, m) moment matrix is never materialized.
+                r = new_vr / jnp.mean(new_vr, axis=-1, keepdims=True)
+                u = g32 * jax.lax.rsqrt(r[..., :, None]) \
+                    * jax.lax.rsqrt(new_vc[..., None, :])
+            else:
+                new_vr, new_vc = vr, vc
+                new_v = beta2t * v + (1 - beta2t) * g2
+                u = g32 * jax.lax.rsqrt(new_v)
+            rms_u = jnp.sqrt(jnp.mean(jnp.square(u)))
+            u = u / jnp.maximum(1.0, rms_u / self.clip_threshold)
+            if lr is None:
+                rms_p = jnp.sqrt(jnp.mean(jnp.square(
+                    p.astype(jnp.float32))))
+                alpha = rho * jnp.maximum(self.eps2, rms_p)
+            else:
+                alpha = lr
+            if self.b1 is not None:
+                new_mu = self.b1 * mu + (1 - self.b1) * u.astype(p.dtype)
+                step = new_mu
+            else:
+                new_mu = mu
+                step = u
+            new_p = p - (alpha * step
+                         + (alpha * self.weight_decay * p if dk else 0.0)
+                         ).astype(p.dtype)
+            return new_p, new_vr, new_vc, new_v, new_mu
+
+        p_l, treedef = jax.tree.flatten(params)
+        outs = [upd(*args) for args in zip(
+            p_l, jax.tree.leaves(grads), jax.tree.leaves(state["vr"]),
+            jax.tree.leaves(state["vc"]), jax.tree.leaves(state["v"]),
+            jax.tree.leaves(state["mu"]), jax.tree.leaves(decay_mask))]
+        unf = lambda i: treedef.unflatten([o[i] for o in outs])  # noqa: E731
+        return unf(0), {"vr": unf(1), "vc": unf(2), "v": unf(3),
+                        "mu": unf(4), "count": count}
+
+
 def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
                   floor: float = 0.0):
     """Linear warmup to ``peak_lr`` then cosine decay to ``floor`` — the
