@@ -1,0 +1,134 @@
+"""Grouped-query attention (tpu_ddp/models/transformer.py num_kv_heads).
+
+Decisive properties: (i) the KV projection and decode cache shrink to
+num_kv_heads while logits stay causal and well-formed; (ii) GQA with
+group size 1 (kv == heads via expand) changes nothing; (iii) GQA
+composes with the sharded paths (tp, sp ring, sp ulysses) computing the
+same function as single-device; (iv) decode with the KV-width cache
+matches the full forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_ddp.models.transformer import make_transformer
+from tpu_ddp.parallel.mesh import MODEL_AXIS, SEQ_AXIS, make_mesh
+
+
+def _gqa(kv=2, **kw):
+    kw.setdefault("max_seq_len", 32)
+    return make_transformer("TransformerLM-tiny",
+                            compute_dtype=jnp.float32, num_kv_heads=kv,
+                            **kw)
+
+
+class TestParams:
+    def test_layout_and_shapes(self):
+        model = _gqa(kv=2)  # 4 q heads, 2 kv heads
+        params = model.init(jax.random.key(0))
+        blk = params["blocks"][0]
+        assert "wqkv" not in blk
+        assert blk["wq"].shape == (128, 4, 32)
+        assert blk["wkv"].shape == (128, 2, 2, 32)
+
+    def test_mha_layout_unchanged(self):
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        blk = model.init(jax.random.key(0))["blocks"][0]
+        assert "wq" not in blk and blk["wqkv"].shape == (128, 3, 4, 32)
+
+    def test_divisibility_enforced(self):
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            _gqa(kv=3)
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            _gqa(kv=0)
+
+    def test_tp_requires_kv_divisibility(self):
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            _gqa(kv=2).with_tensor_parallel(MODEL_AXIS, 4)
+
+
+class TestForward:
+    def test_causal_property(self):
+        model = _gqa(kv=2, max_seq_len=16)
+        params = model.init(jax.random.key(1))
+        t = jax.random.randint(jax.random.key(2), (1, 16), 0, 1024)
+        l1 = model.apply(params, t)
+        t2 = t.at[0, 10].set((t[0, 10] + 7) % 1024)
+        l2 = model.apply(params, t2)
+        np.testing.assert_allclose(np.asarray(l1[:, :10]),
+                                   np.asarray(l2[:, :10]),
+                                   rtol=1e-5, atol=1e-5)
+        assert l1.shape == (1, 16, model.vocab_size)
+
+    def test_mqa_extreme(self):
+        """num_kv_heads=1 (multi-query) runs and differs from MHA."""
+        model = _gqa(kv=1, max_seq_len=16)
+        params = model.init(jax.random.key(3))
+        t = jax.random.randint(jax.random.key(4), (2, 16), 0, 1024)
+        logits = model.apply(params, t)
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_tp_sharded_matches_single_device(self, devices):
+        model = _gqa(kv=2)
+        params = model.init(jax.random.key(5))
+        tokens = jax.random.randint(jax.random.key(6), (2, 32), 0, 1024)
+        want = model.apply(params, tokens)
+
+        tp = 2
+        mesh = make_mesh(devices[:tp], dp=1, mp=tp)
+        sharded = model.with_tensor_parallel(MODEL_AXIS, tp)
+        specs = sharded.param_specs()
+        fn = jax.jit(jax.shard_map(
+            sharded.apply, mesh=mesh,
+            in_specs=(specs, P()), out_specs=P(), check_vma=False))
+        got = fn(params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("mode", ["ring", "ulysses"])
+    def test_sp_sharded_matches_single_device(self, devices, mode):
+        model = _gqa(kv=2)
+        params = model.init(jax.random.key(7))
+        tokens = jax.random.randint(jax.random.key(8), (2, 32), 0, 1024)
+        want = model.apply(params, tokens)
+
+        sp = 4
+        mesh = make_mesh(devices[:sp], dp=1, sp=sp)
+        sharded = model.with_sequence_parallel(SEQ_AXIS, sp, mode=mode)
+        fn = jax.jit(jax.shard_map(
+            sharded.apply, mesh=mesh,
+            in_specs=(P(), P(None, SEQ_AXIS)),
+            out_specs=P(None, SEQ_AXIS), check_vma=False))
+        got = fn(params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestDecode:
+    def test_cache_is_kv_width(self):
+        from tpu_ddp.models.generate import init_cache
+        model = _gqa(kv=2)
+        caches = init_cache(model, batch=2, max_len=16)
+        ck, cv = caches[0]
+        assert ck.shape == (2, 16, 2, 32)  # KV heads, not 4 Q heads
+
+    def test_cached_decode_matches_full_forward(self):
+        """Greedy next-token from the KV-cache decode path equals the
+        argmax of the full (uncached) forward at every step."""
+        from tpu_ddp.models.generate import generate
+        model = _gqa(kv=2, max_seq_len=32)
+        params = model.init(jax.random.key(9))
+        prompt = jax.random.randint(jax.random.key(10), (2, 5), 0, 1024)
+        out = generate(model, params, prompt, max_new_tokens=6)
+        assert out.shape == (2, 6)  # generated continuation only
+        # Re-derive each generated token from full forwards.
+        seq = np.asarray(prompt)
+        for i in range(6):
+            logits = model.apply(params, jnp.asarray(seq))
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+            assert (nxt == np.asarray(out)[:, i]).all()
+            seq = np.concatenate([seq, nxt[:, None]], axis=1)

@@ -7,7 +7,8 @@ sample with :func:`generate`.
 Design, TPU-first:
 - the whole decode loop is ONE jitted ``lax.scan`` over positions —
   no per-token Python dispatch, static shapes throughout;
-- the KV cache is a preallocated (B, max_len, H, hd) buffer per block,
+- the KV cache is a preallocated (B, max_len, KV, hd) buffer per block
+  (KV = num_kv_heads: under GQA it is num_heads/num_kv_heads smaller),
   written with ``lax.dynamic_update_slice`` and attended over with a
   position mask (the standard static-shape decode pattern);
 - prefill runs the prompt through the same math as
@@ -51,19 +52,6 @@ def _check_dense(model):
                          "apply()'s whole-sequence slot competition")
 
 
-def _block_kv(model, blk, y, pos):
-    """QKV for positions ``pos`` of (B, L, dm) normalized input ``y``:
-    returns rotated q, k and raw v, each (B, L, H, hd). The same math
-    as TransformerLM.block_apply's attention head."""
-    cd = model.compute_dtype
-    b, L = y.shape[0], y.shape[1]
-    h, hd = model.num_heads, model.head_dim
-    wqkv = blk["wqkv"].astype(cd).reshape(model.d_model, -1)
-    qkv = jnp.dot(y, wqkv, preferred_element_type=jnp.float32)
-    qkv = qkv.astype(cd).reshape(b, L, 3, h, hd)
-    q = rope(qkv[:, :, 0], pos)
-    k = rope(qkv[:, :, 1], pos)
-    return q, k, qkv[:, :, 2]
 
 
 def _mlp(model, blk, y):
@@ -77,19 +65,26 @@ def _mlp(model, blk, y):
 
 def _attend_cached(model, q, ck, cv, q_pos):
     """q: (B, Lq, H, hd) at absolute positions ``q_pos``; ck/cv: full
-    (B, max_len, H, hd) caches. Attends each query over cache positions
+    (B, max_len, KV, hd) caches. Attends each query over cache positions
     <= its own — the causal mask also covers not-yet-written slots
-    (their positions exceed every live query's)."""
+    (their positions exceed every live query's). Under GQA the grouped
+    einsum contracts Q heads (B, Lq, KV, G, hd) directly against the
+    KV-width cache — the expansion is never materialized, preserving the
+    smaller cache's bandwidth win (decode is KV-read-bound)."""
     scale = 1.0 / (model.head_dim ** 0.5)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+    b, lq, h, hd = q.shape
+    kv = ck.shape[2]
+    qg = q.reshape(b, lq, kv, h // kv, hd)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, ck,
                         preferred_element_type=jnp.float32) * scale
     k_pos = jnp.arange(ck.shape[1])
-    mask = k_pos[None, None, None, :] > q_pos[None, None, :, None]
+    mask = k_pos[None, None, None, None, :] \
+        > q_pos[None, None, None, :, None]
     scores = jnp.where(mask, _NEG_INF, scores)
     p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32),
+    out = jnp.einsum("bkgqs,bskd->bqkgd", p, cv.astype(jnp.float32),
                      preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    return out.reshape(b, lq, h, hd).astype(q.dtype)
 
 
 def _forward_cached(model, params, tokens, caches, start: int):
@@ -103,7 +98,9 @@ def _forward_cached(model, params, tokens, caches, start: int):
     new_caches = []
     for blk, (ck, cv) in zip(params["blocks"], caches):
         y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
-        q, k, v = _block_kv(model, blk, y, pos)
+        # Same projection as training: q at H heads, k/v at KV-head
+        # width, so the cache stores only the KV heads.
+        q, k, v = model.qkv_proj(blk, y, pos)
         ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
                                       (0, start, 0, 0))
         cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
@@ -121,8 +118,10 @@ def _forward_cached(model, params, tokens, caches, start: int):
 
 
 def init_cache(model, batch: int, max_len: int):
-    """Per-block (K, V) buffers: (B, max_len, H, hd) each."""
-    shape = (batch, max_len, model.num_heads, model.head_dim)
+    """Per-block (K, V) buffers: (B, max_len, KV, hd) each — under GQA
+    the cache is num_heads/num_kv_heads times smaller than MHA's, the
+    scheme's reason to exist (decode is KV-cache-bandwidth-bound)."""
+    shape = (batch, max_len, model.kv_heads, model.head_dim)
     zeros = jnp.zeros(shape, model.compute_dtype)
     return tuple((zeros, zeros) for _ in range(model.num_layers))
 

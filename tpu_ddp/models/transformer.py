@@ -72,6 +72,12 @@ class TransformerLM:
     vocab_size: int = 32000
     num_layers: int = 4
     num_heads: int = 8
+    # Grouped-query attention (Ainslie et al., arXiv:2305.13245): K/V get
+    # ``num_kv_heads`` heads shared by groups of Q heads. None -> MHA
+    # (= num_heads; the "wqkv" param layout is kept bit-compatible).
+    # num_kv_heads=1 is multi-query attention. The KV cache shrinks by
+    # num_heads/num_kv_heads (models/generate.py init_cache).
+    num_kv_heads: int | None = None
     d_model: int = 512
     d_ff: int = 2048
     max_seq_len: int = 2048
@@ -112,6 +118,24 @@ class TransformerLM:
         return self.d_model // self.num_heads
 
     @property
+    def kv_heads(self) -> int:
+        return (self.num_kv_heads if self.num_kv_heads is not None
+                else self.num_heads)
+
+    @property
+    def is_gqa(self) -> bool:
+        return self.kv_heads != self.num_heads
+
+    def __post_init__(self):
+        if self.kv_heads < 1:
+            raise ValueError(f"num_kv_heads must be >= 1, got "
+                             f"{self.kv_heads}")
+        if self.num_heads % self.kv_heads:
+            raise ValueError(
+                f"num_heads={self.num_heads} not divisible by "
+                f"num_kv_heads={self.kv_heads}")
+
+    @property
     def _tp(self) -> int:
         return self.tp_size if self.tp_axis is not None else 1
 
@@ -145,13 +169,22 @@ class TransformerLM:
             blk = {
                 "ln1": {"scale": jnp.ones((dm,), self.param_dtype),
                         "bias": jnp.zeros((dm,), self.param_dtype)},
-                "wqkv": _normal(next(keys), (dm, 3, h, hd), std,
-                                self.param_dtype),
                 "wo": _normal(next(keys), (h, hd, dm), std,
                               self.param_dtype),
                 "ln2": {"scale": jnp.ones((dm,), self.param_dtype),
                         "bias": jnp.zeros((dm,), self.param_dtype)},
             }
+            if self.is_gqa:
+                # Separate Q and (smaller) KV projections; the fused
+                # "wqkv" layout stays reserved for MHA back-compat.
+                blk["wq"] = _normal(next(keys), (dm, h, hd), std,
+                                    self.param_dtype)
+                blk["wkv"] = _normal(next(keys), (dm, 2, self.kv_heads,
+                                                  hd), std,
+                                     self.param_dtype)
+            else:
+                blk["wqkv"] = _normal(next(keys), (dm, 3, h, hd), std,
+                                      self.param_dtype)
             if E:
                 # MoE MLP: stacked expert weights + a router.
                 blk["router"] = _normal(next(keys), (dm, E), std,
@@ -182,10 +215,14 @@ class TransformerLM:
         ln = {"scale": P(), "bias": P()}
         blk = {
             "ln1": dict(ln),
-            "wqkv": P(None, None, tp, None),
             "wo": P(tp, None, None),
             "ln2": dict(ln),
         }
+        if self.is_gqa:
+            blk["wq"] = P(None, tp, None)
+            blk["wkv"] = P(None, None, tp, None)
+        else:
+            blk["wqkv"] = P(None, None, tp, None)
         if self.moe_experts:
             blk["router"] = P()
             blk["w1"] = P(ep, None, tp)
@@ -286,19 +323,50 @@ class TransformerLM:
         """
         return self.block_apply_aux(blk, x, pos)[0]
 
+    def qkv_proj(self, blk, y, pos):
+        """Projected + RoPE'd q (B, L, H/tp, hd) and k/v (B, L, KV/tp,
+        hd) from normalized input ``y`` (``_tp_in`` already applied by
+        the caller under tensor parallelism). Column-parallel: local
+        heads only, zero communication. One fused "wqkv" matmul for MHA;
+        separate "wq"/"wkv" for GQA (KV/tp heads, the smaller
+        projection). Shared by training (block_apply_aux) and KV-cache
+        decode (models/generate.py)."""
+        cd = self.compute_dtype
+        b, lc, hd = y.shape[0], y.shape[1], self.head_dim
+        h_loc = self.num_heads // self._tp
+        if "wqkv" in blk:
+            wqkv = blk["wqkv"].astype(cd).reshape(self.d_model, -1)
+            qkv = jnp.dot(y, wqkv, preferred_element_type=jnp.float32)
+            qkv = qkv.astype(cd).reshape(b, lc, 3, h_loc, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            kv_loc = self.kv_heads // self._tp
+            wq = blk["wq"].astype(cd).reshape(self.d_model, -1)
+            q = jnp.dot(y, wq, preferred_element_type=jnp.float32)
+            q = q.astype(cd).reshape(b, lc, h_loc, hd)
+            wkv = blk["wkv"].astype(cd).reshape(self.d_model, -1)
+            kvp = jnp.dot(y, wkv, preferred_element_type=jnp.float32)
+            kvp = kvp.astype(cd).reshape(b, lc, 2, kv_loc, hd)
+            k, v = kvp[:, :, 0], kvp[:, :, 1]
+        return rope(q, pos), rope(k, pos), v
+
+    def expand_kv(self, k, v):
+        """Broadcast KV heads up to the Q head count — each GQA group of
+        Q heads shares one KV head. Identity for MHA. Runs just before
+        attention, so params, activations up to here, and the decode KV
+        cache all stay at KV-head width."""
+        rep = (self.num_heads // self._tp) // k.shape[2]
+        if rep == 1:
+            return k, v
+        return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
     def block_apply_aux(self, blk, x, pos):
         cd = self.compute_dtype
         b, lc = x.shape[0], x.shape[1]
         h_loc, hd = self.num_heads // self._tp, self.head_dim
         y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
-        # Column-parallel QKV: local heads only, zero communication.
-        wqkv = blk["wqkv"].astype(cd).reshape(self.d_model, -1)
-        qkv = jnp.dot(self._tp_in(y), wqkv,
-                      preferred_element_type=jnp.float32)
-        qkv = qkv.astype(cd).reshape(b, lc, 3, h_loc, hd)
-        q = rope(qkv[:, :, 0], pos)
-        k = rope(qkv[:, :, 1], pos)
-        v = qkv[:, :, 2]
+        q, k, v = self.qkv_proj(blk, self._tp_in(y), pos)
+        k, v = self.expand_kv(k, v)
         o = attend(q, k, v, causal=True, axis_name=self.sp_axis,
                    axis_size=self.sp_size, flash=self.use_flash,
                    mode=self.sp_mode)
@@ -356,6 +424,9 @@ class TransformerLM:
         if self.num_heads % axis_size:
             raise ValueError(f"num_heads={self.num_heads} not divisible by "
                              f"tp={axis_size}")
+        if self.kv_heads % axis_size:
+            raise ValueError(f"num_kv_heads={self.kv_heads} not divisible "
+                             f"by tp={axis_size}")
         if self.d_ff % axis_size:
             raise ValueError(f"d_ff={self.d_ff} not divisible by "
                              f"tp={axis_size}")
