@@ -52,8 +52,6 @@ def _check_dense(model):
                          "apply()'s whole-sequence slot competition")
 
 
-
-
 def _mlp(model, blk, y):
     cd = model.compute_dtype
     y = jnp.dot(y, blk["w1"].astype(cd),

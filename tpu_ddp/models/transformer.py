@@ -334,7 +334,10 @@ class TransformerLM:
         cd = self.compute_dtype
         b, lc, hd = y.shape[0], y.shape[1], self.head_dim
         h_loc = self.num_heads // self._tp
-        if "wqkv" in blk:
+        # Dispatch on the STATIC config, not the params keys: a config/
+        # checkpoint layout mismatch then fails immediately with a
+        # KeyError instead of silently training the other scheme.
+        if not self.is_gqa:
             wqkv = blk["wqkv"].astype(cd).reshape(self.d_model, -1)
             qkv = jnp.dot(y, wqkv, preferred_element_type=jnp.float32)
             qkv = qkv.astype(cd).reshape(b, lc, 3, h_loc, hd)
@@ -354,7 +357,14 @@ class TransformerLM:
         """Broadcast KV heads up to the Q head count — each GQA group of
         Q heads shares one KV head. Identity for MHA. Runs just before
         attention, so params, activations up to here, and the decode KV
-        cache all stay at KV-head width."""
+        cache all stay at KV-head width.
+
+        Training attends at expanded width (attention there is
+        FLOPs-bound: the L x L score work is identical either way); the
+        ring ppermute / ulysses all_to_all consequently carry G x the
+        minimal K/V bytes — an accepted trade until the sp kernels grow
+        grouped-head support. Decode, which IS KV-bandwidth-bound, never
+        expands (models/generate.py grouped einsum)."""
         rep = (self.num_heads // self._tp) // k.shape[2]
         if rep == 1:
             return k, v
