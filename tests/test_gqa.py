@@ -108,6 +108,85 @@ class TestForward:
                                    rtol=2e-4, atol=2e-4)
 
 
+class TestGroupedKernels:
+    """attend()'s GQA paths contract KV-width k/v without expansion;
+    every path must equal the materialized-expansion reference."""
+
+    def _qkv(self, key, L=32, h=4, kv=2, d=16):
+        ks = jax.random.split(key, 3)
+        q = jax.random.normal(ks[0], (2, L, h, d))
+        k = jax.random.normal(ks[1], (2, L, kv, d))
+        v = jax.random.normal(ks[2], (2, L, kv, d))
+        return q, k, v
+
+    def _expanded(self, q, k, v, causal):
+        from tpu_ddp.parallel.ring_attention import (full_attention,
+                                                     repeat_kv_heads)
+        k, v = repeat_kv_heads(k, v, q.shape[2] // k.shape[2])
+        return full_attention(q, k, v, causal=causal)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_full_grouped(self, causal):
+        from tpu_ddp.parallel.ring_attention import full_attention
+        q, k, v = self._qkv(jax.random.key(20))
+        np.testing.assert_allclose(
+            np.asarray(full_attention(q, k, v, causal=causal)),
+            np.asarray(self._expanded(q, k, v, causal)),
+            rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_blockwise_grouped(self, causal):
+        from tpu_ddp.parallel.ring_attention import blockwise_attention
+        q, k, v = self._qkv(jax.random.key(21))
+        got = blockwise_attention(q, k, v, causal=causal, block_size=8)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(self._expanded(q, k, v, causal)),
+            rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("kv,sp", [(2, 2), (2, 4), (1, 4)])
+    def test_ring_grouped(self, devices, kv, sp):
+        from tpu_ddp.parallel.ring_attention import ring_attention
+        q, k, v = self._qkv(jax.random.key(22), kv=kv)
+        mesh = make_mesh(devices[:sp], dp=1, sp=sp)
+        fn = jax.jit(jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS, sp,
+                                           causal=True),
+            mesh=mesh, in_specs=(P(None, SEQ_AXIS),) * 3,
+            out_specs=P(None, SEQ_AXIS), check_vma=False))
+        np.testing.assert_allclose(
+            np.asarray(fn(q, k, v)),
+            np.asarray(self._expanded(q, k, v, True)),
+            rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("kv,sp", [(2, 2), (2, 4), (1, 2)])
+    def test_ulysses_grouped(self, devices, kv, sp):
+        """kv % sp == 0 scatters grouped K/V; kv % sp != 0 falls back to
+        pre-collective expansion — both must be exact."""
+        from tpu_ddp.parallel.ulysses import ulysses_attention
+        q, k, v = self._qkv(jax.random.key(23), kv=kv)
+        mesh = make_mesh(devices[:sp], dp=1, sp=sp)
+        fn = jax.jit(jax.shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, SEQ_AXIS, sp,
+                                              causal=True),
+            mesh=mesh, in_specs=(P(None, SEQ_AXIS),) * 3,
+            out_specs=P(None, SEQ_AXIS), check_vma=False))
+        np.testing.assert_allclose(
+            np.asarray(fn(q, k, v)),
+            np.asarray(self._expanded(q, k, v, True)),
+            rtol=2e-5, atol=2e-5)
+
+    def test_flash_gqa_model(self):
+        """use_flash + GQA at sp=1: the kernel sees expanded K/V, logits
+        match the non-flash model."""
+        base = _gqa(kv=2, max_seq_len=16)
+        flash = _gqa(kv=2, max_seq_len=16, use_flash=True)
+        params = base.init(jax.random.key(24))
+        t = jax.random.randint(jax.random.key(25), (2, 16), 0, 1024)
+        np.testing.assert_allclose(np.asarray(flash.apply(params, t)),
+                                   np.asarray(base.apply(params, t)),
+                                   rtol=2e-4, atol=2e-4)
+
+
 class TestDecode:
     def test_cache_is_kv_width(self):
         from tpu_ddp.models.generate import init_cache

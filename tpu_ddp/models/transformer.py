@@ -353,30 +353,16 @@ class TransformerLM:
             k, v = kvp[:, :, 0], kvp[:, :, 1]
         return rope(q, pos), rope(k, pos), v
 
-    def expand_kv(self, k, v):
-        """Broadcast KV heads up to the Q head count — each GQA group of
-        Q heads shares one KV head. Identity for MHA. Runs just before
-        attention, so params, activations up to here, and the decode KV
-        cache all stay at KV-head width.
-
-        Training attends at expanded width (attention there is
-        FLOPs-bound: the L x L score work is identical either way); the
-        ring ppermute / ulysses all_to_all consequently carry G x the
-        minimal K/V bytes — an accepted trade until the sp kernels grow
-        grouped-head support. Decode, which IS KV-bandwidth-bound, never
-        expands (models/generate.py grouped einsum)."""
-        rep = (self.num_heads // self._tp) // k.shape[2]
-        if rep == 1:
-            return k, v
-        return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
-
     def block_apply_aux(self, blk, x, pos):
         cd = self.compute_dtype
         b, lc = x.shape[0], x.shape[1]
         h_loc, hd = self.num_heads // self._tp, self.head_dim
         y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+        # Under GQA k/v stay at KV-head width end to end: attend()'s
+        # ring/blockwise/full paths contract grouped, so collectives and
+        # score math carry the minimal bytes (only the flash kernel
+        # materializes the expansion, and for ulysses only post-gather).
         q, k, v = self.qkv_proj(blk, self._tp_in(y), pos)
-        k, v = self.expand_kv(k, v)
         o = attend(q, k, v, causal=True, axis_name=self.sp_axis,
                    axis_size=self.sp_size, flash=self.use_flash,
                    mode=self.sp_mode)

@@ -46,10 +46,23 @@ def _block_attn(q, k, v, m_prev, l_prev, acc_prev, q_pos, k_pos, causal,
     State: m (B, H, Lq) running max, l (B, H, Lq) running sum,
     acc (B, Lq, H, D) unnormalized output. All state float32.
     ``k_valid`` (bool (Lk,), optional) masks out padded key positions.
+
+    Grouped-query attention: ``k``/``v`` may carry KV < H heads (H % KV
+    == 0) — each contiguous group of H/KV query heads contracts against
+    its shared KV head directly, the expansion never materialized. Head
+    order matches ``jnp.repeat(k, H // KV, axis=2)`` (group-contiguous).
     """
+    b, lq, h, d = q.shape
+    kvh = k.shape[2]
     # scores: (B, H, Lq, Lk) in f32 (MXU accumulates f32 from bf16 inputs).
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+    if kvh == h:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+    else:
+        qg = q.reshape(b, lq, kvh, h // kvh, d)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
+                            preferred_element_type=jnp.float32
+                            ).reshape(b, h, lq, -1) * scale
     if causal:
         mask = k_pos[None, None, None, :] > q_pos[None, None, :, None]
         scores = jnp.where(mask, _NEG_INF, scores)
@@ -59,8 +72,15 @@ def _block_attn(q, k, v, m_prev, l_prev, acc_prev, q_pos, k_pos, causal,
     p = jnp.exp(scores - m_new[..., None])                     # (B,H,Lq,Lk)
     correction = jnp.exp(m_prev - m_new)                       # (B,H,Lq)
     l_new = correction * l_prev + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
-                    preferred_element_type=jnp.float32)
+    v32 = v.astype(jnp.float32)
+    if kvh == h:
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v32,
+                        preferred_element_type=jnp.float32)
+    else:
+        pg = p.reshape(b, kvh, h // kvh, lq, p.shape[-1])
+        pv = jnp.einsum("bkgqs,bskd->bqkgd", pg, v32,
+                        preferred_element_type=jnp.float32
+                        ).reshape(b, lq, h, d)
     acc_new = acc_prev * correction.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, acc_new
 
@@ -113,6 +133,7 @@ def blockwise_attention(q, k, v, causal: bool = False,
     when the Pallas flash kernel is off, tpu_ddp/parallel/ulysses.py).
     """
     b, L, h, d = q.shape
+    kvh = k.shape[2]  # may be < h under grouped-query attention
     bs = min(block_size, L)
     n = -(-L // bs)
     pad = n * bs - L
@@ -121,10 +142,10 @@ def blockwise_attention(q, k, v, causal: bool = False,
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
     scale = 1.0 / (d ** 0.5)
     q_pos = jnp.arange(L)
-    # (n, B, bs, H, D) so lax.scan carries the online-softmax state over
+    # (n, B, bs, KV, D) so lax.scan carries the online-softmax state over
     # key blocks; XLA keeps only one block's scores live at a time.
-    kb = jnp.moveaxis(k.reshape(b, n, bs, h, d), 1, 0)
-    vb = jnp.moveaxis(v.reshape(b, n, bs, h, d), 1, 0)
+    kb = jnp.moveaxis(k.reshape(b, n, bs, kvh, d), 1, 0)
+    vb = jnp.moveaxis(v.reshape(b, n, bs, kvh, d), 1, 0)
 
     # Remat the block update: without it, scan's VJP stacks every block's
     # (B, H, L, bs) probabilities — O(L^2) residuals, the exact buffer
@@ -149,19 +170,43 @@ def blockwise_attention(q, k, v, causal: bool = False,
 
 
 def full_attention(q, k, v, causal: bool = False):
-    """Single-device reference: same math, whole sequence resident."""
+    """Single-device reference: same math, whole sequence resident.
+    Accepts grouped-query k/v (KV < H heads) without expansion."""
     b, L, h, d = q.shape
+    kvh = k.shape[2]
     scale = 1.0 / (d ** 0.5)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+    if kvh == h:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+    else:
+        qg = q.reshape(b, L, kvh, h // kvh, d)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
+                            preferred_element_type=jnp.float32
+                            ).reshape(b, h, L, L) * scale
     if causal:
         pos = jnp.arange(L)
         scores = jnp.where(pos[None, None, None, :] > pos[None, None, :, None],
                            _NEG_INF, scores)
     p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
-                     preferred_element_type=jnp.float32)
+    v32 = v.astype(jnp.float32)
+    if kvh == h:
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v32,
+                         preferred_element_type=jnp.float32)
+    else:
+        pg = p.reshape(b, kvh, h // kvh, L, L)
+        out = jnp.einsum("bkgqs,bskd->bqkgd", pg, v32,
+                         preferred_element_type=jnp.float32
+                         ).reshape(b, L, h, d)
     return out.astype(q.dtype)
+
+
+def repeat_kv_heads(k, v, rep: int):
+    """Materialize the GQA expansion (group-contiguous, matching
+    ``_block_attn``'s grouped contraction order) — only for consumers
+    with no grouped path (the Pallas flash kernel)."""
+    if rep == 1:
+        return k, v
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
 
 
 def attend(q, k, v, *, causal: bool = False, axis_name: str | None = None,
@@ -170,7 +215,12 @@ def attend(q, k, v, *, causal: bool = False, axis_name: str | None = None,
     """Dispatch: sequence-parallel attention when a sequence axis is given
     (``mode`` picks the scheme: ``"ring"`` K/V rotation or ``"ulysses"``
     all-to-all head re-sharding, tpu_ddp/parallel/ulysses.py), else the
-    flash Pallas kernel (``flash=True``) or the jnp reference."""
+    flash Pallas kernel (``flash=True``) or the jnp reference.
+
+    Grouped-query attention: ``k``/``v`` may carry fewer heads than
+    ``q`` (H % KV == 0). The ring, blockwise, and full paths contract
+    grouped — KV-width bytes on the wire and in memory; only the flash
+    kernel needs a materialized expansion."""
     if axis_name is not None:
         if axis_size is None:
             # Falling back to full_attention here would silently compute
@@ -190,5 +240,6 @@ def attend(q, k, v, *, causal: bool = False, axis_name: str | None = None,
                                   causal=causal)
     if flash:
         from tpu_ddp.ops.pallas import flash_attention
+        k, v = repeat_kv_heads(k, v, q.shape[2] // k.shape[2])
         return flash_attention(q, k, v, causal)
     return full_attention(q, k, v, causal=causal)

@@ -41,7 +41,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from tpu_ddp.parallel.mesh import SEQ_AXIS
-from tpu_ddp.parallel.ring_attention import blockwise_attention
+from tpu_ddp.parallel.ring_attention import (blockwise_attention,
+                                             repeat_kv_heads)
 
 
 def _heads_to_seq(x, axis_name, stacked: bool = False):
@@ -77,15 +78,27 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     if axis_size is None:
         raise ValueError("axis_size (the sp mesh extent) is required — "
                          "loop bounds must be static under jit")
-    h = q.shape[2]
+    h, kvh = q.shape[2], k.shape[2]
     if h % axis_size:
         raise ValueError(
             f"ulysses_attention needs num_heads % sp == 0 (got heads={h}, "
             f"sp={axis_size}); use ring attention for head-poor models")
-    # One collective for all three tensors: same bytes as three separate
-    # all_to_alls but a single launch on the critical path.
-    qkv = _heads_to_seq(jnp.stack([q, k, v]), axis_name, stacked=True)
-    q, k, v = qkv[0], qkv[1], qkv[2]
+    if kvh != h and kvh % axis_size:
+        # Grouped K/V can only scatter when KV % sp == 0; otherwise the
+        # expansion happens pre-collective (the wire saving is lost, the
+        # result unchanged). Head-contiguous groups survive the a2a: q's
+        # i-th head block maps exactly onto kv's i-th head block.
+        k, v = repeat_kv_heads(k, v, h // kvh)
+        kvh = h
+    if kvh == h:
+        # One collective for all three tensors: same bytes as three
+        # separate all_to_alls but a single launch on the critical path.
+        qkv = _heads_to_seq(jnp.stack([q, k, v]), axis_name, stacked=True)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+    else:
+        q = _heads_to_seq(q, axis_name)
+        kv = _heads_to_seq(jnp.stack([k, v]), axis_name, stacked=True)
+        k, v = kv[0], kv[1]
     # Full sequence is now resident: local positions ARE global positions,
     # so the plain causal mask is exact. Local attention must stay
     # memory-bounded — the gathered L here is sp x the resident chunk, and
@@ -94,6 +107,9 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     # full_attention.
     if flash:
         from tpu_ddp.ops.pallas import flash_attention
+        # Post-gather expansion: the a2a already moved KV-width bytes;
+        # only the kernel input is widened (it has no grouped path).
+        k, v = repeat_kv_heads(k, v, q.shape[2] // k.shape[2])
         out = flash_attention(q, k, v, causal)
     else:
         out = blockwise_attention(q, k, v, causal=causal)
