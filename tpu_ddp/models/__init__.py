@@ -16,6 +16,7 @@ from tpu_ddp.models.vgg import (  # noqa: F401
     make_vgg,
 )
 from tpu_ddp.models.resnet import ResNetModel, resnet50, make_resnet  # noqa: F401
+from tpu_ddp.models.vit import ViTModel, make_vit  # noqa: F401
 from tpu_ddp.models.generate import generate  # noqa: F401
 from tpu_ddp.models.transformer import (  # noqa: F401
     TransformerLM,
@@ -29,6 +30,8 @@ _REGISTRY = {
     "VGG16": vgg16,
     "VGG19": vgg19,
     "ResNet50": resnet50,
+    "ViT-tiny": _functools.partial(make_vit, "ViT-tiny"),
+    "ViT-S16": _functools.partial(make_vit, "ViT-S16"),
     "TransformerLM-tiny": _functools.partial(make_transformer,
                                              "TransformerLM-tiny"),
     "TransformerLM-small": _functools.partial(make_transformer,

@@ -1,0 +1,201 @@
+"""Vision Transformer — patch-embedding image classifier.
+
+No reference counterpart (the reference ships only VGG,
+part1/model.py:49-50); this family bridges the zoo's CNN side (VGG/
+ResNet, trained by tpu_ddp/train/engine.py) and its transformer side —
+one model that exercises the engine's image pipeline AND the attention
+stack (Dosovitskiy et al., "An Image is Worth 16x16 Words",
+arXiv:2010.11929 — reimplemented from the paper, not from any code).
+
+TPU-first choices:
+- patch embedding is ONE matmul over flattened patches (a stride-p conv
+  is the same linear map, but the reshape+dot form feeds the MXU a
+  single large GEMM);
+- bidirectional attention through the shared ``attend`` dispatch
+  (tpu_ddp/parallel/ring_attention.py) — the Pallas flash kernel is one
+  flag away (``use_flash``), as is blockwise streaming;
+- bf16 compute / f32 params and LayerNorm statistics, like the rest of
+  the zoo; global-average-pool head (no CLS token: GAP is the simpler
+  exact-equivalent classifier head and one less special token to shard).
+
+Same functional contract as VGG/ResNet (init/apply over a pytree), so
+the Trainer engine, the DP ladder parts, checkpointing, and bench.py all
+work unchanged via ``get_model("ViT-tiny")``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpu_ddp.models.transformer import _normal, layer_norm
+from tpu_ddp.parallel.ring_attention import attend
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTModel:
+    name: str = "ViT"
+    image_size: int = 32
+    patch_size: int = 4
+    num_classes: int = 10
+    in_channels: int = 3
+    num_layers: int = 6
+    num_heads: int = 4
+    d_model: int = 256
+    d_ff: int = 1024
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    use_flash: bool = False
+    # Rematerialize each block in the backward pass (jax.checkpoint).
+    remat_blocks: bool = False
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size={self.image_size} not divisible by "
+                f"patch_size={self.patch_size}")
+        if self.d_model % self.num_heads:
+            raise ValueError(f"d_model={self.d_model} not divisible by "
+                             f"num_heads={self.num_heads}")
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    # ---- parameters ----------------------------------------------------
+
+    def init(self, key) -> dict:
+        dm, dff = self.d_model, self.d_ff
+        h, hd = self.num_heads, self.head_dim
+        p = self.patch_size
+        std = 0.02
+        keys = iter(jax.random.split(key, 3 + 4 * self.num_layers))
+        params = {
+            "patch": {
+                "kernel": _normal(next(keys),
+                                  (p * p * self.in_channels, dm), std,
+                                  self.param_dtype),
+                "bias": jnp.zeros((dm,), self.param_dtype),
+            },
+            "pos": _normal(next(keys), (self.num_patches, dm), std,
+                           self.param_dtype),
+            "ln_f": {"scale": jnp.ones((dm,), self.param_dtype),
+                     "bias": jnp.zeros((dm,), self.param_dtype)},
+            "head": {
+                "kernel": _normal(next(keys), (dm, self.num_classes),
+                                  std, self.param_dtype),
+                "bias": jnp.zeros((self.num_classes,), self.param_dtype),
+            },
+        }
+        blocks = []
+        for _ in range(self.num_layers):
+            blocks.append({
+                "ln1": {"scale": jnp.ones((dm,), self.param_dtype),
+                        "bias": jnp.zeros((dm,), self.param_dtype)},
+                "wqkv": _normal(next(keys), (dm, 3, h, hd), std,
+                                self.param_dtype),
+                "wo": _normal(next(keys), (h, hd, dm), std,
+                              self.param_dtype),
+                "ln2": {"scale": jnp.ones((dm,), self.param_dtype),
+                        "bias": jnp.zeros((dm,), self.param_dtype)},
+                "w1": _normal(next(keys), (dm, dff), std,
+                              self.param_dtype),
+                "w2": _normal(next(keys), (dff, dm), std,
+                              self.param_dtype),
+            })
+        params["blocks"] = tuple(blocks)
+        return params
+
+    # ---- forward -------------------------------------------------------
+
+    def _patchify(self, x):
+        """(B, H, W, C) -> (B, N, p·p·C) flattened patch rows."""
+        b = x.shape[0]
+        p = self.patch_size
+        g = self.image_size // p
+        x = x.reshape(b, g, p, g, p, self.in_channels)
+        x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, gh, gw, p, p, C)
+        return x.reshape(b, g * g, p * p * self.in_channels)
+
+    def _block(self, blk, x):
+        cd = self.compute_dtype
+        b, n = x.shape[0], x.shape[1]
+        h, hd = self.num_heads, self.head_dim
+        y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+        wqkv = blk["wqkv"].astype(cd).reshape(self.d_model, -1)
+        qkv = jnp.dot(y, wqkv, preferred_element_type=jnp.float32)
+        qkv = qkv.astype(cd).reshape(b, n, 3, h, hd)
+        o = attend(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                   causal=False, flash=self.use_flash)
+        wo = blk["wo"].astype(cd).reshape(h * hd, self.d_model)
+        o = jnp.dot(o.reshape(b, n, h * hd), wo,
+                    preferred_element_type=jnp.float32).astype(cd)
+        x = x + o
+        y = layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+        y = jnp.dot(y, blk["w1"].astype(cd),
+                    preferred_element_type=jnp.float32)
+        y = jax.nn.gelu(y.astype(jnp.float32)).astype(cd)
+        y = jnp.dot(y, blk["w2"].astype(cd),
+                    preferred_element_type=jnp.float32).astype(cd)
+        return x + y
+
+    def apply(self, params, x):
+        """(B, H, W, C) images -> (B, num_classes) float32 logits."""
+        cd = self.compute_dtype
+        if x.shape[1] != self.image_size or x.shape[2] != self.image_size:
+            raise ValueError(f"expected {self.image_size}x"
+                             f"{self.image_size} inputs, got "
+                             f"{x.shape[1]}x{x.shape[2]}")
+        tok = self._patchify(x.astype(cd))
+        tok = jnp.dot(tok, params["patch"]["kernel"].astype(cd),
+                      preferred_element_type=jnp.float32)
+        tok = (tok + params["patch"]["bias"]).astype(cd)
+        tok = tok + params["pos"].astype(cd)
+        blk_fn = self._block
+        if self.remat_blocks:
+            blk_fn = jax.checkpoint(blk_fn)
+        for blk in params["blocks"]:
+            tok = blk_fn(blk, tok)
+        tok = layer_norm(tok, params["ln_f"]["scale"],
+                         params["ln_f"]["bias"])
+        pooled = jnp.mean(tok.astype(jnp.float32), axis=1)  # GAP
+        logits = jnp.dot(pooled, params["head"]["kernel"].astype(
+            jnp.float32)) + params["head"]["bias"]
+        return logits.astype(jnp.float32)
+
+    def num_params(self, params=None, key=None) -> int:
+        if params is None:
+            params = self.init(key if key is not None else jax.random.key(0))
+        return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+_PRESETS = {
+    # CIFAR-scale: 4x4 patches over 32x32 -> 64 tokens.
+    "ViT-tiny": dict(image_size=32, patch_size=4, num_layers=6,
+                     num_heads=4, d_model=256, d_ff=1024, num_classes=10),
+    # ImageNet-scale ViT-S/16: 196 tokens at 224x224.
+    "ViT-S16": dict(image_size=224, patch_size=16, num_layers=12,
+                    num_heads=6, d_model=384, d_ff=1536,
+                    num_classes=1000),
+}
+
+
+def make_vit(name: str = "ViT-tiny", *, use_pallas_bn: bool = False,
+             **kwargs) -> ViTModel:
+    """Factory matching the zoo's ``get_model`` calling convention.
+    ``use_pallas_bn`` is accepted (the Trainer passes it uniformly to
+    vision models) and ignored — ViT has no BatchNorm."""
+    del use_pallas_bn
+    if name not in _PRESETS:
+        raise ValueError(f"unknown ViT preset {name!r}; available: "
+                         f"{sorted(_PRESETS)}")
+    cfg = dict(_PRESETS[name])
+    cfg.update(kwargs)
+    return ViTModel(name=name, **cfg)

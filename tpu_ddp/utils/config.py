@@ -126,4 +126,5 @@ PRESETS = {
     "vgg11_cifar10": {},
     "resnet50_imagenet": dict(model="ResNet50", num_classes=1000,
                               image_size=224, dataset="imagenet"),
+    "vit_cifar10": dict(model="ViT-tiny"),
 }
