@@ -1,0 +1,68 @@
+// Native text packing for the LM data pipeline.
+//
+// The reference's data path is native (torchvision C transforms +
+// DataLoader workers — SURVEY.md §2 row N4); this is the text-side
+// counterpart for the LM family: byte-level tokenization + document
+// packing done as one linear pass in C++ instead of a Python loop over
+// documents. Exposed to Python via ctypes (tpu_ddp/data/text.py), with
+// a numpy fallback that must produce IDENTICAL output (tested).
+//
+// Token scheme (fixed, mirrored in Python): PAD=0, BOS=1, EOS=2,
+// byte b -> b + 3. Stream layout per document: [BOS?] bytes... EOS.
+// The concatenated stream is chunked into rows of `row_len` tokens;
+// the tail remainder is dropped (standard GPT-2-style grouping).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+constexpr int32_t kBos = 1;
+constexpr int32_t kEos = 2;
+constexpr int32_t kByteOffset = 3;
+}  // namespace
+
+extern "C" {
+
+// Total token-stream length for the given documents (before chunking).
+int64_t tpu_ddp_text_stream_len(const int64_t* doc_offsets, int64_t n_docs,
+                                int add_bos) {
+  int64_t total = 0;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    total += (doc_offsets[d + 1] - doc_offsets[d]) + 1 + (add_bos ? 1 : 0);
+  }
+  return total;
+}
+
+// Pack documents into rows of `row_len` tokens. `bytes` is the
+// concatenation of all documents; `doc_offsets` (n_docs + 1 entries)
+// delimits them. Writes floor(stream_len / row_len) rows into
+// `out_rows` (shape (n_rows, row_len), C-contiguous) and returns the
+// row count. A negative return is an error (insufficient max_rows).
+int64_t tpu_ddp_text_pack(const uint8_t* bytes, const int64_t* doc_offsets,
+                          int64_t n_docs, int64_t row_len, int add_bos,
+                          int32_t* out_rows, int64_t max_rows) {
+  if (row_len <= 0) return -1;
+  const int64_t stream_len =
+      tpu_ddp_text_stream_len(doc_offsets, n_docs, add_bos);
+  const int64_t n_rows = stream_len / row_len;
+  if (n_rows > max_rows) return -2;
+  const int64_t n_keep = n_rows * row_len;
+  int64_t w = 0;  // write cursor in tokens
+  for (int64_t d = 0; d < n_docs && w < n_keep; ++d) {
+    if (add_bos) {
+      out_rows[w++] = kBos;
+      if (w >= n_keep) break;
+    }
+    for (int64_t i = doc_offsets[d]; i < doc_offsets[d + 1]; ++i) {
+      out_rows[w++] = static_cast<int32_t>(bytes[i]) + kByteOffset;
+      if (w >= n_keep) break;
+    }
+    if (w >= n_keep) break;
+    out_rows[w++] = kEos;
+  }
+  return n_rows;
+}
+
+int tpu_ddp_text_version() { return 1; }
+
+}  // extern "C"

@@ -16,6 +16,11 @@ from tpu_ddp.data.cifar10 import (  # noqa: F401
     normalize,
 )
 from tpu_ddp.data.sampler import DistributedShardSampler  # noqa: F401
+from tpu_ddp.data.text import (  # noqa: F401
+    ByteTokenizer,
+    epoch_batches,
+    pack_documents,
+)
 from tpu_ddp.data.loader import DataLoader, create_data_loaders  # noqa: F401
 
 

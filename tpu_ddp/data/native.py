@@ -33,11 +33,8 @@ from tpu_ddp.utils.config import SEED
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libtpu_ddp_data.so")
 
-_lib = None
-_lib_lock = threading.Lock()
-_build_error: str | None = None
+_build_error: str | None = None  # mirror of _data_lib.build_error
 
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
@@ -45,23 +42,52 @@ _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 _f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 
 
-def _build() -> bool:
-    global _build_error
-    src = os.path.join(_NATIVE_DIR, "tpu_ddp_data.cpp")
-    if os.path.exists(_LIB_PATH):
-        if not os.path.exists(src):
-            return True  # prebuilt .so shipped without source: use it
-        if os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src):
+class NativeLib:
+    """Shared lazy build-and-load machinery for the ctypes-bound C++
+    libraries under ``native/`` (the image pipeline here, text packing
+    in tpu_ddp/data/text.py): mtime-checked `make` on first use,
+    negative-cached build errors, thread-safe single load."""
+
+    def __init__(self, lib_name: str, src_name: str, bind):
+        self._lib_path = os.path.join(_NATIVE_DIR, lib_name)
+        self._src_path = os.path.join(_NATIVE_DIR, src_name)
+        self._bind = bind
+        self._lib = None
+        self._lock = threading.Lock()
+        self.build_error: str | None = None
+
+    def _build(self) -> bool:
+        if os.path.exists(self._lib_path):
+            if not os.path.exists(self._src_path):
+                return True  # prebuilt .so shipped without source: use it
+            if os.path.getmtime(self._lib_path) >= \
+                    os.path.getmtime(self._src_path):
+                return True
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR],
+                           check=True, capture_output=True, text=True,
+                           timeout=300)
             return True
-    try:
-        subprocess.run(["make", "-C", _NATIVE_DIR],
-                       check=True, capture_output=True, text=True,
-                       timeout=300)
-        return True
-    except (subprocess.SubprocessError, OSError) as e:
-        out = getattr(e, "stderr", "") or str(e)
-        _build_error = f"native build failed: {out[-500:]}"
-        return False
+        except (subprocess.SubprocessError, OSError) as e:
+            out = getattr(e, "stderr", "") or str(e)
+            self.build_error = f"native build failed: {out[-500:]}"
+            return False
+
+    def get(self):
+        """The loaded library, building if needed; None on failure."""
+        with self._lock:
+            if self._lib is not None:
+                return self._lib
+            if self.build_error is not None:
+                return None  # negative-cached: don't re-spawn make
+            if not self._build():
+                return None
+            try:
+                self._lib = self._bind(ctypes.CDLL(self._lib_path))
+            except OSError as e:  # pragma: no cover - exotic
+                self.build_error = str(e)
+                return None
+            return self._lib
 
 
 def _bind(lib):
@@ -84,22 +110,15 @@ def _bind(lib):
     return lib
 
 
+_data_lib = NativeLib("libtpu_ddp_data.so", "tpu_ddp_data.cpp", _bind)
+
+
 def get_lib():
     """The loaded shared library, building it if needed; None on failure."""
-    global _lib, _build_error
-    with _lib_lock:
-        if _lib is not None:
-            return _lib
-        if _build_error is not None:
-            return None  # negative-cached: don't re-spawn make every call
-        if not _build():
-            return None
-        try:
-            _lib = _bind(ctypes.CDLL(_LIB_PATH))
-        except OSError as e:  # pragma: no cover - load failure is exotic
-            _build_error = str(e)
-            return None
-        return _lib
+    global _build_error
+    lib = _data_lib.get()
+    _build_error = _data_lib.build_error
+    return lib
 
 
 def available() -> bool:
@@ -107,7 +126,7 @@ def available() -> bool:
 
 
 def build_error() -> str | None:
-    return _build_error
+    return _data_lib.build_error
 
 
 def transform_batch(images_u8, labels, indices=None, *, augment=False,

@@ -1,0 +1,167 @@
+"""Text pipeline for the LM family: byte tokenizer, document packing,
+sharded epoch batches.
+
+No reference counterpart (the reference's only dataset is CIFAR-10,
+part1/main.py:19-50) — this module gives the transformer family the
+same complete data story the vision side has: tokenize -> pack ->
+shard-per-rank -> per-epoch reshuffle, with the packing hot loop in
+C++ (native/tpu_ddp_text.cpp, ctypes-bound like the image pipeline in
+tpu_ddp/data/native.py) and a numpy fallback producing IDENTICAL rows
+(tested in tests/test_text.py).
+
+Design:
+- **ByteTokenizer** — vocabulary = 256 bytes + PAD/BOS/EOS (259 ids).
+  Zero-egress and language-agnostic; a learned subword vocabulary can
+  replace it behind the same encode/decode surface.
+- **pack_documents** — one token stream ``[BOS] doc EOS [BOS] doc EOS
+  ...`` chunked into (N, seq_len + 1) rows (GPT-2-style grouping; the
+  +1 lets ``make_lm_batch`` split shifted inputs/targets). Tail
+  remainder is dropped.
+- **epoch_batches** — rank-sharded, optionally epoch-shuffled batch
+  iterator over packed rows, built on the same
+  :class:`DistributedShardSampler` contract as the vision loaders
+  (stride sharding, wrap padding, ``set_epoch``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from tpu_ddp.data.native import NativeLib, _i32p, _i64p, _u8p
+
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+_BYTE_OFFSET = 3
+VOCAB_SIZE = 256 + _BYTE_OFFSET
+
+
+def _bind(lib):
+    lib.tpu_ddp_text_stream_len.argtypes = [_i64p, ctypes.c_int64,
+                                            ctypes.c_int]
+    lib.tpu_ddp_text_stream_len.restype = ctypes.c_int64
+    lib.tpu_ddp_text_pack.argtypes = [
+        _u8p, _i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+        _i32p, ctypes.c_int64]
+    lib.tpu_ddp_text_pack.restype = ctypes.c_int64
+    return lib
+
+
+_text_lib = NativeLib("libtpu_ddp_text.so", "tpu_ddp_text.cpp", _bind)
+_get_lib = _text_lib.get
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: PAD=0, BOS=1, EOS=2, byte b -> b + 3."""
+
+    vocab_size = VOCAB_SIZE
+    pad_id, bos_id, eos_id = PAD_ID, BOS_ID, EOS_ID
+
+    def encode(self, text) -> np.ndarray:
+        data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
+        return np.frombuffer(data, np.uint8).astype(np.int32) + _BYTE_OFFSET
+
+    def decode(self, ids) -> str:
+        ids = np.asarray(ids)
+        ids = ids[ids >= _BYTE_OFFSET] - _BYTE_OFFSET
+        return ids.astype(np.uint8).tobytes().decode("utf-8",
+                                                     errors="replace")
+
+
+def _doc_buffers(docs):
+    blobs = [d.encode("utf-8") if isinstance(d, str) else bytes(d)
+             for d in docs]
+    offsets = np.zeros(len(blobs) + 1, np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    return np.frombuffer(b"".join(blobs), np.uint8), offsets
+
+
+def pack_documents(docs, seq_len: int, add_bos: bool = True,
+                   use_native: bool | None = None) -> np.ndarray:
+    """Pack ``docs`` (str/bytes list) into (N, seq_len + 1) int32 rows.
+
+    ``use_native=None`` picks the C++ packer when the library builds,
+    numpy otherwise; both produce identical rows. Raises on empty input
+    or when the stream is shorter than one row.
+    """
+    if not docs:
+        raise ValueError("pack_documents: no documents")
+    row_len = seq_len + 1
+    data, offsets = _doc_buffers(docs)
+    if use_native is None:
+        use_native = native_available()
+    if use_native:
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError(f"native text library unavailable: "
+                               f"{_text_lib.build_error}")
+        stream = lib.tpu_ddp_text_stream_len(offsets, len(docs),
+                                             int(add_bos))
+        n_rows = stream // row_len
+        if n_rows == 0:
+            raise ValueError(f"documents too short: {stream} tokens < "
+                             f"one row of {row_len}")
+        out = np.empty((n_rows, row_len), np.int32)
+        got = lib.tpu_ddp_text_pack(
+            np.ascontiguousarray(data), offsets, len(docs), row_len,
+            int(add_bos), out, n_rows)
+        if got < 0:
+            raise RuntimeError(f"tpu_ddp_text_pack error {got}")
+        return out[:got]
+    # numpy fallback — must match the C++ layout exactly.
+    pieces = []
+    for d in range(len(docs)):
+        if add_bos:
+            pieces.append(np.array([BOS_ID], np.int32))
+        pieces.append(data[offsets[d]:offsets[d + 1]].astype(np.int32)
+                      + _BYTE_OFFSET)
+        pieces.append(np.array([EOS_ID], np.int32))
+    stream = np.concatenate(pieces)
+    n_rows = len(stream) // row_len
+    if n_rows == 0:
+        raise ValueError(f"documents too short: {len(stream)} tokens < "
+                         f"one row of {row_len}")
+    return stream[:n_rows * row_len].reshape(n_rows, row_len)
+
+
+def epoch_batches(rows: np.ndarray, batch_size: int, *, rank: int = 0,
+                  world_size: int = 1, shuffle: bool = True,
+                  seed: int = 0, epoch: int = 0, drop_last: bool = True):
+    """Yield this rank's (inputs, targets) LM batches for one epoch.
+
+    Sharding follows the vision sampler's contract
+    (tpu_ddp/data/sampler.py): wrap-pad to a common per-rank length,
+    stride-shard by rank. ``shuffle`` permutes ROWS per epoch with a
+    seed shared by all ranks (rows are independent contexts, so row
+    order — unlike the reference's intentionally unshuffled CIFAR
+    epochs — is free to mix). ``drop_last`` drops a ragged final batch
+    (LM steps want static shapes under jit).
+    """
+    from tpu_ddp.train.lm import make_lm_batch
+    n = len(rows)
+    if shuffle:
+        order = np.random.default_rng(seed + epoch).permutation(n)
+    else:
+        order = np.arange(n)
+    per_rank = -(-n // world_size)
+    pad = per_rank * world_size - n
+    if pad:
+        # Tile, don't slice: pad may exceed n (e.g. 1 row, 4 ranks) and
+        # every rank must get the same shard length or a collective
+        # train loop deadlocks (same rule as DistributedShardSampler,
+        # tpu_ddp/data/sampler.py).
+        padded = np.concatenate([order, np.tile(order, -(-pad // n))[:pad]])
+    else:
+        padded = order
+    mine = padded[rank::world_size]
+    for i in range(0, len(mine), batch_size):
+        take = mine[i:i + batch_size]
+        if drop_last and len(take) < batch_size:
+            break
+        yield make_lm_batch(rows[take])
