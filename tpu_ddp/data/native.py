@@ -34,7 +34,6 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 
-_build_error: str | None = None  # mirror of _data_lib.build_error
 
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
@@ -64,7 +63,11 @@ class NativeLib:
                     os.path.getmtime(self._src_path):
                 return True
         try:
-            subprocess.run(["make", "-C", _NATIVE_DIR],
+            # Build ONLY this library's target: a compile failure in a
+            # sibling library must not poison this one, and per-target
+            # builds can't race each other onto the same .so.
+            subprocess.run(["make", "-C", _NATIVE_DIR,
+                            os.path.basename(self._lib_path)],
                            check=True, capture_output=True, text=True,
                            timeout=300)
             return True
@@ -115,10 +118,7 @@ _data_lib = NativeLib("libtpu_ddp_data.so", "tpu_ddp_data.cpp", _bind)
 
 def get_lib():
     """The loaded shared library, building it if needed; None on failure."""
-    global _build_error
-    lib = _data_lib.get()
-    _build_error = _data_lib.build_error
-    return lib
+    return _data_lib.get()
 
 
 def available() -> bool:
@@ -141,7 +141,7 @@ def transform_batch(images_u8, labels, indices=None, *, augment=False,
     """
     lib = get_lib()
     if lib is None:
-        raise RuntimeError(f"native library unavailable: {_build_error}")
+        raise RuntimeError(f"native library unavailable: {_data_lib.build_error}")
     images_u8 = np.ascontiguousarray(images_u8, dtype=np.uint8)
     labels = np.ascontiguousarray(labels, dtype=np.int32)
     n, h, w, c = images_u8.shape
@@ -186,7 +186,7 @@ class NativeDataLoader:
         self.mean = np.ascontiguousarray(mean, np.float32)
         self.std = np.ascontiguousarray(std, np.float32)
         if get_lib() is None:
-            raise RuntimeError(f"native library unavailable: {_build_error}")
+            raise RuntimeError(f"native library unavailable: {_data_lib.build_error}")
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch

@@ -143,23 +143,16 @@ def epoch_batches(rows: np.ndarray, batch_size: int, *, rank: int = 0,
     epochs — is free to mix). ``drop_last`` drops a ragged final batch
     (LM steps want static shapes under jit).
     """
+    from tpu_ddp.data.sampler import DistributedShardSampler
     from tpu_ddp.train.lm import make_lm_batch
-    n = len(rows)
-    if shuffle:
-        order = np.random.default_rng(seed + epoch).permutation(n)
-    else:
-        order = np.arange(n)
-    per_rank = -(-n // world_size)
-    pad = per_rank * world_size - n
-    if pad:
-        # Tile, don't slice: pad may exceed n (e.g. 1 row, 4 ranks) and
-        # every rank must get the same shard length or a collective
-        # train loop deadlocks (same rule as DistributedShardSampler,
-        # tpu_ddp/data/sampler.py).
-        padded = np.concatenate([order, np.tile(order, -(-pad // n))[:pad]])
-    else:
-        padded = order
-    mine = padded[rank::world_size]
+    # One sharding implementation in the codebase: the sampler owns the
+    # deadlock-sensitive wrap-pad + stride-shard rule (and its torch
+    # parity tests); this iterator just feeds its order to the LM batcher.
+    sampler = DistributedShardSampler(len(rows), world_size, rank,
+                                      shuffle=shuffle, seed=seed,
+                                      drop_last=False)
+    sampler.set_epoch(epoch)
+    mine = sampler.indices()
     for i in range(0, len(mine), batch_size):
         take = mine[i:i + batch_size]
         if drop_last and len(take) < batch_size:

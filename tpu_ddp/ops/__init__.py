@@ -6,6 +6,7 @@ implementations that fuse into the jitted train step.
 """
 
 from tpu_ddp.ops.loss import cross_entropy_loss, softmax_cross_entropy  # noqa: F401
+from tpu_ddp.ops.ema import EMA  # noqa: F401
 from tpu_ddp.ops.optim import (  # noqa: F401
     SGD,
     SGDState,

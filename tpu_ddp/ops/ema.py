@@ -1,0 +1,51 @@
+"""Exponential moving average of parameters (Polyak averaging).
+
+No reference counterpart (the reference evaluates the raw SGD iterate,
+part1/main.py:96-111); EMA is the standard eval-time smoothing for
+vision training and half of many semi-supervised recipes. Pure pytree
+transform in the zoo's optimizer style (tpu_ddp/ops/optim.py): state
+lives wherever the params live, the update is elementwise and fuses
+into the jitted train step.
+
+The effective decay warms up as ``min(decay, (1 + t) / (10 + t))`` (the
+classic schedule), so early EMA params track the fast-moving young
+model instead of its random init.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class EMA:
+    decay: float = 0.999
+    warmup: bool = True
+
+    def init(self, params) -> dict:
+        return {"ema": jax.tree.map(jnp.asarray, params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def update(self, state: dict, params) -> dict:
+        count = state["count"] + 1
+        if self.warmup:
+            c = count.astype(jnp.float32)
+            d = jnp.minimum(self.decay, (1.0 + c) / (10.0 + c))
+        else:
+            d = self.decay
+        # Blend in f32, store back in the state's own dtype — the warmup
+        # `d` is a strong-typed f32 scalar and would otherwise promote
+        # bf16 state to f32 (breaking scan carries and doubling memory).
+        ema = jax.tree.map(
+            lambda e, p: (e.astype(jnp.float32) * d
+                          + p.astype(jnp.float32) * (1.0 - d)
+                          ).astype(e.dtype),
+            state["ema"], params)
+        return {"ema": ema, "count": count}
+
+    def params(self, state: dict):
+        """The averaged parameters (plug into ``model.apply`` for eval)."""
+        return state["ema"]
