@@ -1,0 +1,155 @@
+"""Dropout for the LM family (TransformerLM.dropout_rate).
+
+Decisive properties: rng-gated (no rng -> deterministic eval, exactly
+the dropout-free graph), per-step/per-shard key discipline in the
+trainer, preserved loss semantics (model still trains), and loud
+refusal where keys are not threaded (pipeline engine).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.models.transformer import make_transformer
+from tpu_ddp.parallel.mesh import make_mesh
+from tpu_ddp.train.lm import LMTrainer, PipelineLMTrainer, make_lm_batch
+
+
+def _model(rate=0.5, **kw):
+    kw.setdefault("max_seq_len", 16)
+    return make_transformer("TransformerLM-tiny", dropout_rate=rate,
+                            compute_dtype=jnp.float32, **kw)
+
+
+def _tokens(b=2, L=16):
+    return jax.random.randint(jax.random.key(0), (b, L), 0, 1024)
+
+
+class TestModelDropout:
+    def test_no_rng_is_exactly_dropout_free(self):
+        """apply without rng == the rate-0 model's apply, bit for bit —
+        eval and generation never see dropout."""
+        drop = _model(0.5)
+        base = _model(0.0)
+        params = drop.init(jax.random.key(1))
+        t = _tokens()
+        np.testing.assert_array_equal(
+            np.asarray(drop.apply(params, t)),
+            np.asarray(base.apply(params, t)))
+
+    def test_rng_activates_and_is_deterministic(self):
+        model = _model(0.5)
+        params = model.init(jax.random.key(1))
+        t = _tokens()
+        clean = np.asarray(model.apply(params, t))
+        r = jax.random.key(7)
+        a = np.asarray(model.apply(params, t, rng=r))
+        b = np.asarray(model.apply(params, t, rng=r))
+        c = np.asarray(model.apply(params, t, rng=jax.random.key(8)))
+        np.testing.assert_array_equal(a, b)       # same key -> same mask
+        assert np.abs(a - clean).max() > 1e-3     # dropout did something
+        assert np.abs(a - c).max() > 1e-3         # new key -> new mask
+
+    def test_rate_zero_ignores_rng(self):
+        model = _model(0.0)
+        params = model.init(jax.random.key(1))
+        t = _tokens()
+        np.testing.assert_array_equal(
+            np.asarray(model.apply(params, t, rng=jax.random.key(3))),
+            np.asarray(model.apply(params, t)))
+
+    def test_remat_matches_dense_under_dropout(self):
+        """jax.checkpoint must replay the SAME masks in the backward."""
+        dense = _model(0.3)
+        remat = _model(0.3, remat_blocks=True)
+        params = dense.init(jax.random.key(2))
+        t = _tokens()
+        r = jax.random.key(9)
+
+        def loss(model, p):
+            return jnp.mean(model.apply(p, t, rng=r) ** 2)
+
+        g_d = jax.grad(lambda p: loss(dense, p))(params)
+        g_r = jax.grad(lambda p: loss(remat, p))(params)
+        for a, b in zip(jax.tree.leaves(g_d), jax.tree.leaves(g_r)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+
+class TestTrainerDropout:
+    def test_steps_use_fresh_masks_and_resume_replays_them(self, devices,
+                                                           tmp_path):
+        """Two runs from the same checkpoint take identical steps (the
+        key derives from the state's step), and successive steps use
+        different masks (loss path changes even on a fixed batch)."""
+        model = _model(0.4, max_seq_len=32)
+        mesh = make_mesh(devices[:2], dp=2)
+        tr = LMTrainer(model, mesh)
+        state = tr.init_state(seed=0)
+        tokens = np.random.default_rng(0).integers(0, 1024, size=(4, 33))
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        state, _ = tr.train_step(state, x, y)
+        tr.save_checkpoint(str(tmp_path), state)
+        cont, closs = tr.train_step(state, x, y)
+        restored = tr.restore_checkpoint(str(tmp_path))
+        resumed, rloss = tr.train_step(restored, x, y)
+        for a, b in zip(jax.tree.leaves(jax.device_get(cont.params)),
+                        jax.tree.leaves(jax.device_get(resumed.params))):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6)
+        # Mask freshness: the key derives from state.step. Restore the
+        # same checkpoint again (train_step donated the first restore's
+        # buffers) but advance step before stepping — identical params,
+        # batch, and loss math, so any loss change can only come from a
+        # different dropout mask.
+        again = tr.restore_checkpoint(str(tmp_path))
+        bumped = type(again)(params=again.params,
+                             opt_state=again.opt_state,
+                             step=again.step + 1)
+        _, bloss = tr.train_step(bumped, x, y)
+        assert abs(float(np.mean(np.asarray(rloss)))
+                   - float(np.mean(np.asarray(bloss)))) > 1e-6
+
+    def test_trains_with_dropout(self, devices):
+        model = _model(0.1, max_seq_len=32)
+        tr = LMTrainer(model, make_mesh(devices[:2], dp=2))
+        state = tr.init_state(seed=0)
+        tokens = np.random.default_rng(1).integers(0, 1024, size=(4, 33))
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        losses = []
+        for _ in range(6):
+            state, loss = tr.train_step(state, x, y)
+            losses.append(float(np.mean(np.asarray(loss))))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_grad_accum_composes(self, devices):
+        model = _model(0.2, max_seq_len=32)
+        tr = LMTrainer(model, make_mesh(devices[:2], dp=2), grad_accum=2)
+        state = tr.init_state(seed=0)
+        tokens = np.random.default_rng(2).integers(0, 1024, size=(4, 33))
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        state, loss = tr.train_step(state, x, y)
+        assert np.isfinite(np.asarray(loss)).all()
+
+    def test_tp_shards_share_masks(self, devices):
+        """dp=1 x tp=2 with dropout must still produce a consistent
+        (finite, replicated-residual) step: mp shards fold NO axis
+        indices, so their masks agree and the psum'd activations stay
+        coherent. Divergence would show up as loss disagreement between
+        the two loss copies."""
+        model = _model(0.3, max_seq_len=32)
+        tr = LMTrainer(model, make_mesh(devices[:2], dp=1, mp=2))
+        state = tr.init_state(seed=0)
+        tokens = np.random.default_rng(3).integers(0, 1024, size=(2, 33))
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        state, loss = tr.train_step(state, x, y)
+        vals = np.ravel(np.asarray(loss))
+        assert np.isfinite(vals).all()
+
+    def test_pipeline_refuses_dropout(self, devices):
+        model = _model(0.1, num_layers=2)
+        mesh = make_mesh(devices[:2], dp=1, pp=2)
+        with pytest.raises(ValueError, match="dropout"):
+            PipelineLMTrainer(model, mesh, num_micro=2)

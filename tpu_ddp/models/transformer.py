@@ -112,6 +112,11 @@ class TransformerLM:
     # trades ~num_layers x activation memory for one extra forward —
     # the standard long-context memory lever on HBM-bound chips.
     remat_blocks: bool = False
+    # Dropout on the embedding and each block's two residual branches.
+    # Active only when the caller passes an ``rng`` to apply/trunk (the
+    # trainer does, per step); eval/generate never pass one, so they
+    # are deterministic with no mode flag.
+    dropout_rate: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -127,6 +132,9 @@ class TransformerLM:
         return self.kv_heads != self.num_heads
 
     def __post_init__(self):
+        if not 0.0 <= self.dropout_rate < 1.0:
+            raise ValueError(f"dropout_rate must be in [0, 1), got "
+                             f"{self.dropout_rate}")
         if self.kv_heads < 1:
             raise ValueError(f"num_kv_heads must be >= 1, got "
                              f"{self.kv_heads}")
@@ -262,20 +270,31 @@ class TransformerLM:
             return tp_output(x, self.tp_axis)
         return x
 
-    def apply(self, params, tokens):
+    def _dropout(self, x, rng):
+        """Inverted dropout; identity when inactive (rate 0 or no rng).
+        The branch is static, so inactive configurations compile to the
+        bare graph."""
+        if rng is None or self.dropout_rate <= 0.0:
+            return x
+        keep = 1.0 - self.dropout_rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    def apply(self, params, tokens, rng=None):
         """tokens: (B, L_local) int32 -> logits (B, L_local, V) float32.
 
         Under tensor parallelism ``params`` holds this shard's slices
         (heads and d_ff split ``tp_size``-ways, :meth:`param_specs`); the
         residual stream stays replicated, with one ``psum`` after each of
-        the two row-parallel projections.
+        the two row-parallel projections. ``rng`` activates dropout
+        (training); omit it for deterministic eval.
         """
-        return self.apply_with_aux(params, tokens)[0]
+        return self.apply_with_aux(params, tokens, rng=rng)[0]
 
-    def apply_with_aux(self, params, tokens):
+    def apply_with_aux(self, params, tokens, rng=None):
         """Like :meth:`apply`, additionally returning the mean Switch
         load-balance auxiliary loss over MoE blocks (0.0 when dense)."""
-        x, aux = self.trunk_with_aux(params, tokens)
+        x, aux = self.trunk_with_aux(params, tokens, rng=rng)
         return self.project(params, x), aux
 
     def project(self, params, x):
@@ -285,14 +304,17 @@ class TransformerLM:
                          preferred_element_type=jnp.float32)
         return logits.astype(jnp.float32)
 
-    def trunk_with_aux(self, params, tokens):
+    def trunk_with_aux(self, params, tokens, rng=None):
         """Everything but the vocabulary projection: embed -> blocks ->
         final LayerNorm, returning ((B, L, dm) activations, aux). The
         split exists so the LM loss can fuse the head matmul into a
         chunked-vocab cross-entropy without materializing (T, V) logits
         (tpu_ddp/ops/loss.py chunked_vocab_cross_entropy). This is the
         single full-forward implementation — :meth:`apply` /
-        :meth:`apply_with_aux` wrap it, so validation lives here once."""
+        :meth:`apply_with_aux` wrap it, so validation lives here once.
+
+        ``rng``: dropout key (pre-decorrelated across data shards by the
+        trainer); None disables dropout."""
         cd = self.compute_dtype
         lc = tokens.shape[1]
         if lc * self.sp_size > self.max_seq_len:
@@ -301,12 +323,15 @@ class TransformerLM:
                 f"sp {self.sp_size}) exceeds max_seq_len={self.max_seq_len}")
         pos = self._positions(lc)
         x = params["embed"][tokens].astype(cd)
+        if rng is not None:
+            x = self._dropout(x, jax.random.fold_in(rng, self.num_layers))
         aux = jnp.float32(0.0)
         blk_fn = self.block_apply_aux
         if self.remat_blocks:
             blk_fn = jax.checkpoint(blk_fn)
-        for blk in params["blocks"]:
-            x, a = blk_fn(blk, x, pos)
+        for i, blk in enumerate(params["blocks"]):
+            r = jax.random.fold_in(rng, i) if rng is not None else None
+            x, a = blk_fn(blk, x, pos, r)
             aux = aux + a
         x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
         return x, aux / max(self.num_layers, 1)
@@ -353,10 +378,17 @@ class TransformerLM:
             k, v = kvp[:, :, 0], kvp[:, :, 1]
         return rope(q, pos), rope(k, pos), v
 
-    def block_apply_aux(self, blk, x, pos):
+    def block_apply_aux(self, blk, x, pos, rng=None):
         cd = self.compute_dtype
         b, lc = x.shape[0], x.shape[1]
         h_loc, hd = self.num_heads // self._tp, self.head_dim
+        r1 = r2 = None
+        if rng is not None:
+            # Branch keys derive from this block's key; the trainer
+            # already decorrelated ``rng`` across data shards (and left
+            # it IDENTICAL across mp shards — the residual stream is
+            # replicated over tp, so its mask must be too).
+            r1, r2 = jax.random.split(rng)
         y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
         # Under GQA k/v stay at KV-head width end to end: attend()'s
         # ring/blockwise/full paths contract grouped, so collectives and
@@ -371,7 +403,7 @@ class TransformerLM:
         o = self._tp_out(jnp.dot(
             o.reshape(b, lc, h_loc * hd), wo,
             preferred_element_type=jnp.float32)).astype(cd)
-        x = x + o
+        x = x + self._dropout(o, r1)
         y = layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
         if self.moe_experts:
             from tpu_ddp.parallel.moe import moe_mlp
@@ -382,7 +414,7 @@ class TransformerLM:
                 top_k=self.moe_top_k,
                 ep_axis=self.ep_axis or "ep", ep_size=self._ep,
                 tp_in=self._tp_in, tp_out=self._tp_out)
-            return x + y, aux
+            return x + self._dropout(y, r2), aux
         # Column-parallel up-projection (local d_ff slice) ...
         y = jnp.dot(self._tp_in(y), blk["w1"].astype(cd),
                     preferred_element_type=jnp.float32)
@@ -391,7 +423,7 @@ class TransformerLM:
         y = self._tp_out(jnp.dot(
             y, blk["w2"].astype(cd),
             preferred_element_type=jnp.float32)).astype(cd)
-        return x + y, jnp.float32(0.0)
+        return x + self._dropout(y, r2), jnp.float32(0.0)
 
     def head_apply(self, params, x):
         """Final LayerNorm + LM head: (B, L, dm) -> (B, L, V) float32."""

@@ -84,12 +84,21 @@ class _MeshTrainer:
         return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
                             is_leaf=_is_spec)
 
+    def _extra_in_specs(self) -> tuple:
+        """Specs for trainer-specific trailing _base_step args (e.g. the
+        LMTrainer's per-step dropout key, replicated)."""
+        return ()
+
+    def _extra_args(self, state) -> tuple:
+        """Values for those trailing args, built per call."""
+        return ()
+
     def _compile_step(self, batch_spec, loss_spec):
         mapped = jax.shard_map(
             self._base_step,
             mesh=self.mesh,
             in_specs=(self._param_specs, self._opt_specs, batch_spec,
-                      batch_spec),
+                      batch_spec, *self._extra_in_specs()),
             out_specs=(self._param_specs, self._opt_specs, loss_spec),
             check_vma=False,
         )
@@ -107,7 +116,8 @@ class _MeshTrainer:
 
     def train_step(self, state: LMTrainState, inputs, targets):
         params, opt_state, loss = self._train_step(
-            state.params, state.opt_state, inputs, targets)
+            state.params, state.opt_state, inputs, targets,
+            *self._extra_args(state))
         return LMTrainState(params, opt_state, state.step + 1), loss
 
     def _put_sharded(self, array, sharding):
@@ -201,7 +211,7 @@ class LMTrainer(_MeshTrainer):
                  moe_aux_coef: float = 0.01,
                  param_sharding: str = "replicated",
                  vocab_chunk: int = 0, sp_mode: str = "ring",
-                 grad_accum: int = 1):
+                 grad_accum: int = 1, dropout_seed: int = 0):
         self.mesh = mesh
         self.dp = mesh.shape[DATA_AXIS]
         self.sp = mesh.shape[SEQ_AXIS]
@@ -218,6 +228,9 @@ class LMTrainer(_MeshTrainer):
         # > 1: each step scans this many microbatches, accumulating f32
         # gradients before the (single) sync + optimizer update.
         self.grad_accum = grad_accum
+        # Per-step dropout keys derive from this seed + the state's step
+        # (resume-exact); the key is inert when model.dropout_rate == 0.
+        self._dropout_key = jax.random.key(dropout_seed)
         # > 0: compute the loss via chunked-vocab CE, never materializing
         # the (T, V) logits (tpu_ddp/ops/loss.py) — the train step's
         # largest buffer at long context. Value = vocab slice width.
@@ -293,7 +306,26 @@ class LMTrainer(_MeshTrainer):
             return g / excluded if excluded > 1 else g
         return jax.tree.map(leaf, grads, self._param_specs)
 
-    def _accumulate(self, grad_fn, params, inputs, targets):
+    def _extra_in_specs(self) -> tuple:
+        return (P(),)  # dropout key: replicated on every shard
+
+    def _extra_args(self, state) -> tuple:
+        # Folding by step happens HOST-side (step is a Python int), so
+        # each step deterministically gets a fresh key and a restored
+        # run continues the same key sequence.
+        return (jax.random.fold_in(self._dropout_key, state.step),)
+
+    def _decorrelate_rng(self, rng):
+        """Distinct dropout keys per (dp, sp, ep) shard — those hold
+        different tokens — but the SAME key across mp shards, whose
+        replicated residual stream must see one mask."""
+        if self.model.dropout_rate <= 0.0:
+            return None
+        for ax in self._data_axes:
+            rng = jax.random.fold_in(rng, lax.axis_index(ax))
+        return rng
+
+    def _accumulate(self, grad_fn, params, inputs, targets, rng):
         """(local_mean_loss, grads) over ``grad_accum`` microbatches.
 
         A=1 is one plain forward/backward. A>1 splits the local batch
@@ -314,15 +346,18 @@ class LMTrainer(_MeshTrainer):
         """
         A = self.grad_accum
         if A == 1:
-            (_, local_mean), grads = grad_fn(params, inputs, targets)
+            (_, local_mean), grads = grad_fn(params, inputs, targets, rng)
             return local_mean, grads
         mb = inputs.shape[0] // A
         xs = (inputs.reshape(A, mb, inputs.shape[1]),
-              targets.reshape(A, mb, targets.shape[1]))
+              targets.reshape(A, mb, targets.shape[1]),
+              jnp.arange(A))
 
         def body(carry, xt):
             g_acc, l_acc = carry
-            (_, lm), g = grad_fn(params, xt[0], xt[1])
+            # Fresh dropout mask per microbatch (fold by index).
+            r = jax.random.fold_in(rng, xt[2]) if rng is not None else None
+            (_, lm), g = grad_fn(params, xt[0], xt[1], r)
             g_acc = jax.tree.map(
                 lambda a, b: a + b.astype(jnp.float32), g_acc, g)
             return (g_acc, l_acc + lm), None
@@ -333,15 +368,19 @@ class LMTrainer(_MeshTrainer):
         inv = 1.0 / float(A)
         return l_sum * inv, jax.tree.map(lambda g: g * inv, g_sum)
 
-    def _base_step(self, params, opt_state, inputs, targets):
-        def loss_terms(p, inputs, targets):
+    def _base_step(self, params, opt_state, inputs, targets, rng):
+        rng = self._decorrelate_rng(rng)
+
+        def loss_terms(p, inputs, targets, rng):
             if self.vocab_chunk:
-                hidden, aux = self.model.trunk_with_aux(p, inputs)
+                hidden, aux = self.model.trunk_with_aux(p, inputs,
+                                                        rng=rng)
                 nll = chunked_vocab_cross_entropy(
                     hidden.reshape(-1, hidden.shape[-1]), p["head"],
                     targets.reshape(-1), self.vocab_chunk)
             else:
-                logits, aux = self.model.apply_with_aux(p, inputs)
+                logits, aux = self.model.apply_with_aux(p, inputs,
+                                                        rng=rng)
                 nll = softmax_cross_entropy(
                     logits.reshape(-1, logits.shape[-1]),
                     targets.reshape(-1))
@@ -356,20 +395,20 @@ class LMTrainer(_MeshTrainer):
             return loss_for_grad, local_sum / local_n
 
         if self.is_fsdp:
-            def grad_fn(p, x, y):
+            def grad_fn(p, x, y, r):
                 # all_gather over dp materializes full leaves transiently;
                 # the AD transpose reduce-scatters cotangents, delivering
                 # this worker's dp-SUMMED gradient shard directly.
                 return jax.value_and_grad(
                     lambda flat: loss_terms(self.zero3.gather_params(flat),
-                                            x, y), has_aux=True)(p)
+                                            x, y, r), has_aux=True)(p)
         else:
-            def grad_fn(p, x, y):
+            def grad_fn(p, x, y, r):
                 return jax.value_and_grad(
-                    lambda q: loss_terms(q, x, y), has_aux=True)(p)
+                    lambda q: loss_terms(q, x, y, r), has_aux=True)(p)
 
         local_mean, grads = self._accumulate(grad_fn, params, inputs,
-                                             targets)
+                                             targets, rng)
 
         if self.is_fsdp:
             # Mean over sp (each sequence shard contributed its chunk's
@@ -432,6 +471,11 @@ class PipelineLMTrainer(_MeshTrainer):
         if model.num_layers % self.pp:
             raise ValueError(f"num_layers={model.num_layers} not "
                              f"divisible by pp={self.pp}")
+        if model.dropout_rate > 0:
+            raise ValueError(
+                "PipelineLMTrainer does not thread dropout keys through "
+                "the microbatch schedule; use dropout_rate=0 here (the "
+                "dp/sp/tp/ep engine, LMTrainer, supports dropout)")
         if self.tp > 1:
             model = model.with_tensor_parallel(MODEL_AXIS, self.tp)
         self.model = model
