@@ -25,6 +25,42 @@ class TestCollectivesBench:
             bench_collectives(make_mesh(devices[:1]), mb=0.5)
 
 
+class TestScheduledSGD:
+    def test_schedule_drives_lr_and_resumes(self):
+        from tpu_ddp.ops.optim import SGD, warmup_cosine
+
+        opt = SGD(learning_rate=warmup_cosine(1.0, 2, 10),
+                  momentum=0.0, weight_decay=0.0)
+        p = {"w": jnp.asarray([0.0])}
+        g = {"w": jnp.asarray([1.0])}
+        s = opt.init(p)
+        assert int(s["count"]) == 0
+        p1, s = opt.apply(p, g, s)        # step 1: lr = 0.5 (warmup)
+        np.testing.assert_allclose(np.asarray(p1["w"]), [-0.5], rtol=1e-6)
+        p2, s = opt.apply(p1, g, s)       # step 2: lr = 1.0 (peak)
+        np.testing.assert_allclose(np.asarray(p2["w"]), [-1.5], rtol=1e-6)
+        assert int(s["count"]) == 2
+
+    def test_plain_sgd_state_unchanged(self):
+        from tpu_ddp.ops.optim import SGD
+        s = SGD().init({"w": jnp.zeros((2,))})
+        assert set(s) == {"momentum"}  # stateless-count reference form
+
+    def test_pallas_plus_schedule_rejected_at_construction(self):
+        from tpu_ddp.ops.optim import SGD, warmup_cosine
+        with pytest.raises(ValueError, match="static lr"):
+            SGD(learning_rate=warmup_cosine(1.0, 2, 10), use_pallas=True)
+
+    def test_scheduled_lr_preserves_param_dtype(self):
+        from tpu_ddp.ops.optim import SGD, warmup_cosine
+        opt = SGD(learning_rate=warmup_cosine(1.0, 2, 10), momentum=0.0,
+                  weight_decay=0.0)
+        p = {"w": jnp.zeros((2,), jnp.bfloat16)}
+        g = {"w": jnp.ones((2,), jnp.bfloat16)}
+        new_p, _ = opt.apply(p, g, opt.init(p))
+        assert new_p["w"].dtype == jnp.bfloat16  # traced lr must not promote
+
+
 class TestEMA:
     def test_tracks_constant_params(self):
         ema = EMA(decay=0.9)

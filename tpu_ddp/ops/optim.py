@@ -28,20 +28,36 @@ SGDState = dict  # {"momentum": pytree like params}
 
 @dataclasses.dataclass(frozen=True)
 class SGD:
-    learning_rate: float = 0.1
+    # Float, or a schedule ``step (f32, 1-based) -> lr`` (e.g.
+    # :func:`warmup_cosine`) evaluated inside the jitted step — the same
+    # contract as :class:`AdamW`. Scheduled SGD carries a step count in
+    # its state; plain SGD keeps the reference's stateless two-buffer
+    # form (reference part1/main.py:124-125).
+    learning_rate: Any = 0.1
     momentum: float = 0.9
     weight_decay: float = 1e-4
     # Run the whole update as one single-pass Pallas kernel per leaf
     # (tpu_ddp/ops/pallas/sgd.py) instead of the tree.map chain below.
     use_pallas: bool = False
 
+    def __post_init__(self):
+        if callable(self.learning_rate) and self.use_pallas:
+            raise ValueError("use_pallas SGD takes a static lr; "
+                             "scheduled learning rates use the jnp path")
+
     def init(self, params) -> SGDState:
-        return {"momentum": jax.tree.map(jnp.zeros_like, params)}
+        state = {"momentum": jax.tree.map(jnp.zeros_like, params)}
+        if callable(self.learning_rate):
+            state["count"] = jnp.zeros((), jnp.int32)
+        return state
 
     def state_specs(self, param_specs):
         """Optimizer-state PartitionSpec tree mirroring ``param_specs`` —
         momentum lives in the same sharding as its parameter."""
-        return {"momentum": param_specs}
+        specs = {"momentum": param_specs}
+        if callable(self.learning_rate):
+            specs["count"] = PartitionSpec()
+        return specs
 
     def decay_mask(self, params):
         """Torch SGD decays every parameter uniformly (reference
@@ -50,9 +66,12 @@ class SGD:
 
     def map_param_like(self, state: SGDState, fn):
         """Apply ``fn`` to each params-shaped subtree of the state
-        (ZeRO/FSDP re-layout hook); scalars would pass through unchanged
-        (SGD has none)."""
-        return {"momentum": fn(state["momentum"])}
+        (ZeRO/FSDP re-layout hook); the schedule's step count (if any)
+        passes through."""
+        out = {"momentum": fn(state["momentum"])}
+        if "count" in state:
+            out["count"] = state["count"]
+        return out
 
     def _new_buf(self, p, g, buf):
         g = g.astype(p.dtype)
@@ -76,13 +95,26 @@ class SGD:
                 lr=self.learning_rate, momentum=self.momentum,
                 weight_decay=self.weight_decay)
             return new_params, {"momentum": new_buf}
+        # One update path for static and scheduled lr (AdamW's pattern):
+        # resolve lr first, conditionally carry the schedule's count.
+        scheduled = callable(self.learning_rate)
+        if scheduled:
+            count = state["count"] + 1
+            lr = self.learning_rate(count.astype(jnp.float32))
+        else:
+            lr = self.learning_rate
         # Two tree.maps (buf recomputed in the second) — XLA CSEs the
         # duplicate, and it keeps the pytree structure trivially aligned.
+        # astype: a traced f32 lr must not promote bf16 params.
         new_buf = jax.tree.map(self._new_buf, params, grads,
                                state["momentum"])
         new_params = jax.tree.map(
-            lambda p, buf: p - self.learning_rate * buf, params, new_buf)
-        return new_params, {"momentum": new_buf}
+            lambda p, buf: (p - lr * buf).astype(p.dtype),
+            params, new_buf)
+        out = {"momentum": new_buf}
+        if scheduled:
+            out["count"] = count
+        return new_params, out
 
 
 @dataclasses.dataclass(frozen=True)
