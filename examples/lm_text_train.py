@@ -34,6 +34,9 @@ CORPUS = [
 def main() -> int:
     import jax
 
+    # Not a no-op: some environments pre-import jax from a site hook
+    # that programmatically overrides jax_platforms AFTER the env var
+    # was read — re-assert the user's choice (same as lm_train.py).
     env_platforms = os.environ.get("JAX_PLATFORMS")
     if env_platforms and jax.config.jax_platforms != env_platforms:
         jax.config.update("jax_platforms", env_platforms)
@@ -90,14 +93,12 @@ def main() -> int:
         path = trainer.save_checkpoint(ckpt_dir, state)
         print(f"[lm_text] checkpoint: {path}")
 
-    # Sample from the trained model (dense single-device decode; the
-    # trained params are replicated, so the first shard's copy serves).
-    dense = make_transformer("TransformerLM-tiny",
-                             vocab_size=tok.vocab_size,
-                             max_seq_len=seq_len)
+    # Sample from the trained model: `model` is already dense (this mesh
+    # has sp=tp=ep=1, and LMTrainer never mutates the caller's copy);
+    # generate passes no rng, so dropout is inert at decode time.
     params = jax.device_get(state.params)
     prompt = tok.encode("the quick brown ")[None, :]
-    out = generate(dense, params, prompt, max_new_tokens=24)
+    out = generate(model, params, prompt, max_new_tokens=24)
     print(f"[lm_text] sample: {tok.decode(prompt[0])!r} -> "
           f"{tok.decode(np.asarray(out)[0])!r}")
     return 0
