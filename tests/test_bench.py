@@ -1,0 +1,41 @@
+"""bench.py — the driver's benchmark entry point.
+
+Guards the contract the driver depends on: every config produces one
+dict with metric/value/unit/vs_baseline, shrunk to smoke size here
+(real numbers come from the TPU run).
+"""
+
+import numpy as np
+import pytest
+
+import bench
+
+
+class TestBenchEntry:
+    def test_headline_vgg_contract(self):
+        out = bench.run_bench(batch_size=8, timed_iters=2,
+                              config="vgg11_cifar10")
+        assert out["metric"] == "cifar10_vgg11_images_per_sec_per_chip"
+        assert out["unit"] == "images/sec"
+        assert out["value"] > 0 and np.isfinite(out["value"])
+        # Tolerance, not equality: value is rounded to 0.1 before this
+        # check while vs_baseline was rounded from the unrounded rate.
+        assert abs(out["vs_baseline"] - out["value"] / 386.0) < 0.01
+        assert out["extra"]["timed_iters"] == 2
+
+    def test_vit_config(self):
+        out = bench.run_bench(batch_size=8, timed_iters=2,
+                              config="vit_cifar10")
+        assert out["metric"] == "cifar10_vit-tiny_images_per_sec_per_chip"
+        assert out["vs_baseline"] is None  # no reference number exists
+        assert out["value"] > 0
+
+    def test_lm_config(self):
+        out = bench.run_lm_bench(batch_size=2, seq_len=64, timed_iters=2)
+        assert out["metric"] == "transformer_lm_tokens_per_sec_per_chip"
+        assert out["unit"] == "tokens/sec"
+        assert out["value"] > 0 and np.isfinite(out["value"])
+
+    def test_unknown_config_rejected(self):
+        with pytest.raises(ValueError, match="unknown preset"):
+            bench.run_bench(config="resnet9000")
