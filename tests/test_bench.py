@@ -39,3 +39,36 @@ class TestBenchEntry:
     def test_unknown_config_rejected(self):
         with pytest.raises(ValueError, match="unknown preset"):
             bench.run_bench(config="resnet9000")
+
+    def test_mfu_fields_present(self, monkeypatch):
+        monkeypatch.delenv("TPU_DDP_PEAK_TFLOPS", raising=False)
+        out = bench.run_bench(batch_size=4, timed_iters=1,
+                              config="vgg11_cifar10")
+        ex = out["extra"]
+        # Analytic model FLOPs: VGG-11 on 32x32 is ~153M MACs fwd/img
+        # (~306 MFLOPs), train = 3x fwd.
+        per_img_fwd = ex["flops_per_step"] / 3 / 4
+        assert 2.5e8 < per_img_fwd < 3.5e8
+        assert ex["flops_source"] == "analytic"
+        assert ex["achieved_tflops"] > 0
+        # CPU platform: no peak table -> mfu is null, never a wrong number.
+        assert ex["mfu"] is None and ex["peak_tflops_bf16"] is None
+
+    def test_mfu_env_peak_override(self, monkeypatch):
+        monkeypatch.setenv("TPU_DDP_PEAK_TFLOPS", "100")
+        out = bench.run_lm_bench(batch_size=2, seq_len=64, timed_iters=1)
+        ex = out["extra"]
+        assert ex["peak_tflops_bf16"] == 100.0
+        # Both fields are rounded (3 and 4 decimals) before comparison;
+        # on CPU the values are tiny, so tolerate the rounding error.
+        assert ex["mfu"] == pytest.approx(
+            ex["achieved_tflops"] / 100.0, abs=2e-4)
+
+    def test_collectives_bench_shape(self):
+        out = bench.run_collectives_bench(mb=0.5, iters=2)
+        # 8-device virtual mesh in tests -> real results, not skipped.
+        assert out["devices"] == 8
+        assert set(out["results"]) == {"psum", "psum_scatter", "all_gather",
+                                       "ppermute", "all_to_all"}
+        for r in out["results"].values():
+            assert r["ms"] > 0 and r["gbps"] > 0

@@ -21,6 +21,7 @@ resume. Design:
 
 from __future__ import annotations
 
+import atexit
 import functools
 import json
 import os
@@ -28,6 +29,7 @@ import re
 import shutil
 import tempfile
 import threading
+import weakref
 
 import jax
 import numpy as np
@@ -99,9 +101,34 @@ class AsyncCheckpointWriter:
       before reading the checkpoint back or exiting the process.
     """
 
+    # Live writers, drained by ONE atexit hook (registered lazily below):
+    # the writer thread is a daemon, so without the drain a clean exit
+    # would silently abandon the last submitted checkpoint (and swallow
+    # any stored write error — wait() re-raises, atexit prints it).
+    _live: "weakref.WeakSet[AsyncCheckpointWriter]" = weakref.WeakSet()
+    _atexit_registered = False
+
+    @classmethod
+    def _drain_all(cls):
+        # Drain EVERY writer before surfacing any failure — one failed
+        # write must not abandon the other writers' in-flight checkpoints.
+        first_error = None
+        for writer in list(cls._live):
+            try:
+                writer.wait()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+
     def __init__(self):
         self._thread: threading.Thread | None = None
         self._error: BaseException | None = None
+        AsyncCheckpointWriter._live.add(self)
+        if not AsyncCheckpointWriter._atexit_registered:
+            AsyncCheckpointWriter._atexit_registered = True
+            atexit.register(AsyncCheckpointWriter._drain_all)
 
     def submit(self, directory: str, state, step: int,
                keep_last: int | None = None) -> str:
