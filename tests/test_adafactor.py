@@ -38,6 +38,34 @@ class TestState:
         assert s["vr"]["w"].shape == (3, 16)
         assert s["vc"]["w"].shape == (3, 8)
 
+    def test_attention_shaped_leaf_factors_via_split(self):
+        """(dm, 3, heads, head_dim) with head_dim below the threshold:
+        the old rule fell back to a FULL second moment; the split plan
+        views it as (dm, 3*heads*head_dim) and factors O(n+m)."""
+        opt = Adafactor(min_dim_size_to_factor=16)
+        s = opt.init({"wqkv": jnp.ones((64, 3, 4, 8))})
+        assert s["vr"]["wqkv"].shape == (64,)
+        assert s["vc"]["wqkv"].shape == (96,)
+        assert s["v"]["wqkv"].shape == (1,)  # no O(nm) fallback
+
+    def test_split_plan_update_matches_reshaped_2d(self):
+        """The split-factored update of a 4-D leaf must equal the batch-
+        factored update of the same data reshaped to the 2-D view."""
+        rng = np.random.default_rng(7)
+        p4 = rng.normal(size=(32, 2, 4, 8)).astype(np.float32)
+        g4 = rng.normal(size=(32, 2, 4, 8)).astype(np.float32)
+        opt = Adafactor(min_dim_size_to_factor=16)
+        p_new4, _ = opt.apply({"w": jnp.asarray(p4)},
+                              {"w": jnp.asarray(g4)},
+                              opt.init({"w": jnp.asarray(p4)}))
+        p2, g2 = p4.reshape(32, 64), g4.reshape(32, 64)
+        p_new2, _ = opt.apply({"w": jnp.asarray(p2)},
+                              {"w": jnp.asarray(g2)},
+                              opt.init({"w": jnp.asarray(p2)}))
+        np.testing.assert_allclose(
+            np.asarray(p_new4["w"]).reshape(32, 64),
+            np.asarray(p_new2["w"]), rtol=1e-5, atol=1e-8)
+
 
 class TestUpdateMath:
     def test_first_step_unit_gradient(self):
@@ -136,5 +164,225 @@ class TestTrainerIntegration:
     def test_refuses_zero_relayout(self):
         opt = Adafactor(min_dim_size_to_factor=8)
         s = opt.init({"w": jnp.ones((16, 16))})
-        with pytest.raises(NotImplementedError, match="re-laid-out"):
+        with pytest.raises(NotImplementedError, match="FactoredZeRO1"):
             opt.map_param_like(s, lambda t: t)
+
+
+def _sharded_adafactor_step(mesh, wrapper, params, per_worker_grads,
+                            opt_state):
+    """Run wrapper.apply inside a shard_map over dp; per_worker_grads is
+    a list of dp grad trees (stacked on a leading axis for sharding)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_ddp.parallel.mesh import DATA_AXIS
+
+    specs = wrapper.state_specs()
+    stacked = jax.tree.map(lambda *gs: jnp.stack(gs), *per_worker_grads)
+
+    def step(p, state, g):
+        g = jax.tree.map(lambda x: x[0], g)  # my worker's grad tree
+        return wrapper.apply(p, g, state)
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), specs, P(DATA_AXIS)),
+        out_specs=(P(), specs), check_vma=False)
+    state_sh = jax.device_put(
+        opt_state, jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P)))
+    return mapped(params, state_sh, stacked)
+
+
+class TestFactoredZeRO1:
+    """The row-sharded ZeRO-1 wrapper must be EXACT vs the replicated
+    optimizer fed the dp-mean gradient (tpu_ddp/parallel/zero.py)."""
+
+    def _params(self):
+        rng = np.random.default_rng(11)
+        return {
+            "w": jnp.asarray(rng.normal(size=(24, 16)), jnp.float32),
+            "wqkv": jnp.asarray(rng.normal(size=(16, 3, 2, 4)),
+                                jnp.float32),      # split plan
+            "stack": jnp.asarray(rng.normal(size=(3, 16, 8)),
+                                 jnp.float32),      # batch plan
+            "b": jnp.asarray(rng.normal(size=(10,)), jnp.float32),
+        }
+
+    def _grads(self, n):
+        rng = np.random.default_rng(23)
+        p = self._params()
+        return [jax.tree.map(
+            lambda x: jnp.asarray(rng.normal(size=x.shape), jnp.float32),
+            p) for _ in range(n)]
+
+    @pytest.mark.parametrize("b1,lr", [(None, None), (0.9, 1e-2)])
+    def test_matches_replicated(self, devices, b1, lr):
+        from tpu_ddp.parallel.mesh import make_mesh
+        from tpu_ddp.parallel.zero import FactoredZeRO1
+
+        mesh = make_mesh(devices[:4], dp=4)
+        opt = Adafactor(min_dim_size_to_factor=8, b1=b1, learning_rate=lr,
+                        weight_decay=0.01)
+        params = self._params()
+        per_worker = self._grads(4)
+        wrapper = FactoredZeRO1(opt, axis_size=4, template=params)
+        p_sh, s_sh = _sharded_adafactor_step(
+            mesh, wrapper, params, per_worker, wrapper.init(params))
+
+        g_mean = jax.tree.map(lambda *gs: sum(gs) / 4.0, *per_worker)
+        p_ref, s_ref = opt.apply(params, g_mean, opt.init(params))
+
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(p_sh[k]), np.asarray(p_ref[k]),
+                rtol=2e-5, atol=1e-6, err_msg=f"param {k}")
+        # State matches in CANONICAL form (pad rows sliced off).
+        canon = wrapper.canonicalize_opt_host(jax.device_get(s_sh))
+        for part in ("vr", "vc", "v"):
+            for k in params:
+                np.testing.assert_allclose(
+                    np.asarray(canon[part][k]),
+                    np.asarray(s_ref[part][k]),
+                    rtol=2e-5, atol=1e-6, err_msg=f"{part}/{k}")
+
+    def test_two_steps_stay_exact(self, devices):
+        """Factored statistics accumulate across steps; a second step
+        catches any drift the first step's zero-init state hides."""
+        from tpu_ddp.parallel.mesh import make_mesh
+        from tpu_ddp.parallel.zero import FactoredZeRO1
+
+        mesh = make_mesh(devices[:2], dp=2)
+        opt = Adafactor(min_dim_size_to_factor=8)
+        params = self._params()
+        wrapper = FactoredZeRO1(opt, axis_size=2, template=params)
+        state = wrapper.init(params)
+        p_ref, s_ref = params, opt.init(params)
+        for step_i in range(2):
+            per_worker = self._grads(2)
+            params, state = _sharded_adafactor_step(
+                mesh, wrapper, params, per_worker, state)
+            g_mean = jax.tree.map(lambda *gs: sum(gs) / 2.0, *per_worker)
+            p_ref, s_ref = opt.apply(p_ref, g_mean, s_ref)
+        for k in p_ref:
+            np.testing.assert_allclose(
+                np.asarray(params[k]), np.asarray(p_ref[k]),
+                rtol=2e-5, atol=1e-6, err_msg=f"param {k} after 2 steps")
+
+    def test_state_is_sharded_1_over_n(self, devices):
+        """The memory claim: vr (and mu under b1) shard over dp."""
+        from tpu_ddp.parallel.mesh import DATA_AXIS, make_mesh
+        from tpu_ddp.parallel.zero import FactoredZeRO1
+
+        del devices
+        opt = Adafactor(min_dim_size_to_factor=8, b1=0.9)
+        params = {"w": jnp.ones((24, 16))}
+        wrapper = FactoredZeRO1(opt, axis_size=4, template=params)
+        state = wrapper.init(params)
+        specs = wrapper.state_specs()
+        assert state["vr"]["w"].shape == (24,)
+        assert tuple(specs["vr"]["w"]) == (DATA_AXIS,)
+        assert state["mu"]["w"].shape == (24, 16)
+        assert tuple(specs["mu"]["w"]) == (DATA_AXIS, None)
+        assert tuple(specs["vc"]["w"]) == ()
+
+    def test_canonicalize_flatten_roundtrip(self):
+        from tpu_ddp.parallel.zero import FactoredZeRO1
+
+        opt = Adafactor(min_dim_size_to_factor=8, b1=0.9)
+        params = self._params()
+        wrapper = FactoredZeRO1(opt, axis_size=4, template=params)
+        state = jax.device_get(wrapper.init(params))
+        canon = wrapper.canonicalize_opt_host(state)
+        # Canonical shapes == the replicated optimizer's state shapes.
+        ref = jax.device_get(opt.init(params))
+        for part in ("vr", "vc", "v", "mu"):
+            for k in params:
+                assert np.shape(canon[part][k]) == \
+                    np.shape(ref[part][k]), f"{part}/{k}"
+        back = wrapper.flatten_opt(canon)
+        for part in ("vr", "vc", "v", "mu"):
+            for k in params:
+                np.testing.assert_array_equal(
+                    np.asarray(back[part][k]), np.asarray(state[part][k]),
+                    err_msg=f"{part}/{k}")
+
+    def test_lmtrainer_zero1_matches_replicated(self, devices):
+        """LMTrainer(opt_sharding='zero1') with Adafactor: losses track
+        the replicated run step for step."""
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.parallel.mesh import make_mesh
+        from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:2], dp=2)
+        tokens = np.random.default_rng(5).integers(0, 1024, size=(4, 33))
+        losses = {}
+        for sharding in ("replicated", "zero1"):
+            tr = LMTrainer(model, mesh,
+                           optimizer=Adafactor(min_dim_size_to_factor=8),
+                           opt_sharding=sharding)
+            state = tr.init_state(seed=0)
+            x, y = tr.put_batch(*make_lm_batch(tokens))
+            run = []
+            for _ in range(3):
+                state, loss = tr.train_step(state, x, y)
+                run.append(float(np.mean(np.asarray(loss))))
+            losses[sharding] = run
+        np.testing.assert_allclose(losses["zero1"], losses["replicated"],
+                                   rtol=1e-4)
+
+    def test_lmtrainer_zero1_adamw_matches_replicated(self, devices):
+        """The elementwise branch: AdamW under opt_sharding='zero1' goes
+        through the flat ZeRO1 wrapper and must match too."""
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.ops.optim import AdamW
+        from tpu_ddp.parallel.mesh import make_mesh
+        from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:2], dp=2)
+        tokens = np.random.default_rng(6).integers(0, 1024, size=(4, 33))
+        losses = {}
+        for sharding in ("replicated", "zero1"):
+            tr = LMTrainer(model, mesh, optimizer=AdamW(),
+                           opt_sharding=sharding)
+            state = tr.init_state(seed=0)
+            x, y = tr.put_batch(*make_lm_batch(tokens))
+            run = []
+            for _ in range(3):
+                state, loss = tr.train_step(state, x, y)
+                run.append(float(np.mean(np.asarray(loss))))
+            losses[sharding] = run
+        np.testing.assert_allclose(losses["zero1"], losses["replicated"],
+                                   rtol=1e-4)
+
+    def test_zero1_checkpoint_restores_into_replicated(self, devices,
+                                                       tmp_path):
+        """zero1 checkpoints hold canonical shapes: a replicated trainer
+        restores them and continues identically."""
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.parallel.mesh import make_mesh
+        from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+
+        model = make_transformer("TransformerLM-tiny", max_seq_len=16,
+                                 compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:2], dp=2)
+        opt = Adafactor(min_dim_size_to_factor=8, learning_rate=1e-2)
+        tokens = np.random.default_rng(9).integers(0, 1024, size=(2, 17))
+        tr = LMTrainer(model, mesh, optimizer=opt, opt_sharding="zero1")
+        state = tr.init_state(seed=3)
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        state, _ = tr.train_step(state, x, y)
+        tr.save_checkpoint(str(tmp_path), state)
+        cont, _ = tr.train_step(state, x, y)
+
+        repl = LMTrainer(model, mesh, optimizer=opt)
+        resumed = repl.restore_checkpoint(str(tmp_path))
+        resumed, _ = repl.train_step(resumed, x, y)
+        for a, b in zip(jax.tree.leaves(jax.device_get(cont.params)),
+                        jax.tree.leaves(jax.device_get(resumed.params))):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)

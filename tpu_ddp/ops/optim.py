@@ -20,6 +20,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec
 
 
@@ -208,11 +209,15 @@ class Adafactor:
     and, when ``learning_rate`` is None, the relative step size
     ``min(1e-2, 1/sqrt(t)) * max(eps2, RMS(param))``.
 
-    Same pure-pytree-transform shape as :class:`SGD`/:class:`AdamW`. Not
-    composable with ZeRO/FSDP re-layout or tensor-sharded parameters —
-    the factored state is shape-coupled to whole leaves; those stacks
-    use :class:`AdamW` (``map_param_like``/``state_specs`` refuse
-    loudly rather than silently misfactor).
+    Same pure-pytree-transform shape as :class:`SGD`/:class:`AdamW`.
+
+    Composition: ZeRO-1 optimizer-state sharding uses the dedicated
+    row-sharded wrapper ``tpu_ddp.parallel.zero.FactoredZeRO1``
+    (``LMTrainer(opt_sharding="zero1")`` selects it automatically) — the
+    generic flat re-layout (``map_param_like``) cannot host factored
+    state and refuses loudly. Tensor-sharded (tp/ep) parameter leaves
+    are likewise refused by ``state_specs``; those stacks use
+    :class:`AdamW`.
     """
 
     learning_rate: Any = None       # None -> relative step size schedule
@@ -224,20 +229,59 @@ class Adafactor:
     b1: float | None = None        # optional first moment (off = paper default)
     weight_decay: float = 0.0
 
+    def _plan(self, shape):
+        """How to factor a leaf of ``shape`` (None = full second moment).
+
+        - ``("batch", None)``: factor the last two dims, batched over any
+          leading dims (vr = shape[:-1], vc = shape[:-2]+shape[-1:]) —
+          the right semantics for stacked per-layer/per-expert matrices,
+          where each matrix gets its own factors.
+        - ``("split", k)``: the last two dims are too small (e.g. the
+          (dm, 3, heads, head_dim) attention leaves, where head_dim <
+          min_dim_size_to_factor), so view the leaf as the 2-D matrix
+          (prod(shape[:k]), prod(shape[k:])) picking the contiguous
+          split k that qualifies with minimal vr+vc memory.
+        """
+        if (len(shape) >= 2
+                and min(shape[-2:]) >= self.min_dim_size_to_factor):
+            return ("batch", None)
+        if len(shape) > 2:
+            best = None
+            for k in range(1, len(shape)):
+                r = int(np.prod(shape[:k]))
+                c = int(np.prod(shape[k:]))
+                if min(r, c) >= self.min_dim_size_to_factor:
+                    if best is None or r + c < best[0]:
+                        best = (r + c, k)
+            if best is not None:
+                return ("split", best[1])
+        return None
+
     def _factored(self, shape) -> bool:
-        return (len(shape) >= 2
-                and min(shape[-2:]) >= self.min_dim_size_to_factor)
+        return self._plan(shape) is not None
+
+    def _view_shape(self, shape) -> tuple:
+        """The shape factoring math runs over: the leaf itself under the
+        "batch" plan, the 2-D split view under "split"."""
+        plan = self._plan(shape)
+        if plan is None or plan[0] == "batch":
+            return tuple(shape)
+        k = plan[1]
+        return (int(np.prod(shape[:k])), int(np.prod(shape[k:])))
 
     def init(self, params) -> dict:
         one = lambda: jnp.zeros((1,), jnp.float32)  # noqa: E731
 
         def vr(p):
-            return (jnp.zeros(p.shape[:-1], jnp.float32)
-                    if self._factored(p.shape) else one())
+            if not self._factored(p.shape):
+                return one()
+            return jnp.zeros(self._view_shape(p.shape)[:-1], jnp.float32)
 
         def vc(p):
-            return (jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32)
-                    if self._factored(p.shape) else one())
+            if not self._factored(p.shape):
+                return one()
+            view = self._view_shape(p.shape)
+            return jnp.zeros(view[:-2] + view[-1:], jnp.float32)
 
         def v(p):
             return (one() if self._factored(p.shape)
@@ -275,8 +319,10 @@ class Adafactor:
     def map_param_like(self, state, fn):
         raise NotImplementedError(
             "Adafactor's factored state is shape-coupled to its original "
-            "leaves and cannot be re-laid-out by ZeRO/FSDP; use AdamW "
-            "there")
+            "leaves and cannot be re-laid-out by the flat ZeRO/FSDP "
+            "wrappers; use tpu_ddp.parallel.zero.FactoredZeRO1 "
+            "(LMTrainer(opt_sharding='zero1')) which shards the factored "
+            "state natively, or AdamW under FSDP")
 
     def apply(self, params, grads, state, decay_mask=None):
         count = state["count"] + 1
@@ -295,16 +341,20 @@ class Adafactor:
             g32 = g.astype(jnp.float32)
             g2 = jnp.square(g32) + self.eps1
             if self._factored(p.shape):
-                new_vr = beta2t * vr + (1 - beta2t) * jnp.mean(g2, axis=-1)
-                new_vc = beta2t * vc + (1 - beta2t) * jnp.mean(g2, axis=-2)
+                # Factoring runs over the plan's 2-D-per-matrix view
+                # (identical to the leaf itself under the "batch" plan).
+                view = self._view_shape(p.shape)
+                g2v = g2.reshape(view)
+                new_vr = beta2t * vr + (1 - beta2t) * jnp.mean(g2v, axis=-1)
+                new_vc = beta2t * vc + (1 - beta2t) * jnp.mean(g2v, axis=-2)
                 new_v = v
                 # V[i,j] ≈ vr[i]·vc[j] / mean_i(vr) — exact for rank-1
                 # g² (with mean-form accumulators the normalizer is the
                 # row-moment MEAN, not its sum); rsqrt applied factored
                 # so the (n, m) moment matrix is never materialized.
                 r = new_vr / jnp.mean(new_vr, axis=-1, keepdims=True)
-                u = g32 * jax.lax.rsqrt(r[..., :, None]) \
-                    * jax.lax.rsqrt(new_vc[..., None, :])
+                u = (g32.reshape(view) * jax.lax.rsqrt(r[..., :, None])
+                     * jax.lax.rsqrt(new_vc[..., None, :])).reshape(p.shape)
             else:
                 new_vr, new_vc = vr, vc
                 new_v = beta2t * v + (1 - beta2t) * g2

@@ -175,6 +175,342 @@ class ZeRO1(_FlatLayout):
         return jax.tree.map(reassemble, params, new_p_sh), new_state
 
 
+class FactoredZeRO1:
+    """ZeRO-1 for FACTORED optimizers (Adafactor) — exact, row-sharded.
+
+    :class:`ZeRO1`'s flat slices destroy the row/column structure
+    Adafactor's factored second moment is built from, so the generic
+    wrapper cannot host it (tpu_ddp/ops/optim.py:Adafactor refuses).
+    This wrapper shards BY ROWS of each leaf's factoring view instead
+    (the (..., n, m) per-matrix view from ``Adafactor._view_shape``):
+
+    - ``psum_scatter`` over the view's row axis hands each worker the
+      dp-MEAN of its 1/N row block (half an all-reduce, as ZeRO-1);
+    - the row factor ``vr`` (and the momentum ``mu`` when b1 is set —
+      the only O(nm) state) shard with the rows: state memory O(P/N);
+    - the column factor ``vc`` stays replicated (it is the O(m) part)
+      and its cross-row mean, the ``vr`` normalizer, and the update-RMS
+      clip each cost one tiny ``psum`` over dp;
+    - ``all_gather`` reassembles the updated rows on every worker.
+
+    The result is bit-equal (up to reduction order) to replicated
+    Adafactor — tested in tests/test_adafactor.py — while sharding the
+    update compute and the O(nm) momentum 1/N over dp. Leaves too small
+    to factor take :class:`ZeRO1`'s flat elementwise path, with the RMS
+    terms psum'd so clipping stays global per leaf.
+    """
+
+    def __init__(self, inner, axis_name: str = DATA_AXIS,
+                 axis_size: int | None = None, template=None):
+        if axis_size is None or axis_size < 1:
+            raise ValueError("FactoredZeRO1 needs the static dp axis size")
+        if not hasattr(inner, "_plan"):
+            raise ValueError("FactoredZeRO1 wraps factored optimizers "
+                             "(Adafactor); use ZeRO1 for elementwise ones")
+        self.inner = inner
+        self.axis_name = axis_name
+        self.axis_size = axis_size
+        self.meta = (jax.tree.map(_LeafMeta, template)
+                     if template is not None else None)
+
+    # Shared helpers (same semantics as the flat-layout wrappers; aliased,
+    # not re-implemented, so the two cannot drift).
+    _chunk = _FlatLayout._chunk
+    _require_meta = _FlatLayout._require_meta
+
+    # ---- per-leaf geometry ---------------------------------------------
+
+    def _geom(self, shape):
+        """(lead, n, m, n_loc) of the factoring view, or None when the
+        leaf is unfactored (flat elementwise path)."""
+        if self.inner._plan(shape) is None:
+            return None
+        view = self.inner._view_shape(shape)
+        lead, n, m = view[:-2], view[-2], view[-1]
+        n_loc = self._chunk(n)
+        return lead, n, m, n_loc
+
+    # ---- state layout (global view) ------------------------------------
+
+    def init(self, params) -> dict:
+        N = self.axis_size
+        one = lambda: jnp.zeros((1,), jnp.float32)  # noqa: E731
+
+        def vr(p):
+            g = self._geom(p.shape)
+            if g is None:
+                return one()
+            lead, n, m, n_loc = g
+            return jnp.zeros(lead + (n_loc * N,), jnp.float32)
+
+        def vc(p):
+            g = self._geom(p.shape)
+            if g is None:
+                return one()
+            lead, n, m, n_loc = g
+            return jnp.zeros(lead + (m,), jnp.float32)
+
+        def v(p):
+            if self._geom(p.shape) is not None:
+                return one()
+            return jnp.zeros((self._chunk(p.size) * N,), jnp.float32)
+
+        def mu(p):
+            if self.inner.b1 is None:
+                return one()
+            g = self._geom(p.shape)
+            if g is None:
+                return jnp.zeros((self._chunk(p.size) * N,), p.dtype)
+            lead, n, m, n_loc = g
+            return jnp.zeros(lead + (n_loc * N, m), p.dtype)
+
+        return {"vr": jax.tree.map(vr, params),
+                "vc": jax.tree.map(vc, params),
+                "v": jax.tree.map(v, params),
+                "mu": jax.tree.map(mu, params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def state_specs(self, param_specs=None):
+        """Per-leaf specs over the layout above. Only replicated params
+        are supported — the row geometry is computed from FULL leaf
+        shapes, so tp/ep-sharded leaves are refused loudly (the same
+        refusal the replicated Adafactor makes), not silently mis-rowed.
+        """
+        self._require_meta()
+        if param_specs is not None:
+            def check(spec):
+                if tuple(x for x in spec if x is not None):
+                    raise NotImplementedError(
+                        "FactoredZeRO1 shards over full-leaf row geometry "
+                        f"and does not compose with sharded parameter "
+                        f"leaves (got spec {spec}); use AdamW for "
+                        "tp/ep-sharded models")
+                return spec
+            jax.tree.map(check, param_specs,
+                         is_leaf=lambda x: isinstance(x, P))
+        ax = self.axis_name
+
+        def vr_spec(m):
+            g = self._geom(m.shape)
+            if g is None:
+                return P()
+            return P(*([None] * len(g[0])), ax)
+
+        def v_spec(m):
+            return P() if self._geom(m.shape) is not None else P(ax)
+
+        def mu_spec(m):
+            if self.inner.b1 is None:
+                return P()
+            g = self._geom(m.shape)
+            if g is None:
+                return P(ax)
+            return P(*([None] * len(g[0])), ax, None)
+
+        return {"vr": jax.tree.map(vr_spec, self.meta),
+                "vc": jax.tree.map(lambda m: P(), self.meta),
+                "v": jax.tree.map(v_spec, self.meta),
+                "mu": jax.tree.map(mu_spec, self.meta),
+                "count": P()}
+
+    # ---- checkpoint canonicalization (host-side) -----------------------
+
+    def canonicalize_opt_host(self, state) -> dict:
+        """Gathered (global-layout) host state -> the replicated
+        Adafactor's canonical shapes, so checkpoints restore at any dp
+        size or into an unsharded trainer."""
+        self._require_meta()
+
+        def vr(x, m):
+            g = self._geom(m.shape)
+            if g is None:
+                return np.asarray(x)
+            lead, n, _, _ = g
+            return np.asarray(x)[..., :n]
+
+        def v(x, m):
+            if self._geom(m.shape) is not None:
+                return np.asarray(x)
+            return np.asarray(x)[:m.size].reshape(m.shape)
+
+        def mu(x, m):
+            if self.inner.b1 is None:
+                return np.asarray(x)
+            g = self._geom(m.shape)
+            if g is None:
+                return np.asarray(x)[:m.size].reshape(m.shape)
+            lead, n, mm, _ = g
+            return np.asarray(x)[..., :n, :].reshape(m.shape)
+
+        return {"vr": jax.tree.map(vr, state["vr"], self.meta),
+                "vc": jax.tree.map(lambda x, m: np.asarray(x),
+                                   state["vc"], self.meta),
+                "v": jax.tree.map(v, state["v"], self.meta),
+                "mu": jax.tree.map(mu, state["mu"], self.meta),
+                "count": state["count"]}
+
+    def flatten_opt(self, state) -> dict:
+        """Canonical host state -> this wrapper's global layout (restore
+        path; inverse of :meth:`canonicalize_opt_host`)."""
+        self._require_meta()
+        N = self.axis_size
+
+        def vr(x, m):
+            g = self._geom(m.shape)
+            if g is None:
+                return np.asarray(x)
+            lead, n, _, n_loc = g
+            pad = [(0, 0)] * len(lead) + [(0, n_loc * N - n)]
+            return np.pad(np.asarray(x), pad)
+
+        def v(x, m):
+            if self._geom(m.shape) is not None:
+                return np.asarray(x)
+            flat = np.asarray(x).reshape(-1)
+            return np.pad(flat, (0, self._chunk(m.size) * N - m.size))
+
+        def mu(x, m):
+            if self.inner.b1 is None:
+                return np.asarray(x)
+            g = self._geom(m.shape)
+            if g is None:
+                return v(x, m)
+            lead, n, mm, n_loc = g
+            arr = np.asarray(x).reshape(lead + (n, mm))
+            pad = [(0, 0)] * len(lead) + [(0, n_loc * N - n), (0, 0)]
+            return np.pad(arr, pad)
+
+        return {"vr": jax.tree.map(vr, state["vr"], self.meta),
+                "vc": jax.tree.map(lambda x, m: np.asarray(x),
+                                   state["vc"], self.meta),
+                "v": jax.tree.map(v, state["v"], self.meta),
+                "mu": jax.tree.map(mu, state["mu"], self.meta),
+                "count": state["count"]}
+
+    # ---- the sharded update (inside shard_map) -------------------------
+
+    def apply(self, params, grads, opt_state):
+        """One sharded Adafactor step; call inside shard_map over the dp
+        axis with ``grads`` UNSYNCED over dp (pre-synced over any other
+        data axes). Returns (new_params, new_state) with params full-size
+        and identical on every worker."""
+        o = self.inner
+        ax, N = self.axis_name, self.axis_size
+        idx = lax.axis_index(ax)
+        count = opt_state["count"] + 1
+        c = count.astype(jnp.float32)
+        beta2t = 1.0 - c ** (-o.decay_rate)
+        if o.learning_rate is None:
+            rho, lr = jnp.minimum(1e-2, 1.0 / jnp.sqrt(c)), None
+        else:
+            lr = (o.learning_rate(c) if callable(o.learning_rate)
+                  else o.learning_rate)
+            rho = None
+        decay_mask = o.decay_mask(params)
+
+        def alpha_for(p):
+            if lr is not None:
+                return lr
+            rms_p = jnp.sqrt(jnp.mean(jnp.square(p.astype(jnp.float32))))
+            return rho * jnp.maximum(o.eps2, rms_p)
+
+        def upd(p, g, vr, vc, v, mu, dk):
+            geom = self._geom(p.shape)
+            if geom is None:
+                return self._upd_flat(p, g, vr, vc, v, mu, dk, idx,
+                                      beta2t, alpha_for(p))
+            return self._upd_factored(p, g, vr, vc, v, mu, dk, idx,
+                                      beta2t, alpha_for(p), geom)
+
+        p_l, treedef = jax.tree.flatten(params)
+        outs = [upd(*args) for args in zip(
+            p_l, jax.tree.leaves(grads),
+            jax.tree.leaves(opt_state["vr"]),
+            jax.tree.leaves(opt_state["vc"]),
+            jax.tree.leaves(opt_state["v"]),
+            jax.tree.leaves(opt_state["mu"]),
+            jax.tree.leaves(decay_mask))]
+        unf = lambda i: treedef.unflatten([o_[i] for o_ in outs])  # noqa: E731
+        return unf(0), {"vr": unf(1), "vc": unf(2), "v": unf(3),
+                        "mu": unf(4), "count": count}
+
+    def _clip(self, u, sq_sum, n_elems):
+        rms_u = jnp.sqrt(sq_sum / n_elems)
+        return u / jnp.maximum(1.0, rms_u / self.inner.clip_threshold)
+
+    def _step_and_mu(self, u, mu, p_dtype):
+        if self.inner.b1 is None:
+            return u, mu
+        new_mu = self.inner.b1 * mu + (1 - self.inner.b1) * u.astype(p_dtype)
+        return new_mu, new_mu
+
+    def _upd_factored(self, p, g, vr, vc, v, mu, dk, idx, beta2t, alpha,
+                      geom):
+        o, ax, N = self.inner, self.axis_name, self.axis_size
+        lead, n, m, n_loc = geom
+        L = len(lead)
+
+        def to_blocks(x):
+            """(orig shape) -> (lead..., N, n_loc, m) row blocks."""
+            xv = x.reshape(lead + (n, m))
+            pad = [(0, 0)] * L + [(0, n_loc * N - n), (0, 0)]
+            return jnp.pad(xv, pad).reshape(lead + (N, n_loc, m))
+
+        # dp-mean of MY row block: psum_scatter = half an all-reduce.
+        g_loc = lax.psum_scatter(to_blocks(g.astype(jnp.float32)), ax,
+                                 scatter_dimension=L) / N
+        # Rows >= n are padding on the last worker(s): masked out of every
+        # cross-row reduction, and sliced off at reassembly.
+        row_mask = ((idx * n_loc + jnp.arange(n_loc)) < n
+                    ).astype(jnp.float32)                     # (n_loc,)
+        g2 = jnp.square(g_loc) + o.eps1
+        new_vr = beta2t * vr + (1 - beta2t) * jnp.mean(g2, axis=-1)
+        col_sum = lax.psum(
+            jnp.sum(g2 * row_mask[:, None], axis=-2), ax)     # (lead..., m)
+        new_vc = beta2t * vc + (1 - beta2t) * col_sum / n
+        vr_mean = lax.psum(jnp.sum(new_vr * row_mask, axis=-1), ax) / n
+        r = new_vr / vr_mean[..., None]
+        u = g_loc * lax.rsqrt(r)[..., None] * lax.rsqrt(new_vc)[..., None, :]
+        # Update-RMS clip is ONE scalar over the whole leaf (matching the
+        # replicated Adafactor), so sum over every axis before the psum.
+        sq_sum = lax.psum(jnp.sum(jnp.square(u) * row_mask[:, None]), ax)
+        n_elems = float(int(np.prod(lead, initial=1)) * n * m)
+        u = self._clip(u, sq_sum, n_elems)
+        step, new_mu = self._step_and_mu(u, mu, p.dtype)
+        p_loc = lax.dynamic_index_in_dim(to_blocks(p), idx, axis=L,
+                                         keepdims=False)
+        new_p_loc = p_loc - (alpha * step
+                             + (alpha * o.weight_decay * p_loc if dk
+                                else 0.0)).astype(p.dtype)
+        full = lax.all_gather(new_p_loc.astype(p.dtype), ax, axis=L)
+        full = full.reshape(lead + (n_loc * N, m))
+        new_p = full[..., :n, :].reshape(p.shape)
+        return new_p, new_vr, new_vc, v, new_mu
+
+    def _upd_flat(self, p, g, vr, vc, v, mu, dk, idx, beta2t, alpha):
+        o, ax, N = self.inner, self.axis_name, self.axis_size
+        chunk = self._chunk(p.size)
+        flat_g = jnp.pad(g.astype(jnp.float32).reshape(-1),
+                         (0, chunk * N - p.size))
+        g_loc = lax.psum_scatter(flat_g.reshape(N, chunk), ax,
+                                 scatter_dimension=0) / N
+        elem_mask = ((idx * chunk + jnp.arange(chunk)) < p.size
+                     ).astype(jnp.float32)
+        g2 = jnp.square(g_loc) + o.eps1
+        new_v = beta2t * v + (1 - beta2t) * g2
+        u = g_loc * lax.rsqrt(new_v)
+        sq_sum = lax.psum(jnp.sum(jnp.square(u) * elem_mask), ax)
+        u = self._clip(u, sq_sum, float(p.size))
+        step, new_mu = self._step_and_mu(u, mu, p.dtype)
+        flat_p = jnp.pad(p.reshape(-1), (0, chunk * N - p.size))
+        p_loc = lax.dynamic_slice_in_dim(flat_p, idx * chunk, chunk)
+        new_p_loc = p_loc - (alpha * step
+                             + (alpha * o.weight_decay * p_loc if dk
+                                else 0.0)).astype(p.dtype)
+        full = lax.all_gather(new_p_loc.astype(p.dtype), ax, tiled=True)
+        return full[:p.size].reshape(p.shape), vr, vc, new_v, new_mu
+
+
 class ZeRO3(_FlatLayout):
     """Fully-sharded parameters — FSDP / ZeRO stage 3 (part5).
 

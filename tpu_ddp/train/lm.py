@@ -152,6 +152,8 @@ class _MeshTrainer:
             # layout — so they restore at any dp size or as replicated.
             params = self.zero3.unshard_host(params)
             opt_state = self.zero3.canonicalize_opt_host(opt_state)
+        elif getattr(self, "opt_zero1", False):
+            opt_state = self.optimizer.canonicalize_opt_host(opt_state)
         tree = {"params": params, "opt_state": opt_state,
                 "step": np.int64(state.step)}
         if background:
@@ -180,6 +182,10 @@ class _MeshTrainer:
             params_t = self._params_template
             opt_t = jax.eval_shape(self.zero3.inner.init, params_t)
             shapes = {"params": params_t, "opt_state": opt_t}
+        elif getattr(self, "opt_zero1", False):
+            params_t = self._params_template  # built with the wrapper
+            opt_t = jax.eval_shape(self.optimizer.inner.init, params_t)
+            shapes = {"params": params_t, "opt_state": opt_t}
         else:
             shapes = jax.eval_shape(
                 lambda: (lambda s: {"params": s.params,
@@ -191,6 +197,8 @@ class _MeshTrainer:
         if getattr(self, "is_fsdp", False):
             params = self.zero3.shard_params(params)
             opt_state = self.zero3.flatten_opt(opt_state)
+        elif getattr(self, "opt_zero1", False):
+            opt_state = self.optimizer.flatten_opt(opt_state)
         placed = self._place_state(params, opt_state)
         return LMTrainState(params=placed.params,
                             opt_state=placed.opt_state,
@@ -210,6 +218,7 @@ class LMTrainer(_MeshTrainer):
     def __init__(self, model, mesh: Mesh, optimizer: AdamW | None = None,
                  moe_aux_coef: float = 0.01,
                  param_sharding: str = "replicated",
+                 opt_sharding: str = "replicated",
                  vocab_chunk: int = 0, sp_mode: str = "ring",
                  grad_accum: int = 1, dropout_seed: int = 0):
         self.mesh = mesh
@@ -261,6 +270,33 @@ class LMTrainer(_MeshTrainer):
         # All axes the batch (and therefore the loss) is sharded over.
         self._data_axes = (DATA_AXIS, SEQ_AXIS, EXPERT_AXIS)
         self.optimizer = optimizer or AdamW()
+        # ZeRO-1: optimizer state sharded 1/dp, reduce_scatter+all_gather
+        # in place of the gradient all-reduce (tpu_ddp/parallel/zero.py).
+        # Adafactor gets the row-sharded FactoredZeRO1 (its factored
+        # moments cannot ride ZeRO1's flat slices); elementwise
+        # optimizers (AdamW/SGD) the flat ZeRO1.
+        if opt_sharding not in ("replicated", "zero1"):
+            raise ValueError(f"unknown opt_sharding {opt_sharding!r}; "
+                             "choose 'replicated' or 'zero1'")
+        self.opt_zero1 = opt_sharding == "zero1"
+        if self.opt_zero1:
+            if self.is_fsdp:
+                raise ValueError(
+                    "opt_sharding='zero1' is redundant under "
+                    "param_sharding='fsdp' (ZeRO-3 already shards the "
+                    "optimizer state)")
+            if self.tp > 1 or self.ep > 1:
+                raise ValueError(
+                    "opt_sharding='zero1' shards over dp and does not "
+                    "compose with tensor (mp) or expert (ep) sharding; "
+                    "use dp x sp meshes")
+            from tpu_ddp.parallel.zero import FactoredZeRO1, ZeRO1
+            self._params_template = jax.eval_shape(
+                lambda: self.model.init(jax.random.key(0)))
+            wrapper = (FactoredZeRO1 if hasattr(self.optimizer, "_plan")
+                       else ZeRO1)
+            self.optimizer = wrapper(self.optimizer, DATA_AXIS, self.dp,
+                                     template=self._params_template)
         if self.is_fsdp:
             from tpu_ddp.parallel.zero import ZeRO3
             self._params_template = jax.eval_shape(
@@ -416,6 +452,15 @@ class LMTrainer(_MeshTrainer):
             grads = jax.tree.map(
                 lambda g: lax.pmean(g, SEQ_AXIS) / float(self.dp), grads)
             params, opt_state = self.zero3.apply(params, grads, opt_state)
+            return params, opt_state, local_mean.reshape(1, 1)
+
+        if self.opt_zero1:
+            # Mean over sp here (ep is 1 by construction); the ZeRO
+            # wrapper's psum_scatter performs the dp half of the sync
+            # and computes its own decay mask from the full leaves.
+            grads = jax.tree.map(lambda g: lax.pmean(g, SEQ_AXIS), grads)
+            params, opt_state = self.optimizer.apply(params, grads,
+                                                     opt_state)
             return params, opt_state, local_mean.reshape(1, 1)
 
         grads = self._sync_grads(grads)
