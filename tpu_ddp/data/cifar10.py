@@ -74,9 +74,12 @@ def _synthetic(split: str, n: int | None):
     if n is None:
         n = 50_000 if split == "train" else 10_000
         n = int(os.environ.get("TPU_DDP_SYNTH_SIZE", n))
-    rng = np.random.default_rng(0xC1FA8 + (0 if split == "train" else 1))
+    # Class signatures come from a split-INDEPENDENT seed: train and test
+    # must share them, or a model that learns the train classes scores
+    # chance (or worse) on test and convergence artifacts are garbage.
+    base = np.random.default_rng(0xC1FA8).normal(0, 40, size=(10, 1, 1, 3))
+    rng = np.random.default_rng(0xC1FA8 + (1 if split == "train" else 2))
     labels = rng.integers(0, 10, size=n).astype(np.int32)
-    base = rng.normal(0, 40, size=(10, 1, 1, 3))
     images = rng.normal(128, 50, size=(n, 32, 32, 3))
     images = np.clip(images + base[labels], 0, 255).astype(np.uint8)
     return images, labels
