@@ -56,18 +56,23 @@ class TestPipelineEquivalence:
         return (jax.device_get(state.params),
                 float(np.mean(np.asarray(loss))))
 
-    @pytest.mark.parametrize("dp,pp,tp,micro", [
-        (1, 2, 1, 2), (1, 4, 1, 4), (2, 2, 1, 2), (1, 2, 2, 2),
-        (1, 4, 1, 1),  # single microbatch: pure bubble, still exact
+    @pytest.mark.parametrize("dp,pp,tp,micro,schedule", [
+        (1, 2, 1, 2, "gpipe"), (1, 4, 1, 4, "gpipe"),
+        (2, 2, 1, 2, "gpipe"), (1, 2, 2, 2, "gpipe"),
+        (1, 4, 1, 1, "gpipe"),  # single microbatch: pure bubble, exact
+        (1, 2, 1, 4, "1f1b"), (1, 4, 1, 4, "1f1b"),
+        (2, 2, 1, 2, "1f1b"), (1, 2, 2, 2, "1f1b"),
+        (1, 2, 1, 1, "1f1b"),  # M < pp: drains correctly
     ])
-    def test_one_step_matches_dense(self, devices, dp, pp, tp, micro):
+    def test_one_step_matches_dense(self, devices, dp, pp, tp, micro,
+                                    schedule):
         tokens = _tokens()
         dense_p, dense_loss = self._dense_step(devices, tokens)
 
         model = _tiny()
         mesh = make_mesh(devices[:dp * pp * tp], dp=dp, sp=1, mp=tp, pp=pp)
         tr = PipelineLMTrainer(model, mesh, num_micro=micro,
-                               optimizer=_sgd())
+                               optimizer=_sgd(), schedule=schedule)
         state = tr.init_state(seed=7)
         x, y = tr.put_batch(*make_lm_batch(tokens))
         state, loss = tr.train_step(state, x, y)
@@ -105,6 +110,34 @@ class TestPipelineEquivalence:
         )["blocks"][0]["ln1"]["scale"])
         np.testing.assert_allclose(pipe_ln, dense_ln, rtol=1e-4,
                                    atol=1e-6)
+
+    def test_1f1b_matches_gpipe_with_dropout(self, devices):
+        """The two schedules draw IDENTICAL dropout masks (keys derive
+        from (microbatch, global layer), independent of the schedule), so
+        their one-step results must agree with dropout active."""
+        tokens = _tokens()
+        results = {}
+        for schedule in ("gpipe", "1f1b"):
+            model = _tiny(dropout_rate=0.3)
+            mesh = make_mesh(devices[:2], dp=1, sp=1, mp=1, pp=2)
+            tr = PipelineLMTrainer(model, mesh, num_micro=4,
+                                   optimizer=_sgd(), schedule=schedule,
+                                   dropout_seed=3)
+            state = tr.init_state(seed=7)
+            x, y = tr.put_batch(*make_lm_batch(tokens))
+            state, loss = tr.train_step(state, x, y)
+            results[schedule] = (float(np.mean(np.asarray(loss))),
+                                 jax.device_get(state.params))
+        assert abs(results["gpipe"][0] - results["1f1b"][0]) < 1e-4
+        for a, b in zip(jax.tree.leaves(results["gpipe"][1]),
+                        jax.tree.leaves(results["1f1b"][1])):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=3e-4, atol=3e-5)
+
+    def test_unknown_schedule_rejected(self, devices):
+        mesh = make_mesh(devices[:2], dp=1, sp=1, mp=1, pp=2)
+        with pytest.raises(ValueError, match="schedule"):
+            PipelineLMTrainer(_tiny(), mesh, schedule="interleaved")
 
     def test_multi_step_loss_decreases(self, devices):
         model = _tiny()

@@ -85,8 +85,56 @@ def pipeline_param_specs(model) -> dict:
             "head": base["head"], "blocks": blk}
 
 
+def _embed_micro(model, params, micro, rng, num_micro: int):
+    """(M, mb, L) token microbatches -> (M, mb, L, dm) embedded, with the
+    dense model's embedding dropout applied PER MICROBATCH: key =
+    fold(fold(rng, mb_index), num_layers) — the same derivation the dense
+    trunk uses (models/transformer.py:trunk_with_aux), so a given
+    microbatch's mask is independent of the pipeline geometry."""
+    x = params["embed"][micro].astype(model.compute_dtype)
+    if rng is not None and model.dropout_rate > 0.0:
+        keys = jax.vmap(
+            lambda i: jax.random.fold_in(jax.random.fold_in(rng, i),
+                                         model.num_layers)
+        )(jnp.arange(num_micro))
+        x = jax.vmap(model._dropout)(x, keys)
+    return x
+
+
+def _make_run_stage(model, blocks, pos, rng, pp_axis: str):
+    """This stage's layer slice as one function ``(x, mb_idx) -> y``,
+    scanned layer by layer. Dropout keys derive from (microbatch index,
+    GLOBAL layer index) — global = stage * layers_per_stage + local — so
+    every microbatch sees exactly the dense model's per-layer key
+    sequence regardless of how layers shard over stages (tested:
+    pp=1 == pp=2 gradients with dropout on). With ``remat_blocks`` each
+    layer recomputes in the backward pass — essential under GPipe, whose
+    T = M + pp - 1 ticks would otherwise stash every tick's activations.
+    """
+    layers_per_stage = jax.tree.leaves(blocks)[0].shape[0]
+    stage_base = lax.axis_index(pp_axis) * layers_per_stage
+
+    def run_stage(x, mb_idx):
+        def body(h, sl):
+            layer, local_i = sl
+            r = None
+            if rng is not None and model.dropout_rate > 0.0:
+                r = jax.random.fold_in(jax.random.fold_in(rng, mb_idx),
+                                       stage_base + local_i)
+            h, _ = model.block_apply_aux(layer, h, pos, r)
+            return h, None
+        if model.remat_blocks:
+            # prevent_cse=False: scan's loop structure already prevents
+            # the problematic CSE, so keep XLA free to fuse.
+            body = jax.checkpoint(body, prevent_cse=False)
+        h, _ = lax.scan(body, x, (blocks, jnp.arange(layers_per_stage)))
+        return h
+
+    return run_stage
+
+
 def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
-                  num_micro: int, pp_axis: str = PIPE_AXIS):
+                  num_micro: int, pp_axis: str = PIPE_AXIS, rng=None):
     """(masked_loss_sum, local_n) for this shard's (B, L) batch.
 
     Must run inside a shard_map over ``pp_axis`` with ``params["blocks"]``
@@ -94,6 +142,8 @@ def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
     summed token NLL on the LAST stage and exactly 0.0 elsewhere (so its
     gradient is confined to real compute); psum it over ``pp_axis`` to
     read the value. ``local_n`` is the token count (same on all stages).
+    ``rng`` activates dropout, keyed per (microbatch, global layer) so
+    masks are pipeline-geometry-independent.
     """
     B, L = inputs.shape
     if L > model.max_seq_len:
@@ -109,32 +159,21 @@ def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
     pos = jnp.arange(L)
 
     micro = inputs.reshape(M, mb, L)
-    x_embed = params["embed"][micro].astype(cd)      # (M, mb, L, dm)
-
-    def run_stage(x):
-        """This stage's layer slice, scanned layer by layer. With
-        ``remat_blocks`` each layer recomputes in the backward pass —
-        essential under GPipe, whose T = M + pp - 1 ticks would otherwise
-        stash every tick's activations."""
-        def body(h, layer):
-            return model.block_apply(layer, h, pos), None
-        if model.remat_blocks:
-            # prevent_cse=False: scan's loop structure already prevents
-            # the problematic CSE, so keep XLA free to fuse.
-            body = jax.checkpoint(body, prevent_cse=False)
-        h, _ = lax.scan(body, x, params["blocks"])
-        return h
+    x_embed = _embed_micro(model, params, micro, rng, M)  # (M, mb, L, dm)
+    run_stage = _make_run_stage(model, params["blocks"], pos, rng, pp_axis)
 
     perm = [(i, (i + 1) % S) for i in range(S)]
 
     def tick(carry, t):
         x_prev = carry
-        inj = lax.dynamic_index_in_dim(x_embed, jnp.minimum(t, M - 1), 0,
-                                       keepdims=False)
+        f = jnp.minimum(t, M - 1)
+        inj = lax.dynamic_index_in_dim(x_embed, f, 0, keepdims=False)
         # Stage 0's input comes from injection, later stages' from the
         # ring; the where-mask also zeroes embed grads on stages > 0.
         x_in = jnp.where(stage == 0, inj, x_prev)
-        x_out = run_stage(x_in)
+        # Microbatch resident on this stage at tick t is t - stage
+        # (clipped: out-of-range ticks compute masked garbage anyway).
+        x_out = run_stage(x_in, jnp.clip(t - stage, 0, M - 1))
         x_send = lax.ppermute(x_out, pp_axis, perm)
         return x_send, x_out
 
@@ -152,3 +191,166 @@ def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
     # other stages' loss AND, transposed, their head/ln_f gradients.
     is_last = (stage == S - 1).astype(nll.dtype)
     return jnp.sum(nll) * is_last, jnp.float32(nll.size)
+
+
+def pipeline_1f1b_grads(model, params, inputs, targets, *, pp_size: int,
+                        num_micro: int, pp_axis: str = PIPE_AXIS,
+                        rng=None):
+    """One-forward-one-backward schedule (PipeDream-flush / Megatron
+    1F1B; Narayanan et al., arXiv:2104.04473 — reimplemented from the
+    schedule description, not from any code), hand-scheduled because AD
+    of the GPipe scan pins the order to all-forwards-then-all-backwards.
+
+    Returns ``(masked_loss_sum, local_n, grads)`` for this shard's
+    (B, L) batch — same semantics as differentiating
+    :func:`pipeline_loss` (block grads stage-local; embed grads real on
+    stage 0, head/ln_f on the last stage, zeros elsewhere; caller scales
+    by its loss normalization and psums over ``pp_axis``).
+
+    Schedule, expressed SPMD: every tick every stage runs one forward
+    micro-step AND one backward micro-step (masked outside their valid
+    ranges). At tick t, stage s forwards microbatch ``f = t - s`` and
+    backwards ``b = t - 2(pp-1) + s``; activations ppermute down the
+    ring, cotangents ppermute up, and the last stage feeds each
+    microbatch's loss cotangent into the backward stream the same tick
+    its forward completes. T = M + 2(pp-1) ticks total.
+
+    Why it exists: the GPipe path's forward scan materializes one
+    boundary activation per tick plus the full embedded batch — O(M)
+    microbatches resident. Here a stage keeps at most ``2*pp - 1`` saved
+    inputs (the ring buffer below), the backward recomputes the stage
+    forward under ``jax.vjp`` from the saved input (same trade as
+    ``remat_blocks``), and embeddings are computed per tick — so
+    activation residency is O(pp), independent of M. Gradients are
+    bit-comparable to the GPipe path (tested: tests/test_pipeline.py).
+    """
+    B, L = inputs.shape
+    if L > model.max_seq_len:
+        raise ValueError(f"sequence length {L} exceeds "
+                         f"max_seq_len={model.max_seq_len}")
+    if B % num_micro:
+        raise ValueError(f"local batch {B} not divisible by "
+                         f"num_micro={num_micro}")
+    mb = B // num_micro
+    S, M = pp_size, num_micro
+    cd = model.compute_dtype
+    stage = lax.axis_index(pp_axis)
+    pos = jnp.arange(L)
+    K = 2 * S - 1  # ring-buffer slots: max fwd->bwd gap is 2(S-1) ticks
+
+    micro = inputs.reshape(M, mb, L)
+    tmicro = targets.reshape(M, mb, L)
+    run_stage = _make_run_stage(model, params["blocks"], pos, rng, pp_axis)
+
+    def embed_mb(table, mb_idx):
+        """Embedding (+ the dense model's embedding dropout) for ONE
+        microbatch — computed per tick, never materialized for all M."""
+        toks = lax.dynamic_index_in_dim(micro, mb_idx, 0, keepdims=False)
+        x = table[toks].astype(cd)
+        if rng is not None and model.dropout_rate > 0.0:
+            k = jax.random.fold_in(jax.random.fold_in(rng, mb_idx),
+                                   model.num_layers)
+            x = model._dropout(x, k)
+        return x
+
+    def head_loss(hp, y, tgt):
+        """Summed token NLL of one microbatch through ln_f + head."""
+        from tpu_ddp.ops.loss import softmax_cross_entropy
+        logits = model.head_apply(hp, y)
+        nll = softmax_cross_entropy(
+            logits.reshape(-1, logits.shape[-1]), tgt.reshape(-1))
+        return jnp.sum(nll)
+
+    head_params = {"ln_f": params["ln_f"], "head": params["head"]}
+    perm_down = [(i, (i + 1) % S) for i in range(S)]
+    perm_up = [(i, (i - 1) % S) for i in range(S)]
+
+    def run_stage_with(blocks, x, mb_idx):
+        """run_stage over EXPLICIT blocks — the vjp target (gradients
+        w.r.t. the stage's layer slice flow through this)."""
+        return _make_run_stage(model, blocks, pos, rng, pp_axis)(x, mb_idx)
+
+    def masked_add(acc, g, valid):
+        return jax.tree.map(
+            lambda a, gg: a + jnp.where(valid, gg, 0).astype(a.dtype),
+            acc, g)
+
+    def tick(carry, t):
+        fwd_in, bwd_in, buf, g_blk, g_emb, g_head, loss_sum = carry
+        f = t - stage
+        b = t - 2 * (S - 1) + stage
+        f_valid = (0 <= f) & (f < M)
+        b_valid = (0 <= b) & (b < M)
+        f_safe = jnp.clip(f, 0, M - 1)
+        b_safe = jnp.clip(b, 0, M - 1)
+
+        # ---- forward micro-step: embed-inject at stage 0, ring above.
+        x_in = jnp.where(stage == 0, embed_mb(params["embed"], f_safe),
+                         fwd_in)
+        y = run_stage(x_in, f_safe)
+        buf = jnp.where(f_valid,
+                        lax.dynamic_update_index_in_dim(
+                            buf, x_in, f_safe % K, 0),
+                        buf)
+
+        # ---- loss + its cotangent at the last stage (same tick: the
+        # last stage's backward microbatch b equals its forward f).
+        tgt = lax.dynamic_index_in_dim(tmicro, f_safe, 0, keepdims=False)
+        nll_sum, head_vjp = jax.vjp(
+            lambda hp, yy: head_loss(hp, yy, tgt), head_params, y)
+        d_hp, dy_head = head_vjp(jnp.float32(1.0))
+        at_last = stage == S - 1
+        loss_sum = loss_sum + jnp.where(at_last & f_valid, nll_sum, 0.0)
+        g_head = masked_add(g_head, d_hp, at_last & f_valid)
+
+        # ---- backward micro-step: recompute-vjp from the saved input.
+        x_saved = lax.dynamic_index_in_dim(buf, b_safe % K, 0,
+                                           keepdims=False)
+        d_in = jnp.where(at_last, dy_head.astype(cd), bwd_in)
+        _, stage_vjp = jax.vjp(
+            lambda blk, xx: run_stage_with(blk, xx, b_safe),
+            params["blocks"], x_saved)
+        d_blk, dx = stage_vjp(d_in)
+        g_blk = masked_add(g_blk, d_blk, b_valid)
+
+        # Embed grad at stage 0 (dx there is d(embed output) of mb b):
+        # scatter-add straight into the carried accumulator — touches
+        # only the mb*L indexed rows per tick. A jax.vjp of the gather
+        # would materialize a dense (V, dm) cotangent and a full-table
+        # add EVERY tick on EVERY stage, dominating the step at real
+        # vocab sizes. Dropout's backward is recomputed from its key
+        # (where(mask, dx/keep, 0) — the transpose of _dropout).
+        toks_b = lax.dynamic_index_in_dim(micro, b_safe, 0,
+                                          keepdims=False)
+        dxe = dx.astype(jnp.float32)
+        if rng is not None and model.dropout_rate > 0.0:
+            k = jax.random.fold_in(jax.random.fold_in(rng, b_safe),
+                                   model.num_layers)
+            keep = 1.0 - model.dropout_rate
+            mask = jax.random.bernoulli(k, keep, dx.shape)
+            dxe = jnp.where(mask, dxe / keep, 0.0)
+        contrib = jnp.where(b_valid & (stage == 0), dxe, 0.0)
+        g_emb = g_emb.at[toks_b.reshape(-1)].add(
+            contrib.reshape(-1, contrib.shape[-1]))
+
+        return ((lax.ppermute(y, pp_axis, perm_down),
+                 lax.ppermute(dx, pp_axis, perm_up),
+                 buf, g_blk, g_emb, g_head, loss_sum), None)
+
+    zeros_f32 = lambda tree: jax.tree.map(  # noqa: E731
+        lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+    carry0 = (
+        jnp.zeros((mb, L, model.d_model), cd),       # fwd ring
+        jnp.zeros((mb, L, model.d_model), cd),       # bwd ring
+        jnp.zeros((K, mb, L, model.d_model), cd),    # saved inputs
+        zeros_f32(params["blocks"]),
+        zeros_f32(params["embed"]),
+        zeros_f32(head_params),
+        jnp.float32(0.0),
+    )
+    (_, _, _, g_blk, g_emb, g_head, loss_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(M + 2 * (S - 1)))
+
+    grads = {"embed": g_emb, "ln_f": g_head["ln_f"],
+             "head": g_head["head"], "blocks": g_blk}
+    return loss_sum, jnp.float32(B * L), grads

@@ -290,10 +290,17 @@ class LMTrainer(_MeshTrainer):
                     "opt_sharding='zero1' shards over dp and does not "
                     "compose with tensor (mp) or expert (ep) sharding; "
                     "use dp x sp meshes")
+            from tpu_ddp.ops.optim import Adafactor
             from tpu_ddp.parallel.zero import FactoredZeRO1, ZeRO1
             self._params_template = jax.eval_shape(
                 lambda: self.model.init(jax.random.key(0)))
-            wrapper = (FactoredZeRO1 if hasattr(self.optimizer, "_plan")
+            # Explicit type dispatch: Adafactor's factored state needs
+            # the row-sharded wrapper; everything elementwise (AdamW,
+            # SGD) takes the flat one. An unknown factored optimizer
+            # fails loudly in ZeRO1's map_param_like rather than being
+            # silently re-laid-out wrong.
+            wrapper = (FactoredZeRO1 if isinstance(self.optimizer,
+                                                   Adafactor)
                        else ZeRO1)
             self.optimizer = wrapper(self.optimizer, DATA_AXIS, self.dp,
                                      template=self._params_template)
@@ -493,13 +500,16 @@ class PipelineLMTrainer(_MeshTrainer):
     The layer stack shards into ``pp`` stages (stacked block params,
     tpu_ddp/parallel/pipeline.py); each dp slice's batch is split into
     ``num_micro`` microbatches that stream through the stage ring.
-    Composes with tensor parallelism (mp > 1); sequence parallelism under
-    the pipeline is not supported (ring attention would rotate K/V inside
-    every pipeline tick — a composition this engine does not schedule).
+    Composes with tensor parallelism (mp > 1) and dropout (keys derive
+    from (microbatch, global layer), so masks are pipeline-geometry-
+    independent); sequence parallelism under the pipeline is not
+    supported (ring attention would rotate K/V inside every pipeline
+    tick — a composition this engine does not schedule).
     """
 
     def __init__(self, model, mesh: Mesh, num_micro: int | None = None,
-                 optimizer: AdamW | None = None):
+                 optimizer: AdamW | None = None, dropout_seed: int = 0,
+                 schedule: str = "gpipe"):
         from tpu_ddp.parallel.pipeline import pipeline_param_specs
         self.mesh = mesh
         self.dp = mesh.shape[DATA_AXIS]
@@ -516,16 +526,23 @@ class PipelineLMTrainer(_MeshTrainer):
         if model.num_layers % self.pp:
             raise ValueError(f"num_layers={model.num_layers} not "
                              f"divisible by pp={self.pp}")
-        if model.dropout_rate > 0:
-            raise ValueError(
-                "PipelineLMTrainer does not thread dropout keys through "
-                "the microbatch schedule; use dropout_rate=0 here (the "
-                "dp/sp/tp/ep engine, LMTrainer, supports dropout)")
         if self.tp > 1:
             model = model.with_tensor_parallel(MODEL_AXIS, self.tp)
         self.model = model
         self.num_micro = num_micro if num_micro is not None else self.pp
         self.optimizer = optimizer or AdamW()
+        # "gpipe": all-forwards-then-all-backwards via AD of the tick
+        # scan — activation residency O(num_micro). "1f1b": hand-
+        # scheduled one-forward-one-backward with recompute-vjp —
+        # residency O(pp), the long-batch memory lever
+        # (tpu_ddp/parallel/pipeline.py:pipeline_1f1b_grads).
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown schedule {schedule!r}; "
+                             "choose 'gpipe' or '1f1b'")
+        self.schedule = schedule
+        # Per-step dropout keys: seed + step, folded host-side like the
+        # LMTrainer's (resume-exact); inert when dropout_rate == 0.
+        self._dropout_key = jax.random.key(dropout_seed)
         self._param_specs = pipeline_param_specs(model)
         self._opt_specs = self.optimizer.state_specs(self._param_specs)
         self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
@@ -559,22 +576,50 @@ class PipelineLMTrainer(_MeshTrainer):
             return lax.pmean(lax.psum(g, PIPE_AXIS), DATA_AXIS)
         return jax.tree.map(leaf, grads, self._param_specs)
 
-    def _base_step(self, params, opt_state, inputs, targets):
-        from tpu_ddp.parallel.pipeline import pipeline_loss
+    def _extra_in_specs(self) -> tuple:
+        return (P(),)  # dropout key: replicated on every shard
 
-        def loss_fn(p):
-            masked_sum, local_n = pipeline_loss(
-                self.model, p, inputs, targets, pp_size=self.pp,
-                num_micro=self.num_micro)
+    def _extra_args(self, state) -> tuple:
+        return (jax.random.fold_in(self._dropout_key, state.step),)
+
+    def _decorrelate_rng(self, rng):
+        """Distinct dropout keys per dp shard (different tokens); the
+        SAME key across pp stages — a microbatch's (mb, layer) mask
+        derivation must agree on whichever stage runs that layer — and
+        across mp shards (replicated residual stream)."""
+        if self.model.dropout_rate <= 0.0:
+            return None
+        return jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+
+    def _base_step(self, params, opt_state, inputs, targets, rng):
+        from tpu_ddp.parallel.pipeline import (pipeline_1f1b_grads,
+                                               pipeline_loss)
+
+        rng = self._decorrelate_rng(rng)
+
+        if self.schedule == "1f1b":
+            masked_sum, local_n, grads = pipeline_1f1b_grads(
+                self.model, params, inputs, targets, pp_size=self.pp,
+                num_micro=self.num_micro, rng=rng)
             total = lax.psum(local_n, DATA_AXIS)
             n_dp = lax.psum(1.0, DATA_AXIS)
-            # Scale so pmean-over-dp of grads == grad of the global token
-            # mean; masked_sum is nonzero on the last stage only and the
-            # pp-psum in _sync_grads completes the sum.
-            return n_dp * masked_sum / total, masked_sum / local_n
+            # Same normalization the gpipe loss_fn differentiates.
+            grads = jax.tree.map(lambda g: g * (n_dp / total), grads)
+            local_mean = masked_sum / local_n
+        else:
+            def loss_fn(p):
+                masked_sum, local_n = pipeline_loss(
+                    self.model, p, inputs, targets, pp_size=self.pp,
+                    num_micro=self.num_micro, rng=rng)
+                total = lax.psum(local_n, DATA_AXIS)
+                n_dp = lax.psum(1.0, DATA_AXIS)
+                # Scale so pmean-over-dp of grads == grad of the global
+                # token mean; masked_sum is nonzero on the last stage
+                # only and the pp-psum in _sync_grads completes the sum.
+                return n_dp * masked_sum / total, masked_sum / local_n
 
-        (_, local_mean), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
+            (_, local_mean), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
         grads = self._sync_grads(grads)
         params, opt_state = self.optimizer.apply(
             params, grads, opt_state, decay_mask=self._decay_mask(params))
