@@ -488,6 +488,30 @@ class Trainer:
         # part1/main.py:108) + top-1 correct count.
         return cross_entropy_loss(logits, labels), top1_correct(logits, labels)
 
+    def _build_sharded_eval(self):
+        """Test batch sharded over dp, per-shard sums psum'd — N x less
+        eval compute per device than the reference's every-node-evaluates-
+        everything semantics (part2/part2b/main.py:89-93), with metrics
+        identical to the replicated pass (weighted sums reduce to the
+        same totals regardless of the split; wrap-padding rows carry
+        weight 0). Opt-in via ``evaluate(..., sharded=True)``."""
+        def body(params, images, labels, weights):
+            logits = self.model.apply(params, self._maybe_normalize(images))
+            per_ex = softmax_cross_entropy(logits, labels)
+            loss_sum = lax.psum(jnp.sum(weights * per_ex), DATA_AXIS)
+            correct = lax.psum(
+                jnp.sum(weights * (jnp.argmax(logits, axis=-1) == labels)),
+                DATA_AXIS)
+            return loss_sum.reshape(1), correct.reshape(1)
+
+        # Params arrive REPLICATED (evaluate() materializes FSDP's flat
+        # shards first), so one body serves every strategy.
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            check_vma=False))
+
     def _materialize_params(self, params):
         """FSDP: reassemble the flat dp shards into full replicated
         leaves for evaluation (XLA inserts the gather); identity for all
@@ -509,16 +533,50 @@ class Trainer:
         state: TrainState,
         batches,
         log: Callable[[str], None] = print,
+        sharded: bool = False,
     ) -> dict:
-        """Full test-set pass. Like the reference, the test set is NOT
-        sharded — every node evaluates the full set redundantly
-        (part2/part2b/main.py:89-93; SURVEY.md §3.4)."""
+        """Full test-set pass. By default, like the reference, the test
+        set is NOT sharded — every node evaluates the full set redundantly
+        (part2/part2b/main.py:89-93; SURVEY.md §3.4). ``sharded=True``
+        (mesh required) splits each test batch over dp with psum'd
+        loss/correct sums — 1/N the per-device compute, metrics identical
+        for per-example models (tested in tests/test_engine.py). Caveat:
+        batch-statistics BatchNorm (the VGG family's reference-faithful
+        semantic, part1/model.py:24) computes its statistics over the
+        SHARD under sharded eval, so its metrics shift slightly — the
+        same per-replica-stats property the reference's report accepts
+        for distributed training (report §3.2)."""
         total_loss = 0.0
         correct = 0
         seen = 0
         n_batches = 0
+        use_sharded = sharded and self.mesh is not None
+        if use_sharded and jax.process_count() > 1:
+            # The eval loader contract feeds EVERY process the full test
+            # set (reference part2/part2b/main.py:89-93); sharded eval
+            # would assemble each example process_count times and psum
+            # them all — metrics inflated by P. Refuse loudly rather
+            # than report >100% accuracy.
+            raise ValueError(
+                "evaluate(sharded=True) is single-process only: the "
+                "unsharded test loader gives every process the full set, "
+                "which the dp-psum would double-count. Use the default "
+                "replicated eval in multi-process runs.")
+        if use_sharded and not hasattr(self, "_sharded_eval"):
+            self._sharded_eval = self._build_sharded_eval()
         eval_params = self._materialize_params(state.params)
         for images, labels in batches:
+            if use_sharded:
+                xb, yb, wb = self.put_batch(images, labels)
+                loss_sum, corr = self._sharded_eval(eval_params, xb, yb,
+                                                    wb)
+                n = len(labels)
+                total_loss += float(np.ravel(np.asarray(loss_sum))[0]) / n
+                correct += int(round(float(
+                    np.ravel(np.asarray(corr))[0])))
+                seen += n
+                n_batches += 1
+                continue
             if self.mesh is not None:
                 images = jax.device_put(images, self._repl_sharding)
                 labels = jax.device_put(labels, self._repl_sharding)
