@@ -241,6 +241,12 @@ class Adafactor:
           min_dim_size_to_factor), so view the leaf as the 2-D matrix
           (prod(shape[:k]), prod(shape[k:])) picking the contiguous
           split k that qualifies with minimal vr+vc memory.
+
+        State-layout note: leaves that the pre-split rule left unfactored
+        (full ``v``) may now factor, changing their state shapes — a
+        checkpoint from the old layout fails restore's shape check loudly
+        (utils/checkpoint.py raises on any leaf mismatch); re-initialize
+        the optimizer state for such checkpoints.
         """
         if (len(shape) >= 2
                 and min(shape[-2:]) >= self.min_dim_size_to_factor):
