@@ -1,0 +1,75 @@
+"""Convergence acceptance: every ladder rung LEARNS.
+
+The reference validates learning statistically (loss/accuracy after one
+epoch, report Table 1 — quoted in BASELINE.md); the full-epoch analogue
+here is the committed artifact experiments/results_convergence.json
+(produced on the real chip by scripts/run_experiments.py). This test is
+the CI-sized guard: a short run on the class-conditional synthetic
+stand-in must push the training loss well below its ~2.3 starting point,
+and the rungs must agree with each other — a regression in any rung's
+update math shows up as a loss that stays put or diverges from the
+others.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.data.cifar10 import load_cifar10, normalize
+from tpu_ddp.models.vgg import VGGModel
+from tpu_ddp.parallel.mesh import make_mesh
+from tpu_ddp.train.engine import Trainer
+from tpu_ddp.utils.config import TrainConfig
+
+
+def _batches(n_iters=12, bs=16):
+    images, labels, meta = load_cifar10(split="train",
+                                        synthetic_size=n_iters * bs)
+    assert meta["synthetic"] is True  # this guard targets the stand-in
+    x = normalize(images)
+    return [(x[i * bs:(i + 1) * bs], labels[i * bs:(i + 1) * bs])
+            for i in range(n_iters)]
+
+
+def _final_window_loss(trainer, batches):
+    state = trainer.init_state()
+    losses = []
+    for bx, by in batches:
+        state, loss = trainer.train_step(state, *trainer.put_batch(bx, by))
+        losses.append(float(np.mean(np.asarray(loss))))
+    assert all(np.isfinite(losses)), losses
+    return float(np.mean(losses[-3:]))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("strategy", ["none", "gather_scatter",
+                                      "all_reduce", "fused", "zero",
+                                      "fsdp"])
+def test_rung_loss_falls(devices, strategy):
+    # A slimmer VGG plan keeps this CPU-affordable while exercising the
+    # real conv/BN/pool stack and every sync strategy's update math.
+    model = VGGModel(name="slim", cfg=(8, "M", 8, "M", 16, "M", 16, "M", 32, "M"),
+                     compute_dtype=jnp.float32)
+    mesh = None if strategy == "none" else make_mesh(devices[:2])
+    trainer = Trainer(model, TrainConfig(), strategy=strategy, mesh=mesh)
+    final = _final_window_loss(trainer, _batches())
+    # Start is ~ln(10)=2.3 (and the first augmented iterations overshoot
+    # it); a no-learning regression hovers there, while a healthy run
+    # reaches ~1.9 within 12 iterations on the 2-device mesh.
+    assert final < 2.0, f"{strategy}: final-window loss {final:.3f}"
+
+
+@pytest.mark.slow
+def test_rungs_agree(devices):
+    """The distributed rungs share exact update math at a fixed world
+    size — their loss trajectories must coincide tightly."""
+    model = VGGModel(name="slim", cfg=(8, "M", 8, "M", 16, "M", 16, "M", 32, "M"),
+                     compute_dtype=jnp.float32)
+    batches = _batches()
+    finals = {}
+    for strategy in ("all_reduce", "fused", "zero", "fsdp"):
+        trainer = Trainer(model, TrainConfig(), strategy=strategy,
+                          mesh=make_mesh(devices[:2]))
+        finals[strategy] = _final_window_loss(trainer, batches)
+    spread = max(finals.values()) - min(finals.values())
+    assert spread < 1e-2, finals

@@ -94,6 +94,15 @@ def bench_collectives(mesh: Mesh, mb: float = 4.0, iters: int = 10,
 def main(argv=None) -> int:
     import argparse
     import json
+    import os
+
+    # Some environments pre-import jax via a site hook that overrides
+    # the platform list; re-assert the user's JAX_PLATFORMS so
+    # `JAX_PLATFORMS=cpu python -m tpu_ddp.utils.collectives` behaves as
+    # documented (same pattern as parts/common.py).
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
 
     from tpu_ddp.parallel.mesh import make_mesh
 
