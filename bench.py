@@ -74,6 +74,14 @@ def _chained_avg_s(step, state, staged, timed_iters: int):
 
     state, loss = step(state, *staged[0])
     np.asarray(loss)  # warm-up barrier (iteration 0, discarded)
+    # Settle: the first post-compile executions can carry a one-time
+    # runtime transient (measured ~100ms once on the tunneled backend —
+    # program upload/initialization); a short discarded burst keeps it
+    # out of the steady-state window, in the spirit of the reference's
+    # discarded iteration 0 (part1/main.py:86-91).
+    for i in range(3):
+        state, loss = step(state, *staged[i % len(staged)])
+    np.asarray(loss)
     t0 = time.perf_counter()
     for i in range(timed_iters):
         state, loss = step(state, *staged[i % len(staged)])
@@ -150,8 +158,10 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
         fwd = F.resnet_fwd_flops(RESNET_CFG[cfg.model], side,
                                  batch_size, cfg.num_classes,
                                  small_inputs=side <= 64)
+    elif hasattr(model, "num_patches"):
+        fwd = F.vit_fwd_flops(model, batch_size)
     else:
-        fwd = None  # ViT etc.: XLA cost analysis only
+        fwd = None  # unknown family: XLA cost analysis only
     # xla cost analysis forces a fresh AOT compile — worth it once per
     # config as the cross-check, skipped for repeat runs (batch sweep).
     mfu = _mfu_block(

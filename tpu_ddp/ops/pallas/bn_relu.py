@@ -23,6 +23,14 @@ multiple of 128 does the code fall back to zero-padding the channel axis.
 Rows are chunked over a 1-D grid (grid steps are sequential on TPU, so
 per-channel accumulators live in a (1, 128·m) output block shared by all
 steps).
+
+Measured verdict (TPU v5e, VGG-11 train step): XLA's own conv+BN+ReLU
+fusion BEATS this kernel — 25.3 ms vs 66.0 ms per step at batch 2048
+(8.1 vs 11.1 ms at 256) — because XLA fuses the normalize+ReLU into the
+surrounding convolution epilogues while a custom kernel forces the
+activation through VMEM as a separate pass. The kernel stays as an
+opt-in (``TPU_DDP_PALLAS_BN=1``) reference implementation and a Pallas
+pattern exemplar; the default path is the right one.
 """
 
 from __future__ import annotations

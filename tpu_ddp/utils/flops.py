@@ -138,6 +138,20 @@ def transformer_fwd_flops(model, batch: int, seq_len: int) -> int:
     return tokens * (2 * matmul_params + attn)
 
 
+def vit_fwd_flops(model, batch: int) -> int:
+    """Forward FLOPs for one ViT classifier step (models/vit.py): patch
+    embed + encoder blocks (full-L² attention convention) + GAP head."""
+    n = model.num_patches
+    dm, dff = model.d_model, model.d_ff
+    patch_in = model.patch_size ** 2 * model.in_channels
+    per_image = 2 * n * patch_in * dm                    # patch embed GEMM
+    per_layer = 2 * n * (4 * dm * dm + 2 * dm * dff)     # qkv+o, mlp
+    per_layer += 4 * n * n * dm                          # QK^T + AV
+    per_image += model.num_layers * per_layer
+    per_image += 2 * dm * model.num_classes
+    return per_image * batch
+
+
 def train_flops(fwd_flops: int) -> int:
     return TRAIN_FLOPS_MULT * fwd_flops
 
