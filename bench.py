@@ -137,6 +137,35 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
     avg_s, state = _chained_avg_s(trainer.train_step, state, staged,
                                   timed_iters)
 
+    # Multi-step dispatch (headline config only): one jitted lax.scan
+    # over 16 full optimizer steps amortizes per-dispatch overhead — the
+    # TPU-first way to run a dispatch-bound small model
+    # (Trainer.build_multi_step; scan-of-k == k sequential steps,
+    # tested). Recorded alongside, not as the headline, to keep the
+    # headline protocol comparable across rounds.
+    multi_step = None
+    if config == "vgg11_cifar10" and timed_iters >= 16:
+        k = 16
+        multi = trainer.build_multi_step(k)
+        xs = np.stack([h[0] for h in host] * (k // len(host)))
+        ys = np.stack([h[1] for h in host] * (k // len(host)))
+        staged_k = trainer.put_batches(xs, ys)
+        state, losses = multi(state, *staged_k)
+        np.asarray(losses)  # compile + warm
+        state, losses = multi(state, *staged_k)
+        np.asarray(losses)  # settle
+        t0 = time.perf_counter()
+        n_calls = 4
+        for _ in range(n_calls):
+            state, losses = multi(state, *staged_k)
+        np.asarray(losses)
+        per_step = (time.perf_counter() - t0) / (n_calls * k)
+        multi_step = {
+            "steps_per_call": k,
+            "avg_iter_s": round(per_step, 6),
+            "images_per_sec": round(batch_size / per_step, 1),
+        }
+
     # End-to-end per-iteration protocol (host->device transfer + step +
     # loss readback each iteration — the reference loop's exact shape,
     # part1/main.py:65-84): recorded for the record; over a tunneled
@@ -181,6 +210,7 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
         "vs_baseline": round(imgs_per_sec / 386.0, 2) if headline else None,
         "extra": {
             "avg_iter_s": round(avg_s, 6),
+            **({"multi_step": multi_step} if multi_step else {}),
             "end_to_end_iter_s": round(e2e.average_s, 6),
             "batch_size": batch_size,
             "timed_iters": timed_iters,

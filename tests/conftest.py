@@ -21,6 +21,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: every Trainer/LMTrainer instance
+# builds fresh closures, so the in-process jit cache never hits across
+# tests even for identical programs — but the persistent cache keys on
+# the HLO itself, so recompiles of the same tiny-model steps become
+# cache loads (big wall-clock lever on the 1-core CI host; the cache
+# survives across runs in TPU_DDP_TEST_CACHE or /tmp).
+_cache_dir = os.environ.get("TPU_DDP_TEST_CACHE",
+                            "/tmp/tpu_ddp_jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import pytest  # noqa: E402
 
 

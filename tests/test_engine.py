@@ -166,3 +166,54 @@ class TestShardedEval:
         assert shrd["correct"] == repl["correct"]
         np.testing.assert_allclose(shrd["test_loss"], repl["test_loss"],
                                    rtol=1e-5)
+
+
+class TestMultiStep:
+    """build_multi_step: K scanned steps == K sequential train_steps."""
+
+    def test_scan_matches_sequential(self, devices):
+        from tpu_ddp.parallel.mesh import make_mesh
+        import jax
+
+        model = _PerExampleModel()
+        batches = separable_batches(n_batches=4, bs=16, seed=7)
+
+        def run_sequential():
+            tr = Trainer(model, TrainConfig(), strategy="fused",
+                         mesh=make_mesh(devices[:2]))
+            state = tr.init_state()
+            losses = []
+            for bx, by in batches:
+                state, loss = tr.train_step(state, *tr.put_batch(bx, by))
+                losses.append(np.ravel(np.asarray(loss)))
+            return jax.device_get(state.params), np.stack(losses)
+
+        def run_scanned():
+            tr = Trainer(model, TrainConfig(), strategy="fused",
+                         mesh=make_mesh(devices[:2]))
+            state = tr.init_state()
+            multi = tr.build_multi_step(4)
+            xs = np.stack([b[0] for b in batches])
+            ys = np.stack([b[1] for b in batches])
+            state, losses = multi(state, *tr.put_batches(xs, ys))
+            return jax.device_get(state.params), np.asarray(losses)
+
+        p_seq, l_seq = run_sequential()
+        p_scan, l_scan = run_scanned()
+        np.testing.assert_allclose(l_scan, l_seq, rtol=1e-6, atol=1e-7)
+        import jax as _jax
+        for a, b in zip(_jax.tree.leaves(p_seq), _jax.tree.leaves(p_scan)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_single_device_and_validation(self):
+        tr = tiny_trainer()
+        with np.testing.assert_raises(ValueError):
+            tr.build_multi_step(0)
+        batches = separable_batches(n_batches=2, bs=8, seed=1)
+        xs = np.stack([b[0] for b in batches])
+        ys = np.stack([b[1] for b in batches])
+        multi = tr.build_multi_step(2)
+        state, losses = multi(tr.init_state(), *tr.put_batches(xs, ys))
+        assert losses.shape == (2,)
+        assert np.isfinite(np.asarray(losses)).all()

@@ -325,6 +325,85 @@ class Trainer:
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
+    def build_multi_step(self, k: int):
+        """Compile a K-steps-per-dispatch train call: ``fn(state, xs,
+        ys, ws) -> (state, losses)`` where the batch arrays carry a
+        leading ``k`` axis and ``losses`` stacks the per-step losses.
+
+        The TPU-first lever for small models: one ``lax.scan`` over K
+        full optimizer steps amortizes per-call dispatch/host overhead
+        K-fold (a VGG-11/CIFAR step at batch 256 is dispatch-bound on a
+        single chip — measured ~6 ms dispatch vs ~3 ms compute). Each
+        scanned step is bit-identical to :meth:`train_step`'s body
+        (tested in tests/test_engine.py); the reference has no
+        counterpart (its loop is host-driven by construction,
+        part1/main.py:65-77).
+        """
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+
+        def scan_body(params, opt_state, xs, ys, ws):
+            def step(carry, xyw):
+                p, o = carry
+                p, o, loss = self._base_step(p, o, *xyw)
+                return (p, o), loss
+
+            (params, opt_state), losses = lax.scan(
+                step, (params, opt_state), (xs, ys, ws))
+            return params, opt_state, losses
+
+        if self.mesh is None:
+            fn = jax.jit(scan_body, donate_argnums=(0, 1))
+        else:
+            def sharded_body(params, opt_state, xs, ys, ws):
+                params, opt_state, losses = scan_body(
+                    params, opt_state, xs, ys, ws)
+                return params, opt_state, losses.reshape(k, 1)
+
+            b = P(None, DATA_AXIS)
+            mapped = jax.shard_map(
+                sharded_body, mesh=self.mesh,
+                in_specs=(self._param_spec(), self._opt_spec(), b, b, b),
+                out_specs=(self._param_spec(), self._opt_spec(), b),
+                check_vma=False)
+            fn = jax.jit(mapped, donate_argnums=(0, 1))
+
+        def run(state: TrainState, xs, ys, ws=None):
+            if ws is None:
+                ws = jnp.ones(xs.shape[:2], jnp.float32)
+            params, opt_state, losses = fn(state.params, state.opt_state,
+                                           xs, ys, ws)
+            return TrainState(params, opt_state, state.step + k), losses
+
+        return run
+
+    def put_batches(self, images_k, labels_k):
+        """Stage K batches for :meth:`build_multi_step`: (k, B, ...)
+        host arrays -> device arrays with the batch axis sharded over dp
+        (k is a leading scan axis, replicated)."""
+        images_k = np.asarray(images_k)
+        labels_k = np.asarray(labels_k)
+        weights_k = np.ones(labels_k.shape, np.float32)
+        if self.mesh is not None:
+            # Input is this PROCESS's shard of each per-step batch (the
+            # put_batch contract); check divisibility against the local
+            # slot count, as put_batch does. No wrap-padding here: the
+            # scan axis makes ragged-final-batch handling ambiguous —
+            # feed the ragged tail through train_step instead.
+            n_slots = self.mesh.shape[DATA_AXIS]
+            local_slots = max(n_slots // max(jax.process_count(), 1), 1)
+            if labels_k.shape[1] % local_slots:
+                raise ValueError(
+                    f"per-process per-step batch {labels_k.shape[1]} "
+                    f"not divisible by local dp slots {local_slots}")
+        if self.mesh is None:
+            return (jnp.asarray(images_k), jnp.asarray(labels_k),
+                    jnp.asarray(weights_k))
+        from tpu_ddp.parallel.mesh import put_sharded
+        sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        return (put_sharded(images_k, sh), put_sharded(labels_k, sh),
+                put_sharded(weights_k, sh))
+
     def train_step(self, state: TrainState, images, labels,
                    weights=None) -> tuple:
         """One optimization step; returns (state, loss).
