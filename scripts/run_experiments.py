@@ -235,7 +235,7 @@ def render(out_path: Path | None = None) -> str:
             "semantic correctness at scale, not network speedup — the "
             "reference's figures 2-4 shapes (gather/scatter degrading past "
             "3 workers, all-reduce plateauing, DDP monotone) arise from "
-            "real NIC contention that a one-core house cannot reproduce. "
+            "real NIC contention that a one-core host cannot reproduce. "
             "On real multi-chip hardware the same commands produce the "
             "real curve.",
             "",
