@@ -269,8 +269,9 @@ def render(out_path: Path | None = None) -> str:
             "so neither cross-world nor cross-strategy loss agreement "
             "is meaningful HERE: per-update strategy equivalence is "
             "exact-tested (tests/test_sync.py, test_zero.py, "
-            "test_convergence.py) and full-epoch agreement is §1's "
-            "table. Losses also differ across world sizes by design — "
+            "test_convergence.py) and full-epoch agreement is the "
+            "convergence table above (when present). Losses also "
+            "differ across world sizes by design — "
             "BatchNorm uses per-replica batch statistics (the "
             "reference's track_running_stats=False semantic, report "
             "§3.2), so the per-shard batch size changes the "

@@ -1,6 +1,7 @@
 """ResNet-50 / ImageNet-1k stretch config (BASELINE.json configs[4];
 no reference counterpart — the reference is VGG-11/CIFAR-10 only)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -60,6 +61,7 @@ class TestResNet50Config:
                                                   jnp.float32))
         assert out.shape == (2, 1000)
 
+    @pytest.mark.slow  # full-depth ResNet-50 compile: ~47 s on 1 core
     def test_train_step_on_mesh(self, devices):
         """Full fused-DP train step with ResNet-50 (reduced image size to
         stay CPU-feasible; the architecture is identical)."""
