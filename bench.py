@@ -259,6 +259,33 @@ def run_lm_bench(batch_size: int = 8, seq_len: int = 2048,
         (state.params, state.opt_state, *staged[0],
          *trainer._extra_args(state)) if with_xla_flops else None)
 
+    # KV-cache decode throughput (models/generate.py): the whole decode
+    # loop is ONE jitted lax.scan dispatch, so the tunnel RTT amortizes
+    # over all generated tokens. Recorded once (flash config only — the
+    # decode path itself is kernel-independent).
+    decode = None
+    if use_flash:
+        from tpu_ddp.models import generate
+
+        def run_decode():
+            # state.params live replicated on the 1-chip mesh — usable
+            # directly (a host round-trip would push ~130 MB through
+            # the tunnel per call).
+            params = state.params
+            prompt = rng.integers(0, model.vocab_size, size=(8, 128))
+            out = generate(model, params, prompt, max_new_tokens=256)
+            np.asarray(out)  # compile+warm
+            t0 = time.perf_counter()
+            for _ in range(3):
+                out = generate(model, params, prompt, max_new_tokens=256)
+            np.asarray(out)
+            dt = (time.perf_counter() - t0) / 3
+            return {"batch": 8, "prompt_len": 128, "new_tokens": 256,
+                    "tokens_per_sec": round(8 * 256 / dt, 1),
+                    "ms_per_token_step": round(dt / 256 * 1e3, 3)}
+
+        decode = _sub(run_decode)
+
     toks_per_sec = batch_size * seq_len / avg_s
     return {
         "metric": "transformer_lm_tokens_per_sec_per_chip",
@@ -272,6 +299,7 @@ def run_lm_bench(batch_size: int = 8, seq_len: int = 2048,
             "timed_iters": timed_iters,
             "model": model.name,
             "flash_attention": use_flash,
+            **({"decode": decode} if decode else {}),
             "platform": jax.devices()[0].platform,
             "device_kind": jax.devices()[0].device_kind,
             **mfu,

@@ -45,6 +45,58 @@ class TrainState:
     step: int = 0
 
 
+class _LossWindow:
+    """Running-loss window with the reference's print/metric cadence
+    (loss every ``log_every`` iters, part1/main.py:82-84; timing report
+    at the window's last iteration) — ONE implementation shared by the
+    per-step and K-per-dispatch epoch loops so their output cannot
+    drift (tests assert the two loops print identical lines)."""
+
+    def __init__(self, cfg, metrics, timer, epoch: int, log):
+        self._cfg = cfg
+        self._metrics = metrics
+        self._timer = timer
+        self._epoch = epoch
+        self._log = log
+        self._running = 0.0
+        self._window = 0
+        self.last_loss = 0.0
+        self.iters = 0
+
+    def account(self, it: int, local_loss: float, step: int) -> None:
+        cfg = self._cfg
+        self._running += local_loss
+        self._window += 1
+        self.last_loss = local_loss
+        self.iters += 1
+        if it % cfg.log_every == cfg.log_every - 1:
+            # Divide by the iterations actually in the window — after a
+            # mid-epoch resume the first window is shorter.
+            window_loss = self._running / max(self._window, 1)
+            self._log(f"[epoch {self._epoch}, iter {it + 1}] "
+                      f"loss: {window_loss:.3f}")
+            self._metrics.log("train_iter", epoch=self._epoch,
+                              iter=it + 1, step=step,
+                              loss=round(window_loss, 5))
+            self._running = 0.0
+            self._window = 0
+        if it == cfg.timing_last_iter:
+            self._log(self._timer.report(prefix=f"[epoch {self._epoch}] "))
+
+    def epoch_stats(self) -> dict:
+        timer = self._timer
+        self._metrics.log("epoch", epoch=self._epoch, iters=self.iters,
+                          avg_iter_s=timer.average_s,
+                          last_loss=round(self.last_loss, 5))
+        return {
+            "avg_iter_ns": timer.average_ns,
+            "avg_iter_s": timer.average_s,
+            "timed_iters": timer.count,
+            "last_loss": self.last_loss,
+            "iters": self.iters,
+        }
+
+
 class Trainer:
     """Wires model + optimizer + sync strategy into jitted train/eval steps.
 
@@ -470,15 +522,26 @@ class Trainer:
         prefix would double-train those examples and inflate step)."""
         cfg = self.config
         timer = IterationTimer(cfg.timing_first_iter, cfg.timing_last_iter)
-        running_loss = 0.0
-        window_n = 0
-        last_loss = 0.0
-        n_iters = 0
+        window = _LossWindow(cfg, self.metrics, timer, epoch, log)
         # Advance past the resumed prefix BEFORE prefetch wraps the
         # stream, so skipped batches are never processed or transferred.
         if start_iter:
             import itertools
             batches = itertools.islice(iter(batches), start_iter, None)
+        # K-steps-per-dispatch path (cfg.steps_per_dispatch > 1): groups
+        # of K uniform batches run as ONE jitted scan (build_multi_step).
+        # Anything that needs per-step host control forces the per-step
+        # path: in-loop checkpoint/invariant cadences, the fault-
+        # injection drill (it must fire at an exact step), and
+        # device_prefetch (its overlap is a per-step transfer pipeline;
+        # composing it with grouped dispatch is not implemented).
+        import os as _os
+        if (cfg.steps_per_dispatch > 1 and not cfg.ckpt_every_iters
+                and not cfg.check_replicas_every
+                and not cfg.device_prefetch
+                and not _os.environ.get("TPU_DDP_FAIL_AT_STEP")):
+            return self._train_epoch_multi(state, batches, timer,
+                                           window, start_iter=start_iter)
         # With device_prefetch > 0 upcoming batches' transfers are already
         # in flight when the step runs (tpu_ddp/data/prefetch.py); the
         # timer still brackets the same loop body as the reference
@@ -506,25 +569,7 @@ class Trainer:
                     np.ravel(loss.addressable_shards[0].data)[0])
             else:
                 local_loss = float(loss)
-            running_loss += local_loss
-            window_n += 1
-            last_loss = local_loss
-            n_iters += 1
-            # Loss print cadence: every 20 mini-batches
-            # (reference part1/main.py:82-84). Divide by the iterations
-            # actually in the window — after a mid-epoch resume the first
-            # window is shorter than log_every.
-            if it % cfg.log_every == cfg.log_every - 1:
-                window_loss = running_loss / max(window_n, 1)
-                log(f"[epoch {epoch}, iter {it + 1}] "
-                    f"loss: {window_loss:.3f}")
-                self.metrics.log("train_iter", epoch=epoch, iter=it + 1,
-                                 step=state.step,
-                                 loss=round(window_loss, 5))
-                running_loss = 0.0
-                window_n = 0
-            if it == cfg.timing_last_iter:
-                log(timer.report(prefix=f"[epoch {epoch}] "))
+            window.account(it, local_loss, state.step)
             # Aux subsystems (no reference equivalent — SURVEY.md §5):
             # mid-epoch checkpoints, replica-invariant check, fault hook.
             if (ckpt_dir and cfg.ckpt_every_iters
@@ -547,16 +592,84 @@ class Trainer:
                     check_replica_consistency(state.params)
             from tpu_ddp.utils.invariants import maybe_inject_failure
             maybe_inject_failure(state.step)
-        self.metrics.log("epoch", epoch=epoch, iters=n_iters,
-                         avg_iter_s=timer.average_s,
-                         last_loss=round(last_loss, 5))
-        return state, {
-            "avg_iter_ns": timer.average_ns,
-            "avg_iter_s": timer.average_s,
-            "timed_iters": timer.count,
-            "last_loss": last_loss,
-            "iters": n_iters,
-        }
+        return state, window.epoch_stats()
+
+    def _train_epoch_multi(self, state, batches, timer, window,
+                           start_iter):
+        """Epoch loop with K optimizer steps per dispatch.
+
+        Groups of K same-shape, slot-divisible host batches run through
+        :meth:`build_multi_step`'s scanned call (bit-equal to K single
+        steps — tested); ragged tails fall back to :meth:`train_step`.
+        Loss-print cadence and the iteration-window timer keep the
+        reference's semantics via the shared ``_LossWindow`` (per-
+        dispatch time attributed evenly to its K iterations)."""
+        cfg = self.config
+        K = cfg.steps_per_dispatch
+        multi = self.build_multi_step(K)
+        n_slots = (self.mesh.shape[DATA_AXIS] if self.mesh is not None
+                   else 1)
+        local_slots = max(n_slots // max(jax.process_count(), 1), 1)
+
+        def local_of(loss):
+            if self.mesh is not None:
+                return float(np.ravel(loss.addressable_shards[0].data)[0])
+            return float(loss)
+
+        it = start_iter
+        buf: list = []
+
+        def flush_singles():
+            nonlocal state, it
+            for bx, by in buf:
+                timer.start()
+                state, loss = self.train_step(state,
+                                              *self.put_batch(bx, by))
+                loss = jax.block_until_ready(loss)
+                timer.stop(it)
+                window.account(it, local_of(loss), state.step)
+                it += 1
+            buf.clear()
+
+        for item in batches:
+            if cfg.max_iters is not None \
+                    and it + len(buf) >= cfg.max_iters:
+                break
+            buf.append(item)
+            if len(buf) < K:
+                continue
+            shapes = {np.shape(b[0]) for b in buf}
+            if len(shapes) == 1 and len(buf[0][1]) % local_slots == 0:
+                # A group containing pre-window iterations holds the
+                # compile; spreading it over its K iterations would leak
+                # warm-up into the window the reference's protocol
+                # excludes (iteration 0 discarded, part1/main.py:86-91).
+                timed = it >= timer.first_iter
+                if timed:
+                    timer.start()
+                xs = np.stack([b[0] for b in buf])
+                ys = np.stack([b[1] for b in buf])
+                state, losses = multi(state, *self.put_batches(xs, ys))
+                losses = jax.block_until_ready(losses)
+                if timed:
+                    timer.stop_many(it, K)
+                if self.mesh is not None:
+                    per_step = np.asarray(
+                        losses.addressable_shards[0].data).reshape(K, -1)
+                    per_step = per_step[:, 0]
+                else:
+                    per_step = np.ravel(np.asarray(losses))
+                for j in range(K):
+                    # state.step already advanced by K; attribute each
+                    # iteration its own global step.
+                    window.account(it, float(per_step[j]),
+                                   state.step - K + j + 1)
+                    it += 1
+                buf.clear()
+            else:
+                flush_singles()  # non-uniform group: step them singly
+        flush_singles()  # tail shorter than K
+        return state, window.epoch_stats()
 
     # ---- eval (reference test_model, part1/main.py:96-111) -------------
 

@@ -69,6 +69,12 @@ class TrainConfig:
     pallas_sgd: bool = False          # fused Pallas optimizer update kernel
     pallas_bn: bool = False           # fused Pallas BatchNorm+ReLU kernel
     device_prefetch: int = 0          # host->device transfers kept in flight
+    # > 1: the epoch loop groups K uniform batches per dispatch via
+    # Trainer.build_multi_step (one lax.scan over K optimizer steps —
+    # amortizes per-dispatch overhead; bit-equal to K single steps).
+    # Ragged/tail batches and in-loop checkpoint/invariant cadences fall
+    # back to the per-step path. Env: TPU_DDP_STEPS_PER_DISPATCH.
+    steps_per_dispatch: int = 1
 
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
@@ -96,6 +102,9 @@ class TrainConfig:
         env_pf = os.environ.get("TPU_DDP_PREFETCH")
         if env_pf:
             self.device_prefetch = int(env_pf)
+        env_spd = os.environ.get("TPU_DDP_STEPS_PER_DISPATCH")
+        if env_spd:
+            self.steps_per_dispatch = int(env_spd)
         env_ck = os.environ.get("TPU_DDP_CKPT_EVERY")
         if env_ck:
             self.ckpt_every_iters = int(env_ck)

@@ -35,6 +35,19 @@ class IterationTimer:
             self.count += 1
         return elapsed
 
+    def stop_many(self, first_iteration: int, k: int) -> int:
+        """Attribute the elapsed time since :meth:`start` evenly to
+        iterations [first_iteration, first_iteration + k) — the
+        K-steps-per-dispatch case (Trainer.build_multi_step), where
+        per-iteration boundaries don't exist on the host."""
+        elapsed = time.perf_counter_ns() - self._t0
+        share = elapsed // max(k, 1)
+        for it in range(first_iteration, first_iteration + k):
+            if self.first_iter <= it <= self.last_iter:
+                self.total_ns += share
+                self.count += 1
+        return elapsed
+
     @property
     def average_ns(self) -> float:
         return self.total_ns / self.count if self.count else 0.0
