@@ -15,7 +15,9 @@ Each process contributes its local devices as dp slots; batches are
 synthetic tokens (zero egress), per-process shards assembled into global
 arrays by the trainer. Env knobs: TPU_DDP_LM_STEPS, TPU_DDP_LM_PRESET,
 TPU_DDP_LM_FSDP=1, TPU_DDP_GLOBAL_BATCH, TPU_DDP_LM_ACCUM (gradient-
-accumulation microbatches), TPU_DDP_LM_SP_MODE (ring|ulysses).
+accumulation microbatches), TPU_DDP_LM_SP_MODE (ring|ulysses),
+TPU_DDP_LM_OPT (adamw|adafactor), TPU_DDP_LM_ZERO1=1 (ZeRO-1 optimizer
+state sharding — Adafactor uses the row-sharded FactoredZeRO1).
 """
 
 import os
@@ -60,6 +62,8 @@ def main(argv=None) -> int:
     fsdp = os.environ.get("TPU_DDP_LM_FSDP", "0") == "1"
     accum = int(os.environ.get("TPU_DDP_LM_ACCUM", "1"))
     sp_mode = os.environ.get("TPU_DDP_LM_SP_MODE", "ring")
+    zero1 = os.environ.get("TPU_DDP_LM_ZERO1", "0") == "1"
+    opt_name = os.environ.get("TPU_DDP_LM_OPT", "adamw")
     global_batch = int(os.environ.get("TPU_DDP_GLOBAL_BATCH", "8"))
     if global_batch % world:
         raise ValueError(f"TPU_DDP_GLOBAL_BATCH={global_batch} not "
@@ -69,13 +73,24 @@ def main(argv=None) -> int:
     model = make_transformer(preset, max_seq_len=seq_len,
                              compute_dtype=np.float32)
     mesh = make_mesh()
+    if opt_name == "adafactor":
+        from tpu_ddp.ops.optim import Adafactor
+        optimizer = Adafactor(min_dim_size_to_factor=8)
+    elif opt_name == "adamw":
+        optimizer = None  # LMTrainer's AdamW default
+    else:
+        raise ValueError(f"TPU_DDP_LM_OPT={opt_name!r}: expected "
+                         "'adamw' or 'adafactor'")
     trainer = LMTrainer(
         model, mesh,
         param_sharding="fsdp" if fsdp else "replicated",
+        opt_sharding="zero1" if zero1 else "replicated",
+        optimizer=optimizer,
         grad_accum=accum, sp_mode=sp_mode)
     state = trainer.init_state(seed=0)
     print(f"[lm_train] rank={rank} world={world} dp={trainer.dp} "
-          f"sp={trainer.sp} fsdp={fsdp} accum={accum} preset={preset}")
+          f"sp={trainer.sp} fsdp={fsdp} zero1={zero1} opt={opt_name} "
+          f"accum={accum} preset={preset}")
 
     # Deterministic synthetic tokens, identical on every process; each
     # process feeds ITS contiguous shard of the global batch.

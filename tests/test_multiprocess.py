@@ -196,3 +196,28 @@ def test_two_process_lm_train():
         first = [float(l.rsplit(" ", 1)[1])
                  for l in res.output_of(0).splitlines() if "loss" in l]
         assert first[-1] < first[0], (fsdp, first)
+
+
+@pytest.mark.slow
+def test_two_process_lm_zero1_adafactor():
+    """ZeRO-1 Adafactor across REAL process boundaries: the row-block
+    psum_scatter / vc psums / all_gather of FactoredZeRO1 span two
+    jax.distributed processes, and training makes progress (each rank
+    prints the mean over ITS data shard, so values differ per rank but
+    each must be finite and falling)."""
+    res = launch("examples/lm_train.py", nproc=2,
+                 env={"TPU_DDP_LM_STEPS": "5",
+                      "TPU_DDP_LM_OPT": "adafactor",
+                      "TPU_DDP_LM_ZERO1": "1"},
+                 echo=False, timeout=600)
+    assert res.ok, "\n".join(w.output for w in res.workers)
+    import math
+    import re
+    for rank in (0, 1):
+        out = res.output_of(rank)
+        assert "zero1=True opt=adafactor" in out
+        losses = [float(m.group(1)) for m in
+                  re.finditer(r"step \d+/\d+ loss ([0-9.naninf-]+)", out)]
+        assert len(losses) == 5, out
+        assert all(math.isfinite(x) for x in losses), losses
+        assert losses[-1] < losses[0], losses
