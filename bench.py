@@ -91,7 +91,8 @@ def _chained_avg_s(step, state, staged, timed_iters: int):
 
 def run_bench(batch_size: int | None = None, timed_iters: int = 39,
               config: str | None = None, end_to_end_iters: int = 3,
-              with_xla_flops: bool = True) -> dict:
+              with_xla_flops: bool = True,
+              with_multi_step: bool = True) -> dict:
     import jax
 
     from tpu_ddp.models import VGG_CFG, get_model
@@ -144,11 +145,12 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
     # tested). Recorded alongside, not as the headline, to keep the
     # headline protocol comparable across rounds.
     multi_step = None
-    if config == "vgg11_cifar10" and timed_iters >= 16:
-        k = 16
+    if with_multi_step and config == "vgg11_cifar10" and timed_iters >= 4:
+        k = min(16, timed_iters)  # full 16 on real runs; small in tests
         multi = trainer.build_multi_step(k)
-        xs = np.stack([h[0] for h in host] * (k // len(host)))
-        ys = np.stack([h[1] for h in host] * (k // len(host)))
+        reps = -(-k // len(host))
+        xs = np.stack(([h[0] for h in host] * reps)[:k])
+        ys = np.stack(([h[1] for h in host] * reps)[:k])
         staged_k = trainer.put_batches(xs, ys)
         state, losses = multi(state, *staged_k)
         np.asarray(losses)  # compile + warm
@@ -349,7 +351,7 @@ def main() -> dict:
     for bs in (1024, 2048):
         r = _sub(run_bench, batch_size=bs, timed_iters=10,
                  config="vgg11_cifar10", end_to_end_iters=1,
-                 with_xla_flops=False)
+                 with_xla_flops=False, with_multi_step=False)
         sweep[str(bs)] = (
             {"images_per_sec": r["value"], "mfu": r["extra"]["mfu"]}
             if "error" not in r else r)

@@ -64,6 +64,26 @@ class TestBenchEntry:
         assert ex["mfu"] == pytest.approx(
             ex["achieved_tflops"] / 100.0, abs=2e-4)
 
+    @pytest.mark.slow  # scan-of-4 VGG compile: minutes on 1 CPU core
+    def test_multi_step_recorded_for_headline(self):
+        """timed_iters >= 4 triggers the scan-of-k sub-measurement on
+        the headline config (k = min(16, timed_iters), so tests compile
+        a short scan); its throughput field must be present/positive."""
+        out = bench.run_bench(batch_size=8, timed_iters=4,
+                              config="vgg11_cifar10", end_to_end_iters=1,
+                              with_xla_flops=False)
+        ms = out["extra"].get("multi_step")
+        assert ms is not None
+        assert ms["steps_per_call"] == 4
+        assert ms["images_per_sec"] > 0
+
+    @pytest.mark.slow  # decode-scan compile: minutes on 1 CPU core
+    def test_lm_decode_recorded(self):
+        out = bench.run_lm_bench(batch_size=2, seq_len=512, timed_iters=1)
+        dec = out["extra"].get("decode")
+        assert dec is not None and "error" not in dec
+        assert dec["tokens_per_sec"] > 0
+
     def test_collectives_bench_shape(self):
         out = bench.run_collectives_bench(mb=0.5, iters=2)
         # 8-device virtual mesh in tests -> real results, not skipped.
