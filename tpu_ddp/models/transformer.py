@@ -390,10 +390,10 @@ class TransformerLM:
             # replicated over tp, so its mask must be too).
             r1, r2 = jax.random.split(rng)
         y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
-        # Under GQA k/v stay at KV-head width end to end: attend()'s
-        # ring/blockwise/full paths contract grouped, so collectives and
-        # score math carry the minimal bytes (only the flash kernel
-        # materializes the expansion, and for ulysses only post-gather).
+        # Under GQA k/v stay at KV-head width end to end: every attend()
+        # path contracts grouped — ring/blockwise/full in jnp, and the
+        # flash kernel indexes K/V blocks by q-head group natively — so
+        # collectives, memory and score math all carry KV-width bytes.
         q, k, v = self.qkv_proj(blk, self._tp_in(y), pos)
         o = attend(q, k, v, causal=True, axis_name=self.sp_axis,
                    axis_size=self.sp_size, flash=self.use_flash,

@@ -123,17 +123,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
             (m_sc[:, :1] + jnp.log(l_safe)).T
 
 
+def _kv_index(b, *, n_heads, n_kv):
+    """Grid dim-0 runs over B*H q-heads; the K/V array holds B*KV heads.
+    Group-contiguous mapping (head h shares KV head h // (H/KV) — the
+    ``jnp.repeat`` order): kv_row = (b // H) * KV + (b % H) // (H/KV).
+    Identity when H == KV (MHA)."""
+    if n_heads == n_kv:
+        return b
+    group = n_heads // n_kv
+    return (b // n_heads) * n_kv + (b % n_heads) // group
+
+
 @functools.partial(jax.jit,
                    static_argnames=("scale", "seq_len", "causal",
-                                    "interpret"))
-def _fwd_impl(q3, k3, v3, *, scale, seq_len, causal, interpret):
+                                    "n_heads", "n_kv", "interpret"))
+def _fwd_impl(q3, k3, v3, *, scale, seq_len, causal, n_heads, n_kv,
+              interpret):
     bh, lp, dp = q3.shape
     bq = _pick_block(lp, 256)
     bk = _pick_block(lp, 512)
+    kv_idx = functools.partial(_kv_index, n_heads=n_heads, n_kv=n_kv)
     qkv_spec = lambda which, blk: pl.BlockSpec(  # noqa: E731
         (1, blk, dp),
         {"q": lambda b, i, j: (b, i, 0),
-         "kv": lambda b, i, j: (b, j, 0)}[which],
+         "kv": lambda b, i, j: (kv_idx(b), j, 0)}[which],
         memory_space=pltpu.VMEM)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, seq_len=seq_len,
@@ -172,10 +185,17 @@ def _recompute_p_ds(q, k, v, do, lse_row, delta_row, i, j, *, scale,
 
 def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dk_ref, dv_ref, dk_sc, dv_sc, *, scale, seq_len,
-                   causal):
-    jk, iq = pl.program_id(1), pl.program_id(2)  # k-block outer, q inner
+                   causal, n_q_blocks):
+    """dk/dv sweep. Grid dim 0 runs over B*KV (the K/V rows); the inner
+    dim enumerates (group member g, q-block iq) pairs as c = g *
+    n_q_blocks + iq, so under grouped-query attention every q-head
+    sharing this KV head accumulates into the SAME scratch before one
+    flush (TPU grid steps are sequential). MHA is group == 1, where c is
+    simply iq."""
+    jk, c = pl.program_id(1), pl.program_id(2)
+    iq = c % n_q_blocks
 
-    @pl.when(iq == 0)
+    @pl.when(c == 0)
     def _():
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
@@ -197,7 +217,7 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         update()
 
-    @pl.when(iq == pl.num_programs(2) - 1)
+    @pl.when(c == pl.num_programs(2) - 1)
     def _():
         dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
@@ -234,42 +254,64 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "seq_len", "causal",
-                                    "interpret"))
+                                    "n_heads", "n_kv", "interpret"))
 def _bwd_impl(q3, k3, v3, o3, lse, do3, *, scale, seq_len, causal,
-              interpret):
+              n_heads, n_kv, interpret):
     bh, lp, dp = q3.shape
     bq = _pick_block(lp, 256)
     bk = _pick_block(lp, 256)
+    group = n_heads // n_kv
+    nq = lp // bq
+    kv_idx = functools.partial(_kv_index, n_heads=n_heads, n_kv=n_kv)
     # delta_i = rowsum(dO_i * O_i): one fused elementwise pass, f32.
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)[:, None, :]                   # (bh, 1, lp)
 
-    def block3(which, blk):
-        return pl.BlockSpec(
-            (1, blk, dp),
-            {"outer": lambda b, a, c: (b, a, 0),
-             "inner": lambda b, a, c: (b, c, 0)}[which],
-            memory_space=pltpu.VMEM)
+    # ---- dk/dv sweep: grid dim 0 over the B*KV K/V rows; the inner dim
+    # enumerates (group member, q-block) as c = g*nq + iq, so grouped
+    # q-heads accumulate into one scratch (see _bwd_kv_kernel). For MHA
+    # q_row(b, c) == b and the maps reduce to the plain layout.
+    def q_row(b, c):
+        if group == 1:
+            return b
+        return (b // n_kv) * n_heads + (b % n_kv) * group + c // nq
 
+    def qspec_kv(blk):
+        return pl.BlockSpec((1, blk, dp),
+                            lambda b, a, c: (q_row(b, c), c % nq, 0),
+                            memory_space=pltpu.VMEM)
+
+    kvspec_kv = pl.BlockSpec((1, bk, dp), lambda b, a, c: (b, a, 0),
+                             memory_space=pltpu.VMEM)
     # lse/delta ride as full (1, 1, Lp) rows; kernels slice their q-block
     # (TPU block tiling forbids a (1, bq) sub-row block).
-    row_spec = pl.BlockSpec((1, 1, lp), lambda b, a, c: (b, 0, 0),
-                            memory_space=pltpu.VMEM)
+    row_kv = pl.BlockSpec((1, 1, lp), lambda b, a, c: (q_row(b, c), 0, 0),
+                          memory_space=pltpu.VMEM)
 
     kw = dict(scale=scale, seq_len=seq_len, causal=causal)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_kv_kernel, **kw),
-        grid=(bh, lp // bk, lp // bq),  # k-blocks outer, q-blocks inner
-        in_specs=[block3("inner", bq), block3("outer", bk),
-                  block3("outer", bk), block3("inner", bq),
-                  row_spec, row_spec],
-        out_specs=(block3("outer", bk), block3("outer", bk)),
+        functools.partial(_bwd_kv_kernel, n_q_blocks=nq, **kw),
+        grid=(k3.shape[0], lp // bk, group * nq),
+        in_specs=[qspec_kv(bq), kvspec_kv, kvspec_kv, qspec_kv(bq),
+                  row_kv, row_kv],
+        out_specs=(kvspec_kv, kvspec_kv),
         # Cotangent dtypes must match the primals' (k and v may differ).
         out_shape=(jax.ShapeDtypeStruct(k3.shape, k3.dtype),
                    jax.ShapeDtypeStruct(v3.shape, v3.dtype)),
         scratch_shapes=[pltpu.VMEM((bk, dp), jnp.float32)] * 2,
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
+
+    # ---- dq sweep: per q-head grid; K/V blocks via the grouped map.
+    def block3(which, blk):
+        return pl.BlockSpec(
+            (1, blk, dp),
+            {"outer": lambda b, a, c: (b, a, 0),
+             "inner": lambda b, a, c: (kv_idx(b), c, 0)}[which],
+            memory_space=pltpu.VMEM)
+
+    row_spec = pl.BlockSpec((1, 1, lp), lambda b, a, c: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(_bwd_q_kernel, **kw),
         grid=(bh, lp // bq, lp // bk),  # q-blocks outer, k-blocks inner
@@ -311,19 +353,33 @@ def flash_attention(q, k, v, causal: bool = False):
     tpu_ddp/parallel/ring_attention.py:full_attention — same math, O(L·D)
     HBM traffic instead of an O(L²) score matrix. Differentiable via the
     flash backward recomputation.
+
+    Grouped-query attention: ``k``/``v`` may carry KV < H heads (H % KV
+    == 0, group-contiguous ``jnp.repeat`` order). The kernels index K/V
+    blocks by q-head group directly — the expansion is never
+    materialized, and the backward accumulates each KV head's dk/dv
+    across its group inside one scratch sweep.
     """
     o, _ = _flash_fwd_padded(q, k, v, causal)
     return o
 
 
+def _check_heads(h: int, kvh: int) -> None:
+    if h % kvh:
+        raise ValueError(f"flash_attention: {h} query heads not "
+                         f"divisible by {kvh} KV heads")
+
+
 def _flash_fwd_padded(q, k, v, causal):
     b, L, h, d = q.shape
+    kvh = k.shape[2]
+    _check_heads(h, kvh)
     lp = _cdiv(L, _BLOCK) * _BLOCK
     dp = _cdiv(d, _BLOCK) * _BLOCK
     scale = 1.0 / (d ** 0.5)
     o3, lse = _fwd_impl(_to3(q, lp, dp), _to3(k, lp, dp), _to3(v, lp, dp),
                         scale=scale, seq_len=L, causal=causal,
-                        interpret=_interpret())
+                        n_heads=h, n_kv=kvh, interpret=_interpret())
     return _from3(o3, b, L, h, d), (o3, lse)
 
 
@@ -335,15 +391,16 @@ def _flash_fwd(q, k, v, causal):
 def _flash_bwd(causal, residuals, g):
     q, k, v, o3, lse = residuals
     b, L, h, d = q.shape
+    kvh = k.shape[2]
     lp = _cdiv(L, _BLOCK) * _BLOCK
     dp = _cdiv(d, _BLOCK) * _BLOCK
     scale = 1.0 / (d ** 0.5)
     dq3, dk3, dv3 = _bwd_impl(
         _to3(q, lp, dp), _to3(k, lp, dp), _to3(v, lp, dp), o3, lse,
         _to3(g, lp, dp), scale=scale, seq_len=L, causal=causal,
-        interpret=_interpret())
-    return (_from3(dq3, b, L, h, d), _from3(dk3, b, L, h, d),
-            _from3(dv3, b, L, h, d))
+        n_heads=h, n_kv=kvh, interpret=_interpret())
+    return (_from3(dq3, b, L, h, d), _from3(dk3, b, L, kvh, d),
+            _from3(dv3, b, L, kvh, d))
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

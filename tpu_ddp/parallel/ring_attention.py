@@ -218,9 +218,9 @@ def attend(q, k, v, *, causal: bool = False, axis_name: str | None = None,
     flash Pallas kernel (``flash=True``) or the jnp reference.
 
     Grouped-query attention: ``k``/``v`` may carry fewer heads than
-    ``q`` (H % KV == 0). The ring, blockwise, and full paths contract
-    grouped — KV-width bytes on the wire and in memory; only the flash
-    kernel needs a materialized expansion."""
+    ``q`` (H % KV == 0). Every path contracts grouped — KV-width bytes
+    on the wire and in memory; the flash kernel indexes K/V blocks by
+    q-head group natively (tpu_ddp/ops/pallas/flash_attention.py)."""
     if axis_name is not None:
         if axis_size is None:
             # Falling back to full_attention here would silently compute
@@ -240,6 +240,5 @@ def attend(q, k, v, *, causal: bool = False, axis_name: str | None = None,
                                   causal=causal)
     if flash:
         from tpu_ddp.ops.pallas import flash_attention
-        k, v = repeat_kv_heads(k, v, q.shape[2] // k.shape[2])
         return flash_attention(q, k, v, causal)
     return full_attention(q, k, v, causal=causal)

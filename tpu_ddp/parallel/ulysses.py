@@ -107,9 +107,10 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     # full_attention.
     if flash:
         from tpu_ddp.ops.pallas import flash_attention
-        # Post-gather expansion: the a2a already moved KV-width bytes;
-        # only the kernel input is widened (it has no grouped path).
-        k, v = repeat_kv_heads(k, v, q.shape[2] // k.shape[2])
+        # Grouped K/V go straight in: the kernel indexes K/V blocks by
+        # q-head group natively, and the a2a's contiguous head blocks
+        # keep groups contiguous locally (q block i's heads map exactly
+        # onto kv block i's heads).
         out = flash_attention(q, k, v, causal)
     else:
         out = blockwise_attention(q, k, v, causal=causal)
