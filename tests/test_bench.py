@@ -84,6 +84,37 @@ class TestBenchEntry:
         assert dec is not None and "error" not in dec
         assert dec["tokens_per_sec"] > 0
 
+    def test_compact_headline_shape(self):
+        """The driver parses exactly one stdout line; it must stay small
+        and carry headline + MFU (round-2 truncation regression)."""
+        import json
+        result = {
+            "metric": "cifar10_vgg11_images_per_sec_per_chip",
+            "value": 72614.0, "unit": "images/sec", "vs_baseline": 188.1,
+            "extra": {
+                "mfu": 0.2667,
+                "batch_sweep": {"2048": {"images_per_sec": 1.0,
+                                         "mfu": 0.3379},
+                                "4096": {"error": "OOM"}},
+                "configs": {
+                    "resnet50_imagenet": {"extra": {"mfu": 0.2685}},
+                    "transformer_lm": {"extra": {"mfu": 0.2744}},
+                    "transformer_lm_large": {"error": "boom"},
+                },
+            },
+        }
+        c = bench.compact_headline(result)
+        assert c["metric"] == result["metric"]
+        assert c["value"] == result["value"]
+        assert c["vs_baseline"] == result["vs_baseline"]
+        assert c["mfu"] == 0.2667
+        # best vgg MFU comes from the sweep; best overall across families
+        assert c["mfu_by_family"]["vgg11"] == 0.3379
+        assert c["best_mfu"] == 0.3379
+        # errors in sweep/configs never break the compact line
+        line = json.dumps(c)
+        assert len(line) < 1000  # must stay within driver tail capture
+
     def test_collectives_bench_shape(self):
         out = bench.run_collectives_bench(mb=0.5, iters=2)
         # 8-device virtual mesh in tests -> real results, not skipped.

@@ -156,3 +156,119 @@ class TestZeROWrapper:
         new_p, _ = stepped(params, grads, z_state)
         want = np.arange(10, dtype=np.float32) - 0.1
         np.testing.assert_allclose(np.asarray(new_p["v"]), want, rtol=1e-6)
+
+
+class TestZeRO1ModelParallel:
+    """ZeRO-1 composed with tensor/expert parallelism (round-3 verdict
+    item 6): each mp/ep-sharded leaf's optimizer state is laid out per
+    model-parallel cell and dp-sharded within it (P((mp, dp)))."""
+
+    def _lm(self, devices, sharding, mp=1, ep=1, model_name
+            ="TransformerLM-tiny", seed=7):
+        import jax.numpy as jnp
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.train.lm import LMTrainer
+
+        model = make_transformer(model_name, max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:4], dp=4 // (mp * ep), mp=mp, ep=ep)
+        return LMTrainer(model, mesh, optimizer=AdamW(),
+                         opt_sharding=sharding)
+
+    def _run(self, tr, tokens, steps=3):
+        from tpu_ddp.train.lm import make_lm_batch
+        state = tr.init_state(seed=0)
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        losses = []
+        for _ in range(steps):
+            state, loss = tr.train_step(state, x, y)
+            losses.append(float(np.mean(np.asarray(loss))))
+        return state, losses
+
+    def test_dp_tp_zero1_matches_replicated_opt(self, devices):
+        """dp2 x tp2 with zero1 == dp2 x tp2 with replicated optimizer:
+        same losses AND same final params, leaf for leaf."""
+        tokens = np.random.default_rng(11).integers(0, 1024, size=(4, 33))
+        runs = {s: self._run(self._lm(devices, s, mp=2), tokens)
+                for s in ("replicated", "zero1")}
+        np.testing.assert_allclose(runs["zero1"][1], runs["replicated"][1],
+                                   rtol=1e-5)
+        for a, b in zip(
+                jax.tree.leaves(jax.device_get(runs["replicated"][0].params)),
+                jax.tree.leaves(jax.device_get(runs["zero1"][0].params))):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_dp_tp_zero1_state_layout(self, devices):
+        """tp-sharded leaves' moments shard P((mp, dp)); replicated
+        leaves' moments shard P(dp)."""
+        from tpu_ddp.parallel.mesh import MODEL_AXIS
+        tr = self._lm(devices, "zero1", mp=2)
+        state = tr.init_state(seed=0)
+        mu = state.opt_state["mu"]
+        blk = mu["blocks"][0]
+        # wqkv is (dm, 3, heads, hd), heads sharded over mp.
+        assert blk["wqkv"].sharding.spec == P((MODEL_AXIS, DATA_AXIS))
+        assert mu["embed"].sharding.spec == P(DATA_AXIS)
+        # Each device owns 1/(mp*dp) of a tp-sharded leaf's state.
+        leaf = blk["wqkv"]
+        assert leaf.addressable_shards[0].data.size == leaf.size // 4
+
+    def test_dp_tp_zero1_checkpoint_into_replicated(self, devices,
+                                                    tmp_path):
+        """A dp x tp zero1 checkpoint holds canonical shapes: a plain
+        dp-only replicated trainer restores and continues identically."""
+        import jax.numpy as jnp
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+
+        tokens = np.random.default_rng(12).integers(0, 1024, size=(4, 33))
+        tr = self._lm(devices, "zero1", mp=2)
+        state = tr.init_state(seed=0)
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        state, _ = tr.train_step(state, x, y)
+        tr.save_checkpoint(str(tmp_path), state)
+        cont, _ = tr.train_step(state, x, y)
+
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        repl = LMTrainer(model, make_mesh(devices[:4]), optimizer=AdamW())
+        resumed = repl.restore_checkpoint(str(tmp_path))
+        xr, yr = repl.put_batch(*make_lm_batch(tokens))
+        resumed, _ = repl.train_step(resumed, xr, yr)
+        for a, b in zip(jax.tree.leaves(jax.device_get(cont.params)),
+                        jax.tree.leaves(jax.device_get(resumed.params))):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_dp_ep_zero1_matches_replicated_opt(self, devices):
+        """dp2 x ep2 MoE with zero1 == same mesh with replicated
+        optimizer (expert leaves' ep-sum/dp-mean algebra preserved)."""
+        tokens = np.random.default_rng(13).integers(0, 1024, size=(8, 33))
+        runs = {s: self._run(self._lm(devices, s, ep=2,
+                                      model_name="TransformerLM-moe-tiny"),
+                             tokens)
+                for s in ("replicated", "zero1")}
+        np.testing.assert_allclose(runs["zero1"][1], runs["replicated"][1],
+                                   rtol=1e-5)
+        for a, b in zip(
+                jax.tree.leaves(jax.device_get(runs["replicated"][0].params)),
+                jax.tree.leaves(jax.device_get(runs["zero1"][0].params))):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_adafactor_tp_still_refused(self, devices):
+        """Adafactor's row geometry still cannot compose with tp — the
+        guard must stay loud."""
+        import jax.numpy as jnp
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.ops.optim import Adafactor
+        from tpu_ddp.train.lm import LMTrainer
+
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:4], dp=2, mp=2)
+        with pytest.raises(ValueError, match="Adafactor"):
+            LMTrainer(model, mesh,
+                      optimizer=Adafactor(min_dim_size_to_factor=8),
+                      opt_sharding="zero1")

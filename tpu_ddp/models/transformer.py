@@ -491,6 +491,12 @@ def make_transformer(name: str = "TransformerLM-small",
                                     d_ff=2048, vocab_size=32000),
         "TransformerLM-base": dict(num_layers=12, num_heads=12, d_model=768,
                                    d_ff=3072, vocab_size=32000),
+        # MXU-saturating single-chip bench config (~740M params): every
+        # matmul has K,N >= 2048 and head_dim 128 fills the MXU tile
+        # exactly; fits a 16 GB v5e with f32 AdamW states + remat.
+        "TransformerLM-large": dict(num_layers=12, num_heads=16,
+                                    d_model=2048, d_ff=8192,
+                                    vocab_size=32000, remat_blocks=True),
         "TransformerLM-moe-tiny": dict(num_layers=2, num_heads=4,
                                        d_model=128, d_ff=256,
                                        vocab_size=1024, moe_experts=4),

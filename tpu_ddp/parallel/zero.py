@@ -58,6 +58,58 @@ class _LeafMeta:
             self.size *= int(d)
 
 
+class _LeafPart:
+    """Model-parallel partition of one leaf: which dim is sharded over
+    which non-dp mesh axis, and the resulting LOCAL geometry. NOT a
+    pytree node (travels tree.maps as a leaf). ``None`` in the part tree
+    means the leaf is replicated over every non-dp axis."""
+
+    def __init__(self, axis: str, dim: int, count: int,
+                 local_shape: tuple):
+        self.axis = axis            # mesh axis name (e.g. "mp")
+        self.dim = dim              # leaf dim it shards
+        self.count = count          # axis size R
+        self.local_shape = local_shape
+        self.local_size = 1
+        for d in local_shape:
+            self.local_size *= int(d)
+
+
+def _leaf_partition(spec, meta: _LeafMeta, mesh_axis_sizes: dict,
+                    dp_axis: str):
+    """Partition info for one leaf from its PartitionSpec, or None when
+    the leaf is replicated (or the sharding axis has extent 1). Megatron
+    layouts shard at most ONE dim per leaf over ONE axis — anything
+    richer is refused loudly rather than silently mis-sliced."""
+    sharded = []
+    for d, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        axes = tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+        for a in axes:
+            if a == dp_axis:
+                raise NotImplementedError(
+                    f"ZeRO1 cannot wrap a leaf already sharded over its "
+                    f"own axis {dp_axis!r} (spec {spec})")
+        sharded.append((d, axes))
+    if not sharded:
+        return None
+    if len(sharded) > 1 or len(sharded[0][1]) > 1:
+        raise NotImplementedError(
+            f"ZeRO1 supports one sharded dim over one mesh axis per "
+            f"leaf (got spec {spec})")
+    d, (ax,) = sharded[0]
+    r = int(mesh_axis_sizes[ax])
+    if r == 1:
+        return None
+    if meta.shape[d] % r:
+        raise ValueError(f"leaf dim {d} of shape {meta.shape} not "
+                         f"divisible by {ax}={r}")
+    local = list(meta.shape)
+    local[d] //= r
+    return _LeafPart(ax, d, r, tuple(local))
+
+
 class _FlatLayout:
     """Shared flat-padded layout machinery: leaves flatten to
     (ceil(size/N)*N,) and pad with zeros so every worker owns an equal
@@ -75,6 +127,15 @@ class _FlatLayout:
             raise ValueError(f"{type(self).__name__} needs a params "
                              "template for layout conversions")
 
+    def _flat_leaf(self, p, m: _LeafMeta):
+        """One canonical leaf -> flat zero-padded (chunk * N,)."""
+        pad = self._chunk(m.size) * self.axis_size - m.size
+        return np.pad(np.asarray(p).reshape(-1), (0, pad))
+
+    def _unflat_leaf(self, x, m: _LeafMeta):
+        """One flat padded array -> its canonical shape."""
+        return np.asarray(x)[:m.size].reshape(m.shape)
+
     def shard_params(self, params):
         """Canonical-shape tree -> global flat padded tree (place with
         ``P(dp)``); host-side at init/restore time. Deliberately numpy:
@@ -82,19 +143,13 @@ class _FlatLayout:
         shards it — a jnp pad would commit every unsharded leaf to one
         device first, the exact OOM FSDP exists to avoid."""
         self._require_meta()
-
-        def flat(p, m):
-            pad = self._chunk(m.size) * self.axis_size - m.size
-            return np.pad(np.asarray(p).reshape(-1), (0, pad))
-        return jax.tree.map(flat, params, self.meta)
+        return jax.tree.map(self._flat_leaf, params, self.meta)
 
     def unshard_host(self, host_tree):
         """Host flat padded arrays -> canonical shapes (checkpoint
         write path)."""
         self._require_meta()
-        return jax.tree.map(
-            lambda x, m: np.asarray(x)[:m.size].reshape(m.shape),
-            host_tree, self.meta)
+        return jax.tree.map(self._unflat_leaf, host_tree, self.meta)
 
     def canonicalize_opt_host(self, state):
         """Flat host optimizer state -> canonical shapes per leaf."""
@@ -112,10 +167,20 @@ class ZeRO1(_FlatLayout):
     state leaf is a flat (padded_size,) array, sharded over the axis);
     ``apply`` runs INSIDE the shard_map'd train step on UNSYNCED local
     gradients — the reduce-scatter it performs IS the gradient sync.
+
+    Composes with tensor/expert parallelism (round-3 verdict item 6):
+    pass ``param_specs`` + ``mesh_axis_sizes`` and each mp/ep-sharded
+    leaf's optimizer state is laid out as (R * dp * chunk,) sharded
+    ``P((mp, dp))`` — R model-parallel cells, each holding the flat
+    dp-sharded state of ITS tp slice. Inside shard_map ``apply`` only
+    ever sees local leaves, so the sharded update is IDENTICAL for
+    replicated and tp-sharded leaves; only the global layout, the spec
+    tree, and the checkpoint conversions are partition-aware.
     """
 
     def __init__(self, inner, axis_name: str = DATA_AXIS,
-                 axis_size: int | None = None, template=None):
+                 axis_size: int | None = None, template=None,
+                 param_specs=None, mesh_axis_sizes: dict | None = None):
         if axis_size is None or axis_size < 1:
             raise ValueError("ZeRO1 needs the static dp axis size")
         self.inner = inner
@@ -124,19 +189,100 @@ class ZeRO1(_FlatLayout):
         # Optional: enables canonical checkpoint layout conversions.
         self.meta = (jax.tree.map(_LeafMeta, template)
                      if template is not None else None)
+        if param_specs is not None:
+            if self.meta is None:
+                raise ValueError("ZeRO1 with param_specs needs a params "
+                                 "template (global leaf shapes)")
+            if mesh_axis_sizes is None:
+                raise ValueError("ZeRO1 with param_specs needs "
+                                 "mesh_axis_sizes")
+            self.part = jax.tree.map(
+                lambda s, m: _leaf_partition(s, m, mesh_axis_sizes,
+                                             axis_name),
+                param_specs, self.meta,
+                is_leaf=lambda x: isinstance(x, P))
+        else:
+            self.part = (jax.tree.map(lambda m: None, self.meta)
+                         if self.meta is not None else None)
+
+    def _part_leaves(self, n: int) -> list:
+        """Flattened partition list aligned with the meta/params leaf
+        order (None entries must survive flattening, hence the is_leaf)."""
+        if self.part is None:
+            return [None] * n
+        return jax.tree.leaves(
+            self.part,
+            is_leaf=lambda x: x is None or isinstance(x, _LeafPart))
 
     def init(self, params):
-        """Global flat state: inner state over (padded_size,) zero leaves."""
-        flat = jax.tree.map(
-            lambda p: jnp.zeros((self._chunk(p.size) * self.axis_size,),
-                                p.dtype), params)
+        """Global flat state: inner state over (R * padded_local,) zero
+        leaves (R = 1 for leaves with no model-parallel partition)."""
+        p_l, treedef = jax.tree.flatten(params)
+
+        def zeros(p, pt):
+            r = pt.count if pt is not None else 1
+            chunk = self._chunk(pt.local_size if pt is not None
+                                else p.size)
+            return jnp.zeros((r * chunk * self.axis_size,), p.dtype)
+        flat = treedef.unflatten(
+            [zeros(p, pt) for p, pt
+             in zip(p_l, self._part_leaves(len(p_l)))])
         return self.inner.init(flat)
 
     def state_specs(self, param_specs=None):
-        """Every (flat) state leaf shards over the dp axis; scalars (e.g.
-        AdamW's step count) stay replicated — the inner optimizer's
-        state_specs decides which is which."""
-        return self.inner.state_specs(P(self.axis_name))
+        """Flat state leaves shard over the dp axis — model-parallel
+        partitioned leaves over ``P((mp, dp))`` (mp-major, matching
+        ``init``'s concatenation order); scalars (e.g. AdamW's step
+        count) stay replicated — the inner optimizer's state_specs
+        decides which is which."""
+        if self.meta is None:
+            return self.inner.state_specs(P(self.axis_name))
+        m_l, treedef = jax.tree.flatten(self.meta)
+        pt_l = self._part_leaves(len(m_l))
+        if all(pt is None for pt in pt_l):
+            return self.inner.state_specs(P(self.axis_name))
+        specs = treedef.unflatten(
+            [P((pt.axis, self.axis_name)) if pt is not None
+             else P(self.axis_name) for pt in pt_l])
+        return self.inner.state_specs(specs)
+
+    # ---- host-side layout conversions (partition-aware overrides) ------
+
+    def shard_params(self, params):
+        """Canonical-shape tree -> global flat padded tree. A partitioned
+        leaf splits along its mp dim FIRST, then each slice flattens and
+        pads to dp * chunk — the P((mp, dp)) placement order."""
+        self._require_meta()
+        p_l, treedef = jax.tree.flatten(params)
+        m_l = jax.tree.leaves(self.meta)
+        out = []
+        for p, m, pt in zip(p_l, m_l, self._part_leaves(len(p_l))):
+            if pt is None:
+                out.append(self._flat_leaf(p, m))
+            else:
+                chunk = self._chunk(pt.local_size)
+                pad = chunk * self.axis_size - pt.local_size
+                out.append(np.concatenate(
+                    [np.pad(s.reshape(-1), (0, pad)) for s in
+                     np.split(np.asarray(p), pt.count, axis=pt.dim)]))
+        return treedef.unflatten(out)
+
+    def unshard_host(self, host_tree):
+        """Host flat padded arrays -> canonical shapes (checkpoint write
+        path); inverse of :meth:`shard_params`."""
+        self._require_meta()
+        x_l, treedef = jax.tree.flatten(host_tree)
+        m_l = jax.tree.leaves(self.meta)
+        out = []
+        for x, m, pt in zip(x_l, m_l, self._part_leaves(len(x_l))):
+            if pt is None:
+                out.append(self._unflat_leaf(x, m))
+            else:
+                rows = np.asarray(x).reshape(pt.count, -1)
+                out.append(np.concatenate(
+                    [r[:pt.local_size].reshape(pt.local_shape)
+                     for r in rows], axis=pt.dim))
+        return treedef.unflatten(out)
 
     def apply(self, params, grads, opt_state):
         """One sharded step. Call inside shard_map over ``axis_name`` with

@@ -285,11 +285,6 @@ class LMTrainer(_MeshTrainer):
                     "opt_sharding='zero1' is redundant under "
                     "param_sharding='fsdp' (ZeRO-3 already shards the "
                     "optimizer state)")
-            if self.tp > 1 or self.ep > 1:
-                raise ValueError(
-                    "opt_sharding='zero1' shards over dp and does not "
-                    "compose with tensor (mp) or expert (ep) sharding; "
-                    "use dp x sp meshes")
             from tpu_ddp.ops.optim import Adafactor
             from tpu_ddp.parallel.zero import FactoredZeRO1, ZeRO1
             self._params_template = jax.eval_shape(
@@ -299,11 +294,26 @@ class LMTrainer(_MeshTrainer):
             # SGD) takes the flat one. An unknown factored optimizer
             # fails loudly in ZeRO1's map_param_like rather than being
             # silently re-laid-out wrong.
-            wrapper = (FactoredZeRO1 if isinstance(self.optimizer,
-                                                   Adafactor)
-                       else ZeRO1)
-            self.optimizer = wrapper(self.optimizer, DATA_AXIS, self.dp,
-                                     template=self._params_template)
+            if isinstance(self.optimizer, Adafactor):
+                if self.tp > 1 or self.ep > 1:
+                    raise ValueError(
+                        "opt_sharding='zero1' with Adafactor shards over "
+                        "full-leaf row geometry and does not compose "
+                        "with tensor (mp) or expert (ep) sharding; use "
+                        "AdamW for tp/ep-sharded models")
+                self.optimizer = FactoredZeRO1(
+                    self.optimizer, DATA_AXIS, self.dp,
+                    template=self._params_template)
+            else:
+                # Elementwise optimizers compose with tp/ep: each
+                # mp/ep-sharded leaf's state is laid out per model-
+                # parallel cell and dp-sharded within it
+                # (tpu_ddp/parallel/zero.py ZeRO1 docstring).
+                self.optimizer = ZeRO1(
+                    self.optimizer, DATA_AXIS, self.dp,
+                    template=self._params_template,
+                    param_specs=self.model.param_specs(),
+                    mesh_axis_sizes=dict(mesh.shape))
         if self.is_fsdp:
             from tpu_ddp.parallel.zero import ZeRO3
             self._params_template = jax.eval_shape(
@@ -462,10 +472,24 @@ class LMTrainer(_MeshTrainer):
             return params, opt_state, local_mean.reshape(1, 1)
 
         if self.opt_zero1:
-            # Mean over sp here (ep is 1 by construction); the ZeRO
-            # wrapper's psum_scatter performs the dp half of the sync
-            # and computes its own decay mask from the full leaves.
-            grads = jax.tree.map(lambda g: lax.pmean(g, SEQ_AXIS), grads)
+            # Sync over the non-dp data axes here; the ZeRO wrapper's
+            # psum_scatter performs the dp half (and computes its own
+            # decay mask from the full local leaves). Same per-leaf
+            # algebra as _sync_grads with DATA_AXIS delegated: an
+            # ep-sharded expert leaf's gradient already holds the SUM of
+            # the ep token shards (backward all_to_all), so its mean
+            # over the excluded axis is a plain division.
+            def zleaf(g, spec):
+                sharded = _spec_axes(spec)
+                sync = tuple(a for a in (SEQ_AXIS, EXPERT_AXIS)
+                             if a not in sharded)
+                if sync:
+                    g = lax.pmean(g, sync)
+                excluded = int(np.prod([self.mesh.shape[a]
+                                        for a in (SEQ_AXIS, EXPERT_AXIS)
+                                        if a in sharded]))
+                return g / excluded if excluded > 1 else g
+            grads = jax.tree.map(zleaf, grads, self._param_specs)
             params, opt_state = self.optimizer.apply(params, grads,
                                                      opt_state)
             return params, opt_state, local_mean.reshape(1, 1)
