@@ -113,8 +113,10 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
     cfg = TrainConfig.preset(config)
     if batch_size is None:
         batch_size = cfg.global_batch_size
+    import jax.numpy as jnp
     model = get_model(cfg.model, num_classes=cfg.num_classes,
-                      use_pallas_bn=cfg.pallas_bn)
+                      use_pallas_bn=cfg.pallas_bn,
+                      compute_dtype=jnp.dtype(cfg.compute_dtype))
     # part3-equivalent (flagship) configuration: fused DP step, pinned to
     # exactly ONE chip so the per-chip metric stays honest on multi-chip
     # hosts (the pmean over a 1-slot axis degenerates gracefully).

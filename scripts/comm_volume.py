@@ -1,0 +1,186 @@
+"""Communication-volume ladder: collectives + bytes per step, per rung.
+
+The platform-independent analogue of the reference's scaling analysis
+(CS744__Assignment_2.pdf §2.2.2 ring-reduce cost / §3.1 figures 2-4,
+round-3 verdict item 4): instead of wall-clock scaling curves — which a
+one-chip, one-core host cannot produce in kind — extract what each DP
+rung actually PUTS ON THE WIRE from its compiled HLO. This is a
+measurable claim about the programs themselves: gather/scatter's root
+asymmetry, all-reduce == reduce_scatter + all_gather byte identity for
+ZeRO, FSDP's per-leaf gather/scatter pairs.
+
+For every rung of the ladder (part1..part5) the jitted train step is
+compiled for an 8-device virtual CPU mesh at the reference's global
+batch, the HLO is scanned for collective ops, and each op's payload
+size is recorded along with its ring-algorithm wire cost per device:
+
+- all-reduce:          2 * (N-1)/N * payload   (reduce-scatter + gather)
+- reduce-scatter:          (N-1)/N * input payload
+- all-gather:              (N-1)/N * output payload
+- all-to-all:              (N-1)/N * payload
+- collective-permute:                payload   (one neighbor hop)
+
+Writes ``experiments/comm_volume.json`` and prints a markdown table
+(pasted into EXPERIMENTS.md §5).
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+           python scripts/comm_volume.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather",
+                "all-to-all", "collective-permute")
+
+# One HLO instruction: "%name = <shape> op-name(...)" where <shape> is
+# "f32[a,b]{layout}" or a tuple "(f32[a]{0}, f32[b]{0})".
+_INSTR = re.compile(
+    r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^ ]*)\s+"
+    r"(" + "|".join(_COLLECTIVES) + r")(?:-start)?\(")
+
+_SHAPE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def _shape_bytes(shape_str: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue  # e.g. token[] / opaque
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_volume(hlo_text: str, n_devices: int) -> dict:
+    """Scan compiled HLO for collective ops; payload + ring wire bytes.
+
+    Uses each op's RESULT shape as the payload (for all-reduce and
+    collective-permute result == operand; for reduce-scatter the input
+    is result * N; for all-gather the result already is the gathered
+    size — the ring formulas below account for each case).
+    """
+    ops: dict = {k: {"count": 0, "payload_bytes": 0} for k in _COLLECTIVES}
+    for m in _INSTR.finditer(hlo_text):
+        shape_str, op = m.group(1), m.group(2)
+        b = _shape_bytes(shape_str)
+        ops[op]["count"] += 1
+        ops[op]["payload_bytes"] += b
+    frac = (n_devices - 1) / n_devices
+    wire = 0.0
+    for op, rec in ops.items():
+        if op == "all-reduce":
+            rec["wire_bytes_per_device"] = 2 * frac * rec["payload_bytes"]
+        elif op == "reduce-scatter":
+            # result is the 1/N shard; input payload = result * N.
+            rec["wire_bytes_per_device"] = (frac * rec["payload_bytes"]
+                                            * n_devices)
+        elif op == "all-gather":
+            rec["wire_bytes_per_device"] = frac * rec["payload_bytes"]
+        elif op == "all-to-all":
+            rec["wire_bytes_per_device"] = frac * rec["payload_bytes"]
+        else:  # collective-permute: one neighbor hop
+            rec["wire_bytes_per_device"] = float(rec["payload_bytes"])
+        wire += rec["wire_bytes_per_device"]
+    ops = {k: v for k, v in ops.items() if v["count"]}
+    return {"ops": ops, "total_wire_bytes_per_device": wire,
+            "total_collectives": sum(v["count"] for v in ops.values())}
+
+
+def _rung_hlo(strategy: str, n_devices: int) -> tuple[str, int]:
+    """Compile one ladder rung's train step; (hlo_text, param_bytes)."""
+    import numpy as np
+
+    import jax
+
+    from tpu_ddp.models import get_model
+    from tpu_ddp.parallel.mesh import make_mesh
+    from tpu_ddp.train.engine import Trainer
+    from tpu_ddp.utils.config import TrainConfig
+
+    mesh = make_mesh(jax.devices()[:n_devices])
+    cfg = TrainConfig()
+    model = get_model(cfg.model, num_classes=cfg.num_classes)
+    trainer = Trainer(model, cfg, strategy=strategy, mesh=mesh)
+    state = trainer.init_state()
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(cfg.global_batch_size, cfg.image_size,
+                                   cfg.image_size, 3)).astype(np.uint8)
+    y = rng.integers(0, cfg.num_classes,
+                     size=cfg.global_batch_size).astype(np.int32)
+    xb, yb, wb = trainer.put_batch(x, y)
+    lowered = trainer._train_step.lower(state.params, state.opt_state,
+                                        xb, yb, wb)
+    hlo = lowered.compile().as_text()
+    param_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(state.params))
+    return hlo, param_bytes
+
+
+def main(n_devices: int = 8) -> dict:
+    from tpu_ddp.parallel.sync import PART_TO_STRATEGY
+
+    results = {}
+    for part, strategy in sorted(PART_TO_STRATEGY.items()):
+        hlo, param_bytes = _rung_hlo(strategy, n_devices)
+        vol = collective_volume(hlo, n_devices)
+        vol["strategy"] = strategy
+        vol["param_bytes"] = param_bytes
+        results[part] = vol
+        print(f"[comm_volume] {part} ({strategy}): "
+              f"{vol['total_collectives']} collectives, "
+              f"{vol['total_wire_bytes_per_device'] / 1e6:.2f} MB/device",
+              file=sys.stderr)
+    out = {"n_devices": n_devices, "model": "VGG11/CIFAR-10",
+           "note": "collectives per optimizer step from compiled HLO; "
+                   "wire bytes use the ring-algorithm cost model",
+           "rungs": results}
+    os.makedirs(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "experiments"), exist_ok=True)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "experiments", "comm_volume.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"[comm_volume] wrote {path}", file=sys.stderr)
+
+    # Markdown table for EXPERIMENTS.md.
+    print("| part | strategy | collectives | ops | wire MB/device |")
+    print("|---|---|---|---|---|")
+    for part, vol in results.items():
+        ops = ", ".join(f"{k} x{v['count']}" for k, v in vol["ops"].items())
+        print(f"| {part} | {vol['strategy']} | "
+              f"{vol['total_collectives']} | {ops or '-'} | "
+              f"{vol['total_wire_bytes_per_device'] / 1e6:.2f} |")
+    return out
+
+
+if __name__ == "__main__":
+    # Force the virtual CPU mesh BEFORE any backend touch (the site hook
+    # pre-imports jax with platform axon,cpu; parts/common.py pattern).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    if jax.config.jax_platforms != "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    main(int(os.environ.get("N_DEVICES", "8")))

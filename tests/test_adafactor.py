@@ -183,10 +183,14 @@ def _sharded_adafactor_step(mesh, wrapper, params, per_worker_grads,
         g = jax.tree.map(lambda x: x[0], g)  # my worker's grad tree
         return wrapper.apply(p, g, state)
 
-    mapped = jax.shard_map(
+    # jit, not eager: an un-jitted shard_map dispatches the wrapper's
+    # hundreds of per-leaf collective ops one by one (~15 s per call on
+    # this 1-core host, measured); jitted, the compile lands in the
+    # persistent cache and repeat calls are milliseconds.
+    mapped = jax.jit(jax.shard_map(
         step, mesh=mesh,
         in_specs=(P(), specs, P(DATA_AXIS)),
-        out_specs=(P(), specs), check_vma=False)
+        out_specs=(P(), specs), check_vma=False))
     state_sh = jax.device_put(
         opt_state, jax.tree.map(
             lambda s: NamedSharding(mesh, s), specs,

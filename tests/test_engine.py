@@ -156,6 +156,46 @@ class TestShardedEval:
         np.testing.assert_allclose(shrd["test_loss"], repl["test_loss"],
                                    rtol=1e-5)
 
+    def test_loader_weight_triples_mask_examples(self, devices):
+        """(images, labels, weights) triples from a process-sharded
+        loader: weight-0 rows contribute nothing to loss/correct/seen —
+        evaluating a batch with its tail zero-weighted equals evaluating
+        the batch without the tail."""
+        tr = self._mesh_trainer(devices)
+        state = tr.init_state()
+        rng = np.random.default_rng(5)
+        x = rng.normal(0, 0.1, size=(16, 4, 4, 3)).astype(np.float32)
+        y = rng.integers(0, 10, size=16).astype(np.int32)
+        w = np.ones(16, np.float32)
+        w[12:] = 0.0  # the sampler wrap-padding marker
+        masked = tr.evaluate(state, [(x, y, w)], log=lambda s: None,
+                             sharded=True)
+        plain = tr.evaluate(state, [(x[:12], y[:12])],
+                            log=lambda s: None, sharded=True)
+        assert masked["seen"] == plain["seen"] == 12
+        assert masked["correct"] == plain["correct"]
+        np.testing.assert_allclose(masked["test_loss"],
+                                   plain["test_loss"], rtol=1e-5)
+
+    def test_replicated_eval_honors_weight_triples(self, devices):
+        """A weights-carrying loader fed to the REPLICATED eval must not
+        count wrap-padding rows as real examples (they are dropped
+        host-side), matching the sharded path's masking."""
+        tr = self._mesh_trainer(devices)
+        state = tr.init_state()
+        rng = np.random.default_rng(6)
+        x = rng.normal(0, 0.1, size=(16, 4, 4, 3)).astype(np.float32)
+        y = rng.integers(0, 10, size=16).astype(np.int32)
+        w = np.ones(16, np.float32)
+        w[12:] = 0.0
+        repl = tr.evaluate(state, [(x, y, w)], log=lambda s: None)
+        plain = tr.evaluate(state, [(x[:12], y[:12])],
+                            log=lambda s: None)
+        assert repl["seen"] == plain["seen"] == 12
+        assert repl["correct"] == plain["correct"]
+        np.testing.assert_allclose(repl["test_loss"],
+                                   plain["test_loss"], rtol=1e-6)
+
     def test_matches_replicated_under_fsdp(self, devices):
         tr = self._mesh_trainer(devices, strategy="fsdp")
         state = tr.init_state()

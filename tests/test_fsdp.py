@@ -15,7 +15,7 @@ from tpu_ddp.utils.config import TrainConfig
 from jax.sharding import PartitionSpec as P
 
 
-def _batch(n=16, seed=0):
+def _batch(n=8, seed=0):  # 8 = smallest slot-divisible batch (dp=4); halves 1-core step time
     rng = np.random.default_rng(seed)
     return (rng.normal(size=(n, 32, 32, 3)).astype(np.float32),
             rng.integers(0, 10, size=n).astype(np.int32))

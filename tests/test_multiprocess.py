@@ -221,3 +221,24 @@ def test_two_process_lm_zero1_adafactor():
         assert len(losses) == 5, out
         assert all(math.isfinite(x) for x in losses), losses
         assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.slow
+def test_two_process_sharded_eval():
+    """Process-sharded evaluation (round-3 verdict item 8): the test set
+    shards by process in the loader (wrap-pad rows weight 0), per-shard
+    sums psum over dp, and the metrics equal the replicated eval's.
+    synth 320 -> 320-example test set divisible by batch*world, so the
+    example asserts tight loss equality too (its internal asserts fail
+    the ranks if violated)."""
+    res = launch("examples/sharded_eval.py", nproc=2,
+                 env={"TPU_DDP_SYNTH_SIZE": "320",
+                      "TPU_DDP_GLOBAL_BATCH": "16"},
+                 echo=False, timeout=600)
+    assert res.ok, "\n".join(w.output for w in res.workers)
+    for rank in (0, 1):
+        out = res.output_of(rank)
+        assert "agreement ok" in out, out
+        # Both evals ran and printed the reference-format line.
+        assert "[replicated] Test set: average loss" in out
+        assert "[sharded] Test set: average loss" in out

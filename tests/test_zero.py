@@ -16,7 +16,7 @@ from tpu_ddp.train.engine import Trainer
 from tpu_ddp.utils.config import TrainConfig
 
 
-def _batch(n=16, seed=0):
+def _batch(n=8, seed=0):  # 8 = smallest slot-divisible batch (dp=4); halves 1-core step time
     rng = np.random.default_rng(seed)
     x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
     y = rng.integers(0, 10, size=n).astype(np.int32)
@@ -272,3 +272,102 @@ class TestZeRO1ModelParallel:
             LMTrainer(model, mesh,
                       optimizer=Adafactor(min_dim_size_to_factor=8),
                       opt_sharding="zero1")
+
+
+class TestZeRO1Pipeline:
+    """ZeRO-1 under pipeline parallelism (round-3 verdict item 9):
+    stacked block leaves' optimizer state shards P((pp, dp))."""
+
+    def _run(self, devices, sharding, schedule="gpipe", steps=2):
+        import jax.numpy as jnp
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.train.lm import PipelineLMTrainer, make_lm_batch
+
+        model = make_transformer("TransformerLM-tiny", max_seq_len=16,
+                                 compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:4], dp=2, pp=2)
+        tr = PipelineLMTrainer(model, mesh, num_micro=2,
+                               optimizer=AdamW(), schedule=schedule,
+                               opt_sharding=sharding)
+        tokens = np.random.default_rng(21).integers(0, 1024, size=(4, 17))
+        state = tr.init_state(seed=0)
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        losses = []
+        for _ in range(steps):
+            state, loss = tr.train_step(state, x, y)
+            losses.append(float(np.mean(np.asarray(loss))))
+        return tr, state, losses
+
+    def test_pp_zero1_matches_replicated_opt(self, devices):
+        _, s_repl, l_repl = self._run(devices, "replicated")
+        _, s_zero, l_zero = self._run(devices, "zero1")
+        np.testing.assert_allclose(l_zero, l_repl, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(jax.device_get(s_repl.params)),
+                        jax.tree.leaves(jax.device_get(s_zero.params))):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_pp_zero1_state_layout(self, devices):
+        from tpu_ddp.parallel.mesh import PIPE_AXIS
+        tr, state, _ = self._run(devices, "zero1", steps=1)
+        mu = state.opt_state["mu"]
+        blk_leaf = jax.tree.leaves(mu["blocks"])[0]
+        assert blk_leaf.sharding.spec == P((PIPE_AXIS, DATA_AXIS))
+        assert mu["embed"].sharding.spec == P(DATA_AXIS)
+        # One (pp, dp) cell owns 1/4 of a stacked leaf's state.
+        assert (blk_leaf.addressable_shards[0].data.size
+                == blk_leaf.size // 4)
+
+    def test_pp_zero1_decay_mask_matches_dense_policy(self, devices):
+        """Stacked (L, dm) LayerNorm scales must stay decay-exempt under
+        the flat ZeRO layout (rank+1 would otherwise flip the policy):
+        covered by exact param agreement, asserted here on LN leaves."""
+        _, s_repl, _ = self._run(devices, "replicated", steps=2)
+        _, s_zero, _ = self._run(devices, "zero1", steps=2)
+        ln_r = jax.device_get(s_repl.params["blocks"]["ln1"]["scale"])
+        ln_z = jax.device_get(s_zero.params["blocks"]["ln1"]["scale"])
+        np.testing.assert_allclose(np.asarray(ln_z), np.asarray(ln_r),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_pp_zero1_1f1b(self, devices):
+        """The hand-scheduled 1F1B backward feeds the same ZeRO update."""
+        _, s_repl, l_repl = self._run(devices, "replicated",
+                                      schedule="1f1b")
+        _, s_zero, l_zero = self._run(devices, "zero1", schedule="1f1b")
+        np.testing.assert_allclose(l_zero, l_repl, rtol=1e-5)
+
+    def test_pp_zero1_checkpoint_into_replicated(self, devices,
+                                                 tmp_path):
+        import jax.numpy as jnp
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.train.lm import PipelineLMTrainer, make_lm_batch
+
+        tr, state, _ = self._run(devices, "zero1", steps=1)
+        tokens = np.random.default_rng(22).integers(0, 1024, size=(4, 17))
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        tr.save_checkpoint(str(tmp_path), state)
+        cont, _ = tr.train_step(state, x, y)
+
+        model = make_transformer("TransformerLM-tiny", max_seq_len=16,
+                                 compute_dtype=jnp.float32)
+        repl = PipelineLMTrainer(model,
+                                 make_mesh(jax.devices()[:4], dp=2, pp=2),
+                                 num_micro=2, optimizer=AdamW())
+        resumed = repl.restore_checkpoint(str(tmp_path))
+        resumed, _ = repl.train_step(resumed, x, y)
+        for a, b in zip(jax.tree.leaves(jax.device_get(cont.params)),
+                        jax.tree.leaves(jax.device_get(resumed.params))):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_pp_zero1_tp_refused(self, devices):
+        import jax.numpy as jnp
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.train.lm import PipelineLMTrainer
+
+        model = make_transformer("TransformerLM-tiny", max_seq_len=16,
+                                 compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:8], dp=2, mp=2, pp=2)
+        with pytest.raises(ValueError, match="tp must be 1"):
+            PipelineLMTrainer(model, mesh, num_micro=2,
+                              opt_sharding="zero1")

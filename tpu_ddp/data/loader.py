@@ -37,6 +37,7 @@ class DataLoader:
         seed: int = SEED,
         mean: np.ndarray = CIFAR10_MEAN,
         std: np.ndarray = CIFAR10_STD,
+        with_weights: bool = False,
     ):
         self.images_u8 = images_u8
         self.labels = np.asarray(labels, dtype=np.int32)
@@ -47,6 +48,9 @@ class DataLoader:
         self.epoch = 0
         self.mean = np.asarray(mean, np.float32)
         self.std = np.asarray(std, np.float32)
+        # True -> yield (images, labels, weights) triples, weight 0 on
+        # sampler wrap-padding rows (the process-sharded eval contract).
+        self.with_weights = with_weights
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -61,15 +65,26 @@ class DataLoader:
         return (n + self.batch_size - 1) // self.batch_size
 
     def __iter__(self):
-        idx = (self.sampler.indices() if self.sampler is not None
-               else np.arange(len(self.labels)))
+        if self.sampler is not None:
+            idx, valid = self.sampler.indices_and_valid()
+        else:
+            idx = np.arange(len(self.labels))
+            valid = np.ones(len(idx), bool)
         rng = np.random.default_rng((self.seed, self.epoch))
         for start in range(0, len(idx), self.batch_size):
             sel = idx[start:start + self.batch_size]
             imgs = self.images_u8[sel]
             if self.augment:
                 imgs = random_crop_flip(imgs, rng)
-            yield normalize(imgs, self.mean, self.std), self.labels[sel]
+            batch = (normalize(imgs, self.mean, self.std),
+                     self.labels[sel])
+            if self.with_weights:
+                # Sampler wrap-padding duplicates carry weight 0 — the
+                # process-sharded eval contract (each example counted
+                # once globally; tpu_ddp/train/engine.py:evaluate).
+                batch += (valid[start:start + self.batch_size]
+                          .astype(np.float32),)
+            yield batch
 
 
 def _pick_loader_cls(native: bool | None):
@@ -95,6 +110,7 @@ def create_data_loaders(
     seed: int = SEED,
     synthetic_size: int | None = None,
     native: bool | None = None,
+    shard_eval: bool = False,
 ):
     """(train_loader, test_loader), the reference's L4 facade.
 
@@ -102,7 +118,10 @@ def create_data_loaders(
     passes ``int(256/world_size)`` in (part2/part2b/main.py:177). Train is
     sharded by rank with DistributedSampler semantics (``shuffle=False,
     drop_last=False``, part2/part2b/main.py:78-79); test is unsharded so
-    every node evaluates the full set (part2/part2b/main.py:89-93).
+    every node evaluates the full set (part2/part2b/main.py:89-93) —
+    unless ``shard_eval=True``, which shards the test set by rank too and
+    yields (images, labels, weights) triples (wrap-padding rows weight 0)
+    for ``Trainer.evaluate(sharded=True)`` in multi-process runs.
     """
     train_x, train_y, meta = load_cifar10(root, "train", synthetic_size)
     test_x, test_y, _ = load_cifar10(
@@ -119,5 +138,16 @@ def create_data_loaders(
     loader_cls = _pick_loader_cls(native)
     train_loader = loader_cls(train_x, train_y, batch_size,
                               sampler=sampler, augment=True, seed=seed)
-    test_loader = loader_cls(test_x, test_y, batch_size, augment=False)
+    if shard_eval and world_size > 1:
+        # Weights ride only the numpy DataLoader (eval is unaugmented;
+        # the native pipeline's decode threads buy nothing here).
+        test_loader = DataLoader(
+            test_x, test_y, batch_size,
+            sampler=DistributedShardSampler(
+                len(test_y), num_replicas=world_size, rank=rank,
+                shuffle=False, drop_last=False),
+            augment=False, with_weights=True)
+    else:
+        test_loader = loader_cls(test_x, test_y, batch_size,
+                                 augment=False)
     return train_loader, test_loader

@@ -53,6 +53,17 @@ class DistributedShardSampler:
         self.epoch = epoch
 
     def indices(self) -> np.ndarray:
+        return self.indices_and_valid()[0]
+
+    def indices_and_valid(self) -> tuple[np.ndarray, np.ndarray]:
+        """(this rank's indices, bool validity mask).
+
+        ``valid[i]`` is False exactly for the wrap-padding duplicates
+        (positions past ``dataset_len`` in the padded global list) —
+        the rows a process-sharded EVAL must weight 0 so each test
+        example counts once globally, while every rank still yields
+        equal-shaped shards (the multi-process global-array assembly
+        contract, tpu_ddp/parallel/mesh.py:put_sharded)."""
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
             idx = rng.permutation(self.dataset_len)
@@ -66,7 +77,9 @@ class DistributedShardSampler:
             idx = np.concatenate([idx, np.tile(idx, reps)[:pad]])
         else:
             idx = idx[: self.total_size]
-        return idx[self.rank :: self.num_replicas]
+        valid = np.arange(self.total_size) < self.dataset_len
+        return (idx[self.rank :: self.num_replicas],
+                valid[self.rank :: self.num_replicas])
 
     def __iter__(self):
         return iter(self.indices())

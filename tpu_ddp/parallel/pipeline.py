@@ -295,13 +295,33 @@ def pipeline_1f1b_grads(model, params, inputs, targets, *, pp_size: int,
 
         # ---- loss + its cotangent at the last stage (same tick: the
         # last stage's backward microbatch b equals its forward f).
+        # lax.cond, not masking: under shard_map the predicate is
+        # device-varying, so non-last stages (and the last stage's
+        # ramp-up/drain ticks) genuinely SKIP the ln_f+head forward and
+        # vjp — at real vocab sizes that B/M*L*dm*V matmul pair per tick
+        # would otherwise run S*T/M times more than the GPipe path's
+        # once-per-microbatch head cost (round-2 advisor finding). Safe
+        # because head_loss contains no collectives.
         tgt = lax.dynamic_index_in_dim(tmicro, f_safe, 0, keepdims=False)
-        nll_sum, head_vjp = jax.vjp(
-            lambda hp, yy: head_loss(hp, yy, tgt), head_params, y)
-        d_hp, dy_head = head_vjp(jnp.float32(1.0))
         at_last = stage == S - 1
-        loss_sum = loss_sum + jnp.where(at_last & f_valid, nll_sum, 0.0)
-        g_head = masked_add(g_head, d_hp, at_last & f_valid)
+
+        def head_fwd_bwd(y, tgt):
+            nll_sum, head_vjp = jax.vjp(
+                lambda hp, yy: head_loss(hp, yy, tgt), head_params, y)
+            d_hp, dy_head = head_vjp(jnp.float32(1.0))
+            return nll_sum, d_hp, dy_head
+
+        def head_skip(y, tgt):
+            return (jnp.float32(0.0),
+                    jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype),
+                                 head_params),
+                    jnp.zeros_like(y))
+
+        nll_sum, d_hp, dy_head = lax.cond(at_last & f_valid,
+                                          head_fwd_bwd, head_skip, y, tgt)
+        loss_sum = loss_sum + nll_sum
+        g_head = jax.tree.map(lambda a, gg: a + gg.astype(a.dtype),
+                              g_head, d_hp)
 
         # ---- backward micro-step: recompute-vjp from the saved input.
         x_saved = lax.dynamic_index_in_dim(buf, b_safe % K, 0,

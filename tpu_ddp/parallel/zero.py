@@ -214,6 +214,12 @@ class ZeRO1(_FlatLayout):
             self.part,
             is_leaf=lambda x: x is None or isinstance(x, _LeafPart))
 
+    def decay_mask(self, params):
+        """Inner optimizer's policy, passed through so trainers that
+        override the mask (pipeline stacked leaves) can query the
+        wrapper like they would the bare optimizer."""
+        return self.inner.decay_mask(params)
+
     def init(self, params):
         """Global flat state: inner state over (R * padded_local,) zero
         leaves (R = 1 for leaves with no model-parallel partition)."""
@@ -284,10 +290,15 @@ class ZeRO1(_FlatLayout):
                      for r in rows], axis=pt.dim))
         return treedef.unflatten(out)
 
-    def apply(self, params, grads, opt_state):
+    def apply(self, params, grads, opt_state, decay_mask=None):
         """One sharded step. Call inside shard_map over ``axis_name`` with
         ``grads`` UNSYNCED; returns (new_params, new_state) with params
-        full-size and synchronized (identical on every worker)."""
+        full-size and synchronized (identical on every worker).
+
+        ``decay_mask`` overrides the inner optimizer's policy — needed by
+        callers whose LOCAL leaves are re-laid-out (the pipeline trainer's
+        stacked blocks raise every leaf's rank by one, which would
+        otherwise weight-decay the (L, dm) LayerNorm scales)."""
         ax, n = self.axis_name, self.axis_size
         idx = lax.axis_index(ax)
 
@@ -310,7 +321,8 @@ class ZeRO1(_FlatLayout):
         # The decay policy must be evaluated on the ORIGINAL leaves (the
         # flat slices are all rank-1), so query the inner optimizer for
         # its mask rather than re-implementing its rule here.
-        mask = self.inner.decay_mask(params)
+        mask = (decay_mask if decay_mask is not None
+                else self.inner.decay_mask(params))
         new_p_sh, new_state = self.inner.apply(p_sh, g_sh, opt_state,
                                                decay_mask=mask)
 

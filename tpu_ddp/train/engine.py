@@ -85,8 +85,14 @@ class _LossWindow:
 
     def epoch_stats(self) -> dict:
         timer = self._timer
+        # timed_iters makes a steps_per_dispatch K that swallows most of
+        # the timing window VISIBLE in the metrics stream (a K-group
+        # that starts before timer.first_iter is deliberately untimed —
+        # keeping compile out of the window — so the average may rest on
+        # few samples; round-2 advisor finding).
         self._metrics.log("epoch", epoch=self._epoch, iters=self.iters,
                           avg_iter_s=timer.average_s,
+                          timed_iters=timer.count,
                           last_loss=round(self.last_loss, 5))
         return {
             "avg_iter_ns": timer.average_ns,
@@ -472,7 +478,7 @@ class Trainer:
 
     # ---- data placement ------------------------------------------------
 
-    def put_batch(self, images, labels):
+    def put_batch(self, images, labels, weights=None):
         """Place a host batch onto the mesh: batch axis sharded over dp.
 
         Returns ``(images, labels, weights)``. When the batch size is not
@@ -481,6 +487,10 @@ class Trainer:
         is wrap-padded to divisibility and the padding rows get weight 0 —
         the weighted loss in :meth:`_base_step` makes them exact no-ops.
 
+        ``weights`` (optional) are per-example validity weights from the
+        loader (process-sharded eval marks sampler wrap-padding rows 0);
+        default all-ones. Divisibility padding appends further zeros.
+
         Single process: ``images``/``labels`` are the global batch. Multi
         process: they are this process's shard of the global batch (the L4
         sampler already sharded them — shard sizes are symmetric across
@@ -488,7 +498,9 @@ class Trainer:
         """
         images = np.asarray(images)
         labels = np.asarray(labels)
-        weights = np.ones((len(labels),), np.float32)
+        weights = (np.ones((len(labels),), np.float32)
+                   if weights is None
+                   else np.asarray(weights, np.float32))
         if self.mesh is None:
             return jnp.asarray(images), jnp.asarray(labels), \
                 jnp.asarray(weights)
@@ -694,14 +706,18 @@ class Trainer:
             correct = lax.psum(
                 jnp.sum(weights * (jnp.argmax(logits, axis=-1) == labels)),
                 DATA_AXIS)
-            return loss_sum.reshape(1), correct.reshape(1)
+            # Global valid-example count: the denominator when loader
+            # weights mark sampler wrap-padding (process-sharded eval).
+            wsum = lax.psum(jnp.sum(weights), DATA_AXIS)
+            return (loss_sum.reshape(1), correct.reshape(1),
+                    wsum.reshape(1))
 
         # Params arrive REPLICATED (evaluate() materializes FSDP's flat
         # shards first), so one body serves every strategy.
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
             check_vma=False))
 
     def _materialize_params(self, params):
@@ -743,30 +759,51 @@ class Trainer:
         seen = 0
         n_batches = 0
         use_sharded = sharded and self.mesh is not None
-        if use_sharded and jax.process_count() > 1:
-            # The eval loader contract feeds EVERY process the full test
-            # set (reference part2/part2b/main.py:89-93); sharded eval
-            # would assemble each example process_count times and psum
-            # them all — metrics inflated by P. Refuse loudly rather
-            # than report >100% accuracy.
-            raise ValueError(
-                "evaluate(sharded=True) is single-process only: the "
-                "unsharded test loader gives every process the full set, "
-                "which the dp-psum would double-count. Use the default "
-                "replicated eval in multi-process runs.")
         if use_sharded and not hasattr(self, "_sharded_eval"):
             self._sharded_eval = self._build_sharded_eval()
         eval_params = self._materialize_params(state.params)
-        for images, labels in batches:
+        for batch in batches:
+            images, labels = batch[0], batch[1]
+            batch_w = batch[2] if len(batch) > 2 else None
+            if batch_w is not None and not use_sharded:
+                # A process-sharded loader's weight column marks the
+                # sampler's wrap-padding duplicates; a replicated eval
+                # must not count them as real examples — drop them
+                # host-side so the metrics stay per-shard-exact rather
+                # than silently inflated.
+                keep = np.asarray(batch_w) > 0
+                images, labels = images[keep], labels[keep]
+                batch_w = None
+                if len(labels) == 0:
+                    continue
             if use_sharded:
-                xb, yb, wb = self.put_batch(images, labels)
-                loss_sum, corr = self._sharded_eval(eval_params, xb, yb,
-                                                    wb)
-                n = len(labels)
-                total_loss += float(np.ravel(np.asarray(loss_sum))[0]) / n
-                correct += int(round(float(
-                    np.ravel(np.asarray(corr))[0])))
-                seen += n
+                if batch_w is None and jax.process_count() > 1:
+                    # The plain eval loader feeds EVERY process the full
+                    # test set (reference part2/part2b/main.py:89-93);
+                    # sharding that would psum each example P times.
+                    # A process-sharded loader announces itself by
+                    # yielding (images, labels, weights) triples —
+                    # create_data_loaders(shard_eval=True).
+                    raise ValueError(
+                        "evaluate(sharded=True) in a multi-process run "
+                        "needs a process-sharded eval loader (weights "
+                        "triples): create_data_loaders(shard_eval=True)"
+                        ". The default replicated loader would be "
+                        "double-counted by the dp-psum.")
+                xb, yb, wb = self.put_batch(images, labels, batch_w)
+                loss_sum, corr, wsum = self._sharded_eval(eval_params,
+                                                          xb, yb, wb)
+
+                # Outputs are dp-sharded global arrays whose shards all
+                # hold the same psum'd value; read the LOCAL shard (a
+                # whole-array np.asarray is impossible in multi-process,
+                # where some shards live on other processes).
+                def first_local(x):
+                    return float(np.ravel(x.addressable_shards[0].data)[0])
+                n = first_local(wsum)
+                total_loss += first_local(loss_sum) / max(n, 1.0)
+                correct += int(round(first_local(corr)))
+                seen += int(round(n))
                 n_batches += 1
                 continue
             if self.mesh is not None:

@@ -341,18 +341,25 @@ class LMTrainer(_MeshTrainer):
             return self._place_state(params, self.zero3.init(params))
         return self._place_state(params, self.optimizer.init(params))
 
-    def _sync_grads(self, grads):
+    def _sync_grads(self, grads, skip_axes=()):
         """Mean over the data axes, per leaf. A leaf sharded over ``ep``
         (stacked expert weights) owns its slice, so no ep-collective —
         BUT its gradient already holds the SUM over every token shard's
         contribution (the backward all_to_all delivered them), so the
         mean over those excluded axes becomes a plain division.
         mp-replicated leaves are already identical across mp by the
-        tensor-parallel backward construction (tp_input)."""
+        tensor-parallel backward construction (tp_input).
+
+        ``skip_axes``: data axes some OTHER mechanism synchronizes —
+        ZeRO-1 passes ``(DATA_AXIS,)`` because its psum_scatter IS the
+        dp half of the sync — kept out of the pmean here, one algebra
+        for every optimizer layout."""
         def leaf(g, spec):
             sharded = _spec_axes(spec)
-            sync = tuple(a for a in self._data_axes if a not in sharded)
-            g = lax.pmean(g, sync)
+            sync = tuple(a for a in self._data_axes
+                         if a not in sharded and a not in skip_axes)
+            if sync:
+                g = lax.pmean(g, sync)
             excluded = int(np.prod([self.mesh.shape[a]
                                     for a in self._data_axes
                                     if a in sharded]))
@@ -474,22 +481,8 @@ class LMTrainer(_MeshTrainer):
         if self.opt_zero1:
             # Sync over the non-dp data axes here; the ZeRO wrapper's
             # psum_scatter performs the dp half (and computes its own
-            # decay mask from the full local leaves). Same per-leaf
-            # algebra as _sync_grads with DATA_AXIS delegated: an
-            # ep-sharded expert leaf's gradient already holds the SUM of
-            # the ep token shards (backward all_to_all), so its mean
-            # over the excluded axis is a plain division.
-            def zleaf(g, spec):
-                sharded = _spec_axes(spec)
-                sync = tuple(a for a in (SEQ_AXIS, EXPERT_AXIS)
-                             if a not in sharded)
-                if sync:
-                    g = lax.pmean(g, sync)
-                excluded = int(np.prod([self.mesh.shape[a]
-                                        for a in (SEQ_AXIS, EXPERT_AXIS)
-                                        if a in sharded]))
-                return g / excluded if excluded > 1 else g
-            grads = jax.tree.map(zleaf, grads, self._param_specs)
+            # decay mask from the full local leaves).
+            grads = self._sync_grads(grads, skip_axes=(DATA_AXIS,))
             params, opt_state = self.optimizer.apply(params, grads,
                                                      opt_state)
             return params, opt_state, local_mean.reshape(1, 1)
@@ -524,16 +517,20 @@ class PipelineLMTrainer(_MeshTrainer):
     The layer stack shards into ``pp`` stages (stacked block params,
     tpu_ddp/parallel/pipeline.py); each dp slice's batch is split into
     ``num_micro`` microbatches that stream through the stage ring.
-    Composes with tensor parallelism (mp > 1) and dropout (keys derive
+    Composes with tensor parallelism (mp > 1), dropout (keys derive
     from (microbatch, global layer), so masks are pipeline-geometry-
-    independent); sequence parallelism under the pipeline is not
-    supported (ring attention would rotate K/V inside every pipeline
-    tick — a composition this engine does not schedule).
+    independent), and ZeRO-1 optimizer-state sharding
+    (``opt_sharding="zero1"``: stacked leaves' state laid out
+    P((pp, dp)), replicated leaves' P(dp) — with tp = 1); sequence
+    parallelism under the pipeline is not supported (ring attention
+    would rotate K/V inside every pipeline tick — a composition this
+    engine does not schedule).
     """
 
     def __init__(self, model, mesh: Mesh, num_micro: int | None = None,
                  optimizer: AdamW | None = None, dropout_seed: int = 0,
-                 schedule: str = "gpipe"):
+                 schedule: str = "gpipe",
+                 opt_sharding: str = "replicated"):
         from tpu_ddp.parallel.pipeline import pipeline_param_specs
         self.mesh = mesh
         self.dp = mesh.shape[DATA_AXIS]
@@ -568,6 +565,36 @@ class PipelineLMTrainer(_MeshTrainer):
         # LMTrainer's (resume-exact); inert when dropout_rate == 0.
         self._dropout_key = jax.random.key(dropout_seed)
         self._param_specs = pipeline_param_specs(model)
+        # ZeRO-1 under pp (round-3 addition): optimizer state for the
+        # pp-sharded stacked block leaves is laid out P((pp, dp)) — each
+        # stage's slice dp-sharded — via the same partition-aware ZeRO1
+        # the LMTrainer uses for tp.
+        if opt_sharding not in ("replicated", "zero1"):
+            raise ValueError(f"unknown opt_sharding {opt_sharding!r}; "
+                             "choose 'replicated' or 'zero1'")
+        self.opt_zero1 = opt_sharding == "zero1"
+        if self.opt_zero1:
+            from tpu_ddp.ops.optim import Adafactor
+            from tpu_ddp.parallel.zero import ZeRO1
+            if isinstance(self.optimizer, Adafactor):
+                raise ValueError(
+                    "opt_sharding='zero1' with Adafactor does not "
+                    "compose with the pipeline's stacked-leaf layout; "
+                    "use AdamW/SGD")
+            if self.tp > 1:
+                raise ValueError(
+                    "opt_sharding='zero1' under pp composes with dp "
+                    "only (stacked leaves sharded over (pp, dp)); "
+                    "tp must be 1")
+            from tpu_ddp.parallel.pipeline import stack_block_params
+            self._params_template = jax.eval_shape(
+                lambda: stack_block_params(
+                    self.model.init(jax.random.key(0))))
+            self.optimizer = ZeRO1(
+                self.optimizer, DATA_AXIS, self.dp,
+                template=self._params_template,
+                param_specs=self._param_specs,
+                mesh_axis_sizes=dict(mesh.shape))
         self._opt_specs = self.optimizer.state_specs(self._param_specs)
         self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
         self._param_shardings = self._shardings(self._param_specs)
@@ -590,14 +617,16 @@ class PipelineLMTrainer(_MeshTrainer):
         proto["blocks"] = jax.tree.map(lambda p: p[0], params["blocks"])
         return self.optimizer.decay_mask(proto)
 
-    def _sync_grads(self, grads):
+    def _sync_grads(self, grads, skip_dp: bool = False):
         """Stacked block leaves are stage-local (mean over dp only);
         replicated leaves (embed/head/ln_f) got their real gradient on one
-        stage and zeros elsewhere — sum over pp reassembles it."""
+        stage and zeros elsewhere — sum over pp reassembles it.
+        ``skip_dp``: ZeRO-1 delegates the dp mean to its psum_scatter —
+        only the pp reassembly happens here."""
         def leaf(g, spec):
-            if PIPE_AXIS in tuple(spec):
-                return lax.pmean(g, DATA_AXIS)
-            return lax.pmean(lax.psum(g, PIPE_AXIS), DATA_AXIS)
+            if PIPE_AXIS not in tuple(spec):
+                g = lax.psum(g, PIPE_AXIS)
+            return g if skip_dp else lax.pmean(g, DATA_AXIS)
         return jax.tree.map(leaf, grads, self._param_specs)
 
     def _extra_in_specs(self) -> tuple:
@@ -644,9 +673,12 @@ class PipelineLMTrainer(_MeshTrainer):
 
             (_, local_mean), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-        grads = self._sync_grads(grads)
+        # Under ZeRO-1 only the pp half of the sync happens here (the
+        # wrapper's psum_scatter is the dp half); one shared apply.
+        grads = self._sync_grads(grads, skip_dp=self.opt_zero1)
         params, opt_state = self.optimizer.apply(
-            params, grads, opt_state, decay_mask=self._decay_mask(params))
+            params, grads, opt_state,
+            decay_mask=self._decay_mask(params))
         # Real chunk mean lives on the last stage; share it with everyone
         # (outside the differentiated path).
         mean = lax.psum(local_mean, PIPE_AXIS)

@@ -105,6 +105,15 @@ class TrainConfig:
         env_spd = os.environ.get("TPU_DDP_STEPS_PER_DISPATCH")
         if env_spd:
             self.steps_per_dispatch = int(env_spd)
+        # f32 end-to-end runs turn the bf16-rounding drift story into a
+        # measurement (run_experiments --dtype float32): bit-equivalent
+        # programs must then agree to f32 reduction-order tolerance.
+        env_cd = os.environ.get("TPU_DDP_COMPUTE_DTYPE")
+        if env_cd:
+            if env_cd not in ("bfloat16", "float32", "float16"):
+                raise ValueError(f"TPU_DDP_COMPUTE_DTYPE={env_cd!r}: "
+                                 "expected bfloat16|float32|float16")
+            self.compute_dtype = env_cd
         env_ck = os.environ.get("TPU_DDP_CKPT_EVERY")
         if env_ck:
             self.ckpt_every_iters = int(env_ck)
