@@ -13,7 +13,11 @@ timing harness.
 TPU-native extensions (no reference equivalent): one process automatically
 drives all of its local chips as dp slots, and env knobs
 (``TPU_DDP_MAX_ITERS``, ``TPU_DDP_GLOBAL_BATCH``, ``TPU_DDP_SYNTH_SIZE``)
-shrink a run for smoke tests.
+shrink a run for smoke tests; ``TPU_DDP_COMPUTE_DTYPE`` overrides the
+matmul dtype (f32 runs for drift measurement),
+``TPU_DDP_STEPS_PER_DISPATCH`` groups K optimizer steps per dispatch,
+and ``TPU_DDP_SHARD_EVAL=1`` opts into the process-sharded dp-psum'd
+evaluation (CIFAR path).
 """
 
 from __future__ import annotations
@@ -119,16 +123,25 @@ def run_part(part: str, argv=None):
     mesh = make_mesh() if distributed else None
     dp_size = mesh.shape["dp"] if mesh is not None else 1
 
+    # TPU_DDP_SHARD_EVAL=1: process-sharded test set + dp-psum'd eval
+    # (1/N per-device eval compute) instead of the reference's
+    # every-node-evaluates-everything semantics. CIFAR path only — the
+    # ImageNet loader keeps the replicated contract.
+    from tpu_ddp.utils.config import _env_bool
+    shard_eval = _env_bool("TPU_DDP_SHARD_EVAL", False)
     if cfg.dataset == "imagenet":
         from tpu_ddp.data.imagenet import create_imagenet_loaders
         train_loader, test_loader = create_imagenet_loaders(
             rank=rank, world_size=world_size, batch_size=batch_size,
             root=args.data_root, seed=cfg.seed,
             image_size=cfg.image_size, num_classes=cfg.num_classes)
+        shard_eval = False
     else:
         train_loader, test_loader = create_data_loaders(
             rank=rank, world_size=world_size, batch_size=batch_size,
-            root=args.data_root, seed=cfg.seed)
+            root=args.data_root, seed=cfg.seed,
+            shard_eval=shard_eval)
+        shard_eval = shard_eval and world_size > 1
 
     import jax.numpy as jnp
     model = get_model(cfg.model, num_classes=cfg.num_classes,
@@ -179,7 +192,7 @@ def run_part(part: str, argv=None):
             path = trainer.save_checkpoint(args.ckpt_dir, state)
             if path:
                 print(f"[{part}] checkpoint saved: {path}")
-        trainer.evaluate(state, test_loader)
+        trainer.evaluate(state, test_loader, sharded=shard_eval)
         print(f"[{part}] epoch {epoch}: avg iter "
               f"{stats['avg_iter_s']:.4f}s over {stats['timed_iters']} timed "
               f"iters; {stats['iters']} iters total")

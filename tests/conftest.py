@@ -30,7 +30,7 @@ jax.config.update("jax_platforms", "cpu")
 _cache_dir = os.environ.get("TPU_DDP_TEST_CACHE",
                             "/tmp/tpu_ddp_jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 import pytest  # noqa: E402
@@ -41,3 +41,28 @@ def devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+_TRAINER_CACHE: dict = {}
+
+
+def cached_vgg_trainer(devices, strategy, dp=4):
+    """Session-cached VGG Trainer per (strategy, dp) — construction
+    re-traces and reloads the compiled step from the persistent cache
+    (~1-2 s each on the 1-core CI host). Trainers hold no per-run
+    mutable state, so test modules share them and rebuild their own
+    TrainStates. Per-process, so safe under `pytest -n auto`."""
+    key = (strategy, dp)
+    if key not in _TRAINER_CACHE:
+        import numpy as np
+
+        from tpu_ddp.models import get_model
+        from tpu_ddp.parallel.mesh import make_mesh
+        from tpu_ddp.train.engine import Trainer
+        from tpu_ddp.utils.config import TrainConfig
+
+        mesh = make_mesh(devices[:dp])
+        model = get_model("VGG11", compute_dtype=np.float32)
+        _TRAINER_CACHE[key] = Trainer(model, TrainConfig(),
+                                      strategy=strategy, mesh=mesh)
+    return _TRAINER_CACHE[key]

@@ -27,6 +27,7 @@ class TestBenchEntry:
         assert abs(out["vs_baseline"] - out["value"] / 386.0) < 0.01
         assert out["extra"]["timed_iters"] == 2
 
+    @pytest.mark.slow  # ViT compile: model correctness lives in test_vit
     def test_vit_config(self):
         out = bench.run_bench(batch_size=8, timed_iters=2,
                               config="vit_cifar10",
@@ -39,7 +40,8 @@ class TestBenchEntry:
         # The ONE test that keeps with_xla_flops on (AOT cost-analysis
         # cross-check) — tiniest config, so the extra compile is cheap.
         out = bench.run_lm_bench(batch_size=2, seq_len=64, timed_iters=2,
-                                 with_decode=False)
+                                 with_decode=False,
+                                 model_name="TransformerLM-tiny")
         assert out["metric"] == "transformer_lm_tokens_per_sec_per_chip"
         assert out["unit"] == "tokens/sec"
         assert out["value"] > 0 and np.isfinite(out["value"])
@@ -66,7 +68,8 @@ class TestBenchEntry:
     def test_mfu_env_peak_override(self, monkeypatch):
         monkeypatch.setenv("TPU_DDP_PEAK_TFLOPS", "100")
         out = bench.run_lm_bench(batch_size=2, seq_len=64, timed_iters=1,
-                                 with_xla_flops=False, with_decode=False)
+                                 with_xla_flops=False, with_decode=False,
+                                 model_name="TransformerLM-tiny")
         ex = out["extra"]
         assert ex["peak_tflops_bf16"] == 100.0
         # Both fields are rounded (3 and 4 decimals) before comparison;

@@ -60,6 +60,7 @@ class TestModelDropout:
             np.asarray(model.apply(params, t, rng=jax.random.key(3))),
             np.asarray(model.apply(params, t)))
 
+    @pytest.mark.slow  # remat+dropout double compile; logic also covered by test_vit remat
     def test_remat_matches_dense_under_dropout(self):
         """jax.checkpoint must replay the SAME masks in the backward."""
         dense = _model(0.3)

@@ -33,6 +33,7 @@ class TestCachedForward:
         np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow  # one recompile per grown length: ~20s on 1 core
     def test_incremental_matches_full_recompute(self):
         """Decoding one token with the cache == rerunning apply on the
         extended sequence, at every step."""
@@ -61,9 +62,9 @@ class TestGenerate:
         params = model.init(jax.random.key(3))
         prompt = _prompt(b=2, L=6, seed=4)
         got = np.asarray(generate(model, params, prompt,
-                                  max_new_tokens=5))
+                                  max_new_tokens=3))
         seq = prompt.copy()
-        for _ in range(5):
+        for _ in range(3):
             logits = model.apply(params, jnp.asarray(seq))[:, -1]
             nxt = np.argmax(np.asarray(logits), axis=-1)
             seq = np.concatenate([seq, nxt[:, None]], axis=1)

@@ -72,6 +72,7 @@ def test_vgg_init_deterministic():
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow  # ResNet-50 fwd compile: minutes-scale on 1 core
 def test_resnet50_small_inputs_forward():
     model = resnet50(num_classes=10, small_inputs=True,
                      compute_dtype=jnp.float32)

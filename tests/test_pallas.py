@@ -138,6 +138,8 @@ class TestBatchNormRelu:
 
 
 class TestPallasTrainStep:
+    @pytest.mark.slow  # full VGG trainer compile; kernel exactness is
+    # TestFusedSGD's job — this only checks the cfg wiring end to end
     def test_trainer_with_pallas_sgd(self):
         """The fused optimizer works inside the full jitted train step."""
         from tpu_ddp.models import get_model
