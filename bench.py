@@ -358,7 +358,7 @@ def main() -> dict:
     # sweep runs until the MFU plateau (round-2 verdict: 2048 stopped
     # while MFU was still rising).
     sweep = {}
-    for bs in (1024, 2048, 4096, 8192):
+    for bs in (1024, 2048, 4096, 8192, 16384):
         r = _sub(run_bench, batch_size=bs, timed_iters=10,
                  config="vgg11_cifar10", end_to_end_iters=1,
                  with_xla_flops=False, with_multi_step=False)
@@ -379,8 +379,10 @@ def main() -> dict:
     # every matmul K,N >= 2048, head_dim 128. remat off — it fits at
     # batch 4, and the recomputed forward would burn 25% of counted MFU
     # (MFU counts 3x fwd; remat executes 4x). Measured on the v5e:
-    # batch 4 no-remat 0.513 MFU > batch 8 no-remat 0.457 (XLA spills)
-    # > batch 8 remat 0.399 > batch 4 remat 0.395.
+    # batch 4 no-remat 0.509-0.513 MFU > batch 6 (0.484; +vocab_chunk
+    # 0.471) > batch 8 no-remat 0.457 (XLA spills) > batch 8 remat
+    # 0.399 > batch 4 remat 0.395; non-flash attention fails to compile
+    # at this scale (the (B,H,L,L) score tensor).
     extra["configs"]["transformer_lm_large"] = _sub(
         run_lm_bench, model_name="TransformerLM-large", batch_size=4,
         timed_iters=10, with_decode=False,
