@@ -17,7 +17,9 @@ arrays by the trainer. Env knobs: TPU_DDP_LM_STEPS, TPU_DDP_LM_PRESET,
 TPU_DDP_LM_FSDP=1, TPU_DDP_GLOBAL_BATCH, TPU_DDP_LM_ACCUM (gradient-
 accumulation microbatches), TPU_DDP_LM_SP_MODE (ring|ulysses),
 TPU_DDP_LM_OPT (adamw|adafactor), TPU_DDP_LM_ZERO1=1 (ZeRO-1 optimizer
-state sharding — Adafactor uses the row-sharded FactoredZeRO1).
+state sharding — Adafactor uses the row-sharded FactoredZeRO1; with
+TPU_DDP_LM_TP>1 the elementwise wrapper lays tp-sharded leaves' state
+out P((mp, dp))), TPU_DDP_LM_TP (Megatron tensor-parallel extent).
 """
 
 import os
@@ -64,15 +66,20 @@ def main(argv=None) -> int:
     sp_mode = os.environ.get("TPU_DDP_LM_SP_MODE", "ring")
     zero1 = os.environ.get("TPU_DDP_LM_ZERO1", "0") == "1"
     opt_name = os.environ.get("TPU_DDP_LM_OPT", "adamw")
+    tp = int(os.environ.get("TPU_DDP_LM_TP", "1"))
     global_batch = int(os.environ.get("TPU_DDP_GLOBAL_BATCH", "8"))
-    if global_batch % world:
+    # The batch axis shards over dp PROCESS GROUPS (world // tp), not
+    # over every process: tp-group members feed the same rows.
+    dp_groups = max(world // max(tp, 1), 1)
+    if global_batch % dp_groups:
         raise ValueError(f"TPU_DDP_GLOBAL_BATCH={global_batch} not "
-                         f"divisible by world size {world}")
+                         f"divisible by dp process groups {dp_groups} "
+                         f"(world {world} / tp {tp})")
     seq_len = 32
 
     model = make_transformer(preset, max_seq_len=seq_len,
                              compute_dtype=np.float32)
-    mesh = make_mesh()
+    mesh = make_mesh(mp=tp)
     if opt_name == "adafactor":
         from tpu_ddp.ops.optim import Adafactor
         optimizer = Adafactor(min_dim_size_to_factor=8)
@@ -89,16 +96,22 @@ def main(argv=None) -> int:
         grad_accum=accum, sp_mode=sp_mode)
     state = trainer.init_state(seed=0)
     print(f"[lm_train] rank={rank} world={world} dp={trainer.dp} "
-          f"sp={trainer.sp} fsdp={fsdp} zero1={zero1} opt={opt_name} "
-          f"accum={accum} preset={preset}")
+          f"sp={trainer.sp} tp={trainer.tp} fsdp={fsdp} zero1={zero1} "
+          f"opt={opt_name} accum={accum} preset={preset}")
 
     # Deterministic synthetic tokens, identical on every process; each
     # process feeds ITS contiguous shard of the global batch.
     rng = np.random.default_rng(1234)
     tokens = rng.integers(0, model.vocab_size,
                           size=(global_batch, seq_len + 1))
-    local = tokens[rank * (global_batch // world):
-                   (rank + 1) * (global_batch // world)]
+    # Each PROCESS feeds its shard of the batch axis; with tp the
+    # batch only shards over dp = world/tp process groups, so processes
+    # in the same tp group feed the SAME rows (put_batch assembles by
+    # process index; dp-major mesh order makes rank // tp the dp slot).
+    # tp == 1 reduces to the plain per-rank split (slot == rank).
+    per = global_batch // dp_groups
+    slot = rank // max(tp, 1)
+    local = tokens[slot * per:(slot + 1) * per]
     x, y = trainer.put_batch(*make_lm_batch(local))
     for step in range(steps):
         state, loss = trainer.train_step(state, x, y)

@@ -242,3 +242,30 @@ def test_two_process_sharded_eval():
         # Both evals ran and printed the reference-format line.
         assert "[replicated] Test set: average loss" in out
         assert "[sharded] Test set: average loss" in out
+
+
+@pytest.mark.slow
+def test_four_process_lm_zero1_tensor_parallel():
+    """ZeRO-1 x tp across REAL process boundaries (round-3): a 4-process
+    dp2 x tp2 cluster where Megatron collectives AND the P((mp, dp))
+    optimizer-state psum_scatter/all_gather span processes. Ranks in the
+    same tp group hold the same dp shard -> identical loss streams."""
+    res = launch("examples/lm_train.py", nproc=4,
+                 env={"TPU_DDP_LM_STEPS": "3", "TPU_DDP_LM_TP": "2",
+                      "TPU_DDP_LM_ZERO1": "1",
+                      "TPU_DDP_GLOBAL_BATCH": "4"},
+                 echo=False, timeout=600)
+    assert res.ok, "\n".join(w.output for w in res.workers)
+    import re
+
+    def losses(rank):
+        return [m.group(1) for m in re.finditer(
+            r"step \d+/\d+ loss ([0-9.]+)", res.output_of(rank))]
+    for rank in range(4):
+        assert "dp=2 sp=1 tp=2" in res.output_of(rank)
+        assert "zero1=True" in res.output_of(rank)
+        assert len(losses(rank)) == 3
+    # tp groups (0,1) and (2,3) see the same tokens: identical losses.
+    assert losses(0) == losses(1)
+    assert losses(2) == losses(3)
+    assert losses(0) != losses(2)  # different dp shards

@@ -125,11 +125,17 @@ class _MeshTrainer:
         return put_sharded(array, sharding)
 
     @staticmethod
-    def _global_batch(local_b: int) -> int:
+    def _global_batch(local_b: int, shard_ways: int | None = None) -> int:
         """Divisibility constraints apply to the ASSEMBLED batch: in a
         multi-process launch each process's put_batch sees only its own
-        shard of the batch axis."""
-        return local_b * jax.process_count()
+        shard of the batch axis. ``shard_ways`` = how many ways the
+        batch axis is sharded (dp*ep for the LM trainer, dp for the
+        pipeline): processes in the same model-parallel group feed the
+        SAME rows, so the multiplier is capped at the shard count —
+        ``local_b * process_count`` alone would overcount by the tp/pp
+        replication factor and false-pass the divisibility checks."""
+        p = jax.process_count()
+        return local_b * (min(p, shard_ways) if shard_ways else p)
 
     # ---- checkpoint / resume (no reference equivalent, SURVEY.md §5) ---
 
@@ -497,7 +503,7 @@ class LMTrainer(_MeshTrainer):
         inputs = np.ascontiguousarray(inputs, np.int32)
         targets = np.ascontiguousarray(targets, np.int32)
         b, L = inputs.shape
-        gb = self._global_batch(b)
+        gb = self._global_batch(b, self.dp * self.ep)
         if gb % (self.dp * self.ep):
             raise ValueError(f"global batch {gb} not divisible by dp*ep="
                              f"{self.dp * self.ep}")
@@ -524,7 +530,12 @@ class PipelineLMTrainer(_MeshTrainer):
     P((pp, dp)), replicated leaves' P(dp) — with tp = 1); sequence
     parallelism under the pipeline is not supported (ring attention
     would rotate K/V inside every pipeline tick — a composition this
-    engine does not schedule).
+    engine does not schedule). Gradient accumulation needs no separate
+    mechanism here: ``num_micro`` IS accumulation — every microbatch's
+    gradient sums into one optimizer step, and raising it shrinks both
+    per-microbatch activation memory and (under 1F1B, where residency
+    is O(pp) regardless) the bubble — so the LMTrainer's ``grad_accum``
+    knob maps to ``num_micro`` under the pipeline.
     """
 
     def __init__(self, model, mesh: Mesh, num_micro: int | None = None,
@@ -688,7 +699,7 @@ class PipelineLMTrainer(_MeshTrainer):
         inputs = np.ascontiguousarray(inputs, np.int32)
         targets = np.ascontiguousarray(targets, np.int32)
         b = inputs.shape[0]
-        gb = self._global_batch(b)
+        gb = self._global_batch(b, self.dp)
         if gb % (self.dp * self.num_micro):
             raise ValueError(f"global batch {gb} not divisible by "
                              f"dp*num_micro={self.dp * self.num_micro}")
