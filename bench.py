@@ -388,13 +388,18 @@ def main() -> dict:
         run_lm_bench, model_name="TransformerLM-large", batch_size=4,
         timed_iters=10, with_decode=True,
         model_overrides={"remat_blocks": False})
-    # Long-context training (seq 8192, flash): the regime where the
-    # O(L*D)-memory kernel is the enabling piece; MFU is lower by
-    # construction (attention's share of FLOPs grows with L) and
-    # recorded honestly. Measured v5e: ~99k tok/s, 0.19 MFU.
+    # Long-context training (TransformerLM-large, seq 8192, flash): the
+    # regime where the O(L*D)-memory kernel is the enabling piece — the
+    # jnp attention path cannot even compile the O(L^2) score tensor
+    # here. batch 1, remat off (remat OOMs at this length; the no-remat
+    # step fits). Measured v5e: ~12.7k tok/s, 0.415 MFU — attention's
+    # FLOP share grows with L, so lower than the seq-2048 cell by
+    # construction. (The small model at seq 8192 sits at 0.19 MFU:
+    # d_model 512 leaves attention dominant.)
     extra["configs"]["transformer_lm_long"] = _sub(
-        run_lm_bench, batch_size=2, seq_len=8192, timed_iters=6,
-        with_xla_flops=False, with_decode=False)
+        run_lm_bench, model_name="TransformerLM-large", batch_size=1,
+        seq_len=8192, timed_iters=5, with_xla_flops=False,
+        with_decode=False, model_overrides={"remat_blocks": False})
     lm_flash = _sub(run_lm_bench, use_flash=True)
     lm_jnp = _sub(run_lm_bench, use_flash=False, timed_iters=10,
                   with_xla_flops=False)

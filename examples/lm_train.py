@@ -67,10 +67,12 @@ def main(argv=None) -> int:
     zero1 = os.environ.get("TPU_DDP_LM_ZERO1", "0") == "1"
     opt_name = os.environ.get("TPU_DDP_LM_OPT", "adamw")
     tp = int(os.environ.get("TPU_DDP_LM_TP", "1"))
+    if tp < 1:
+        raise ValueError(f"TPU_DDP_LM_TP={tp}: must be >= 1")
     global_batch = int(os.environ.get("TPU_DDP_GLOBAL_BATCH", "8"))
     # The batch axis shards over dp PROCESS GROUPS (world // tp), not
     # over every process: tp-group members feed the same rows.
-    dp_groups = max(world // max(tp, 1), 1)
+    dp_groups = max(world // tp, 1)
     if global_batch % dp_groups:
         raise ValueError(f"TPU_DDP_GLOBAL_BATCH={global_batch} not "
                          f"divisible by dp process groups {dp_groups} "
@@ -110,7 +112,7 @@ def main(argv=None) -> int:
     # process index; dp-major mesh order makes rank // tp the dp slot).
     # tp == 1 reduces to the plain per-rank split (slot == rank).
     per = global_batch // dp_groups
-    slot = rank // max(tp, 1)
+    slot = rank // tp
     local = tokens[slot * per:(slot + 1) * per]
     x, y = trainer.put_batch(*make_lm_batch(local))
     for step in range(steps):

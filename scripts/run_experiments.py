@@ -465,7 +465,7 @@ def render(out_path: Path | None = None) -> str:
                  "128", "img/s"),
                 ("transformer_lm", "TransformerLM-small, seq 2048, "
                  "flash", "tok/s"),
-                ("transformer_lm_long", "TransformerLM-small, seq 8192 "
+                ("transformer_lm_long", "TransformerLM-large, seq 8192 "
                  "(long context, flash)", "tok/s"),
                 ("transformer_lm_large", "TransformerLM-large (~740M, "
                  "head_dim 128), batch 4", "tok/s")):
