@@ -236,15 +236,116 @@ class TestLMFSDP:
                                    float(np.mean(np.asarray(l_src))),
                                    rtol=1e-5)
 
-    def test_rejects_tp_ep_composition(self, devices):
+    def test_rejects_bogus_mode(self, devices):
         from tpu_ddp.models.transformer import make_transformer
         from tpu_ddp.train.lm import LMTrainer
 
         model = make_transformer("TransformerLM-tiny", max_seq_len=32,
                                  compute_dtype=jnp.float32)
-        mesh = make_mesh(devices[:4], dp=2, sp=1, mp=2)
-        with pytest.raises(ValueError, match="fsdp"):
-            LMTrainer(model, mesh, param_sharding="fsdp")
         with pytest.raises(ValueError, match="param_sharding"):
             LMTrainer(model, make_mesh(devices[:2], dp=2),
                       param_sharding="bogus")
+
+
+class TestLMFSDPModelParallel:
+    """FSDP x tensor/expert parallelism (round-3 verdict item 3): each
+    mp/ep-sharded leaf's flat parameter layout is per model-parallel
+    cell, dp-sharded within it (P((mp..., dp)))."""
+
+    def _step(self, devices, mode, tokens, dp=2, sp=1, mp=1, ep=1,
+              model_name="TransformerLM-tiny", steps=2):
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.ops.optim import SGD
+        from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+
+        model = make_transformer(model_name, max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:dp * sp * mp * ep], dp=dp, sp=sp,
+                         mp=mp, ep=ep)
+        tr = LMTrainer(model, mesh, param_sharding=mode,
+                       optimizer=SGD(learning_rate=0.1, momentum=0.9,
+                                     weight_decay=1e-4))
+        state = tr.init_state(seed=5)
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        losses = []
+        for _ in range(steps):
+            state, loss = tr.train_step(state, x, y)
+            losses.append(float(np.mean(np.asarray(loss))))
+        return tr, state, losses
+
+    def _tokens(self, b=4, L=33, seed=19):
+        return np.random.default_rng(seed).integers(0, 1024, size=(b, L))
+
+    @pytest.mark.parametrize("dp,sp,mp", [(2, 1, 2), (2, 2, 2)])
+    def test_fsdp_tp_matches_replicated(self, devices, dp, sp, mp):
+        """Two fsdp steps on a dp x (sp x) tp mesh == the replicated
+        dp x tp step (step 2 exercises momentum through the
+        partition-aware flat layout)."""
+        tokens = self._tokens()
+        _, s_ref, l_ref = self._step(devices, "replicated", tokens,
+                                     dp=dp, sp=sp, mp=mp)
+        tr, s_fs, l_fs = self._step(devices, "fsdp", tokens,
+                                    dp=dp, sp=sp, mp=mp)
+        np.testing.assert_allclose(l_fs, l_ref, rtol=1e-4)
+        full = tr.zero3.unshard_host(jax.device_get(s_fs.params))
+        want = jax.device_get(s_ref.params)
+        for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(full)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=3e-4, atol=3e-5,
+                                       err_msg=f"dp={dp} sp={sp} mp={mp}")
+
+    def test_fsdp_tp_sharded_at_rest(self, devices):
+        """tp-sharded leaves lay out P((mp, dp)) — 1/(mp*dp) per device;
+        replicated leaves P(dp)."""
+        from tpu_ddp.parallel.mesh import MODEL_AXIS
+        tr, state, _ = self._step(devices, "fsdp", self._tokens(),
+                                  dp=2, mp=2, steps=1)
+        wo = state.params["blocks"][0]["wo"]
+        assert wo.ndim == 1
+        assert wo.sharding.spec == P((MODEL_AXIS, DATA_AXIS))
+        assert wo.addressable_shards[0].data.size == wo.size // 4
+        emb = state.params["embed"]
+        assert emb.sharding.spec == P(DATA_AXIS)
+        assert emb.addressable_shards[0].data.size == emb.size // 2
+
+    def test_fsdp_ep_moe_matches_replicated(self, devices):
+        """FSDP composes with expert parallelism: dp2 x ep2 MoE fsdp ==
+        the replicated run on the same mesh."""
+        tokens = self._tokens(b=8)
+        _, s_ref, l_ref = self._step(devices, "replicated", tokens,
+                                     dp=2, ep=2,
+                                     model_name="TransformerLM-moe-tiny")
+        tr, s_fs, l_fs = self._step(devices, "fsdp", tokens, dp=2, ep=2,
+                                    model_name="TransformerLM-moe-tiny")
+        np.testing.assert_allclose(l_fs, l_ref, rtol=1e-4)
+        full = tr.zero3.unshard_host(jax.device_get(s_fs.params))
+        want = jax.device_get(s_ref.params)
+        for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(full)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=3e-4, atol=3e-5)
+
+    def test_fsdp_tp_checkpoint_into_replicated(self, devices, tmp_path):
+        """fsdp x tp checkpoints hold canonical shapes: a replicated
+        dp x tp trainer restores and continues identically."""
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.ops.optim import SGD
+        from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+
+        tokens = self._tokens()
+        tr, state, _ = self._step(devices, "fsdp", tokens, dp=2, mp=2,
+                                  steps=1)
+        tr.save_checkpoint(str(tmp_path), state)
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        _, l_src = tr.train_step(state, x, y)
+
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        repl = LMTrainer(model, make_mesh(devices[:4], dp=2, mp=2),
+                         optimizer=SGD(learning_rate=0.1, momentum=0.9,
+                                       weight_decay=1e-4))
+        rest = repl.restore_checkpoint(str(tmp_path))
+        xr, yr = repl.put_batch(*make_lm_batch(tokens))
+        _, l_t = repl.train_step(rest, xr, yr)
+        np.testing.assert_allclose(float(np.mean(np.asarray(l_t))),
+                                   float(np.mean(np.asarray(l_src))),
+                                   rtol=1e-5)

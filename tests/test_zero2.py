@@ -1,0 +1,155 @@
+"""ZeRO-2: dp-scattered gradient accumulation (round-3 verdict item 5).
+
+The rung between ZeRO-1 (optimizer-state sharding, part4) and ZeRO-3
+(parameter sharding, part5): each microbatch's gradients are
+reduce-scattered over dp IMMEDIATELY and the f32 accumulation buffer
+holds 1/dp slices — accumulation memory drops ~dp x while the update
+stays exactly the full-batch one. No reference counterpart (the
+reference ladder stops at DDP, part3/main.py:174; ZeRO stages follow
+arXiv:1910.02054 §5).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_ddp.models.transformer import make_transformer
+from tpu_ddp.ops.optim import SGD, Adafactor
+from tpu_ddp.parallel.mesh import DATA_AXIS, make_mesh
+from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+
+
+def _model():
+    return make_transformer("TransformerLM-tiny", max_seq_len=32,
+                            compute_dtype=jnp.float32)
+
+
+def _tokens(b=8, seed=5):
+    return np.random.default_rng(seed).integers(0, 1024, size=(b, 33))
+
+
+def _run(devices, opt_sharding, grad_accum=2, dp=2, sp=1, mp=1,
+         steps=2, clip=None):
+    # SGD: linear in the gradient, so scattered and dense accumulation
+    # must agree to fp roundoff (the test_grad_accum.py rationale).
+    mesh = make_mesh(devices[:dp * sp * mp], dp=dp, sp=sp, mp=mp)
+    tr = LMTrainer(_model(), mesh, grad_accum=grad_accum,
+                   opt_sharding=opt_sharding, clip_grad_norm=clip,
+                   optimizer=SGD(learning_rate=0.1, momentum=0.9,
+                                 weight_decay=1e-4))
+    state = tr.init_state(seed=21)
+    x, y = tr.put_batch(*make_lm_batch(_tokens()))
+    losses = []
+    for _ in range(steps):
+        state, loss = tr.train_step(state, x, y)
+        losses.append(float(np.mean(np.asarray(loss))))
+    return tr, jax.device_get(state.params), losses
+
+
+class TestZeRO2:
+    def test_matches_replicated_and_zero1(self, devices):
+        """Two accumulated steps: zero2 == zero1 == replicated (same
+        losses AND same final params; step 2 runs momentum through the
+        scattered layout)."""
+        runs = {s: _run(devices, s) for s in ("replicated", "zero1",
+                                              "zero2")}
+        for s in ("zero1", "zero2"):
+            np.testing.assert_allclose(runs[s][2], runs["replicated"][2],
+                                       rtol=1e-5, err_msg=s)
+            for a, b in zip(jax.tree.leaves(runs["replicated"][1]),
+                            jax.tree.leaves(runs[s][1])):
+                np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                           rtol=2e-5, atol=1e-6,
+                                           err_msg=s)
+
+    def test_matches_without_accumulation(self, devices):
+        """grad_accum=1 degenerates to zero1 (scatter before the non-dp
+        sync commutes with it)."""
+        _, p_z1, l_z1 = _run(devices, "zero1", grad_accum=1)
+        _, p_z2, l_z2 = _run(devices, "zero2", grad_accum=1)
+        np.testing.assert_allclose(l_z2, l_z1, rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(p_z1), jax.tree.leaves(p_z2)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_composes_with_tp(self, devices):
+        """dp2 x tp2: the scattered accumulation rides the partition-
+        aware ZeRO layout (slices are per model-parallel cell)."""
+        _, p_ref, l_ref = _run(devices, "replicated", mp=2)
+        _, p_z2, l_z2 = _run(devices, "zero2", mp=2)
+        np.testing.assert_allclose(l_z2, l_ref, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_z2)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=2e-5, atol=1e-6)
+
+    def test_composes_with_sp(self, devices):
+        """dp2 x sp2: the non-dp sync applies elementwise to slices."""
+        _, p_ref, l_ref = _run(devices, "replicated", dp=2, sp=2)
+        _, p_z2, l_z2 = _run(devices, "zero2", dp=2, sp=2)
+        np.testing.assert_allclose(l_z2, l_ref, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_z2)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=2e-5, atol=1e-6)
+
+    def test_state_layout_is_zero1(self, devices):
+        """ZeRO-2 keeps ZeRO-1's sharded optimizer-state layout (the
+        stage adds gradient sharding, not a new state layout)."""
+        tr, _, _ = _run(devices, "zero2", dp=2, steps=1)
+        state = tr.init_state(seed=0)
+        mom = state.opt_state["momentum"]
+        leaf = jax.tree.leaves(mom)[0]
+        assert leaf.ndim == 1
+        assert leaf.sharding.spec == P(DATA_AXIS)
+
+    def test_accumulation_buffer_is_sharded(self, devices):
+        """The compiled step's live-memory accounting must show the win:
+        the zero2 program's peak temp allocation is SMALLER than zero1's
+        (the A-microbatch f32 buffer holds 1/dp slices instead of full
+        leaves). XLA:CPU supports memory_analysis; skip if not."""
+        mesh = make_mesh(devices[:2], dp=2)
+
+        def compiled_peak(sharding):
+            tr = LMTrainer(_model(), mesh, grad_accum=4,
+                           opt_sharding=sharding,
+                           optimizer=SGD(learning_rate=0.1, momentum=0.9,
+                                         weight_decay=1e-4))
+            state = tr.init_state(seed=0)
+            x, y = tr.put_batch(*make_lm_batch(_tokens()))
+            lowered = tr._train_step.lower(state.params, state.opt_state,
+                                           x, y, *tr._extra_args(state))
+            try:
+                mem = lowered.compile().memory_analysis()
+                return int(mem.temp_size_in_bytes)
+            except Exception:
+                pytest.skip("backend exposes no memory analysis")
+
+        z1, z2 = compiled_peak("zero1"), compiled_peak("zero2")
+        assert z2 < z1, (z1, z2)
+
+    def test_adafactor_refused(self, devices):
+        mesh = make_mesh(devices[:2], dp=2)
+        with pytest.raises(ValueError, match="zero2"):
+            LMTrainer(_model(), mesh, opt_sharding="zero2",
+                      optimizer=Adafactor(min_dim_size_to_factor=8))
+
+    def test_checkpoint_into_replicated(self, devices, tmp_path):
+        """zero2 checkpoints are canonical (same path as zero1)."""
+        tr, _, _ = _run(devices, "zero2", steps=1)
+        state = tr.init_state(seed=21)
+        x, y = tr.put_batch(*make_lm_batch(_tokens()))
+        state, _ = tr.train_step(state, x, y)
+        tr.save_checkpoint(str(tmp_path), state)
+        cont, _ = tr.train_step(state, x, y)
+
+        repl = LMTrainer(_model(), make_mesh(jax.devices()[:2], dp=2),
+                         grad_accum=2,
+                         optimizer=SGD(learning_rate=0.1, momentum=0.9,
+                                       weight_decay=1e-4))
+        resumed = repl.restore_checkpoint(str(tmp_path))
+        resumed, _ = repl.train_step(resumed, x, y)
+        for a, b in zip(jax.tree.leaves(jax.device_get(cont.params)),
+                        jax.tree.leaves(jax.device_get(resumed.params))):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-5, atol=1e-6)

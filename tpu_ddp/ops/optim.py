@@ -394,6 +394,23 @@ class Adafactor:
                         "mu": unf(4), "count": count}
 
 
+def clip_scale_from_sq(sq, clip_norm: float):
+    """Gradient scale for global-norm clipping, from the squared sum:
+    ``min(1, clip / (||g|| + 1e-12))``. ONE definition shared by every
+    layout's clipping path (replicated/fsdp in train/engine.py, the
+    LM trainers' _clip_by_global_norm, ZeRO's apply_scattered) so the
+    epsilon and semantics cannot drift between layouts — drift would
+    silently break the cross-layout norm equality tests/test_clip_norm.py
+    pins."""
+    return jnp.minimum(1.0, clip_norm / (jnp.sqrt(sq) + 1e-12))
+
+
+def clip_tree(grads, scale):
+    """Scale every leaf, preserving its dtype (a traced f32 scale must
+    not promote bf16 gradients)."""
+    return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
+
 def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
                   floor: float = 0.0):
     """Linear warmup to ``peak_lr`` then cosine decay to ``floor`` — the

@@ -66,6 +66,14 @@ def _pick_block(lp: int, want: int) -> int:
     return min(b, lp)
 
 
+def _block_env(name: str, default: int) -> int:
+    """Block-size tuning hook (TPU_DDP_FLASH_{BQ,BK,BWD_BQ,BWD_BK}):
+    read at trace time, so a bench sweep can try tile shapes without a
+    code edit. Defaults are the shipped, measured-best values."""
+    import os
+    return int(os.environ.get(name, default))
+
+
 def _positions(i, j, bq, bk):
     q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -153,8 +161,8 @@ def _kv_index(b, *, n_heads, n_kv):
 def _fwd_impl(q3, k3, v3, *, scale, seq_len, causal, n_heads, n_kv,
               interpret):
     bh, lp, dp = q3.shape
-    bq = _pick_block(lp, 256)
-    bk = _pick_block(lp, 512)
+    bq = _pick_block(lp, _block_env("TPU_DDP_FLASH_BQ", 256))
+    bk = _pick_block(lp, _block_env("TPU_DDP_FLASH_BK", 512))
     kv_idx = functools.partial(_kv_index, n_heads=n_heads, n_kv=n_kv)
     qkv_spec = lambda which, blk: pl.BlockSpec(  # noqa: E731
         (1, blk, dp),
@@ -271,8 +279,8 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_impl(q3, k3, v3, o3, lse, do3, *, scale, seq_len, causal,
               n_heads, n_kv, interpret):
     bh, lp, dp = q3.shape
-    bq = _pick_block(lp, 256)
-    bk = _pick_block(lp, 256)
+    bq = _pick_block(lp, _block_env("TPU_DDP_FLASH_BWD_BQ", 256))
+    bk = _pick_block(lp, _block_env("TPU_DDP_FLASH_BWD_BK", 256))
     group = n_heads // n_kv
     nq = lp // bq
     kv_idx = functools.partial(_kv_index, n_heads=n_heads, n_kv=n_kv)

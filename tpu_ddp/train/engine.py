@@ -118,9 +118,21 @@ class Trainer:
         strategy: str = "none",
         mesh: Mesh | None = None,
         metrics: "MetricsLogger | None" = None,
+        clip_grad_norm: float | None = None,
     ):
         self.model = model
         self.config = config or TrainConfig()
+        # Global-norm gradient clipping (round-3 verdict item 6):
+        # torch.nn.utils.clip_grad_norm_ semantics. Applied to the
+        # SYNCED gradients, so every rung clips by the same global norm:
+        # replicated strategies compute it locally (grads identical
+        # everywhere after sync), ZeRO-1 from its dp-scattered slices
+        # (ZeRO1.apply_scattered), FSDP from its flat dp shards — all
+        # exactly equal up to reduction order (tests/test_clip_norm.py).
+        if clip_grad_norm is not None and clip_grad_norm <= 0:
+            raise ValueError(
+                f"clip_grad_norm must be > 0, got {clip_grad_norm}")
+        self.clip_grad_norm = clip_grad_norm
         self.metrics = metrics if metrics is not None else MetricsLogger()
         self.strategy_name = strategy
         self.sync_fn = get_sync_strategy(strategy)
@@ -344,6 +356,16 @@ class Trainer:
                 loss_fn, has_aux=True)(params)
             # psum_scatter summed over workers; recover the replica mean.
             grads = jax.tree.map(lambda g: g / float(self._dp), grads)
+            if self.clip_grad_norm is not None:
+                # Flat dp shards hold distinct elements: psum the
+                # squared sums over dp for the exact global norm.
+                from tpu_ddp.ops.optim import (clip_scale_from_sq,
+                                               clip_tree)
+                sq = lax.psum(
+                    sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in jax.tree.leaves(grads)), DATA_AXIS)
+                grads = clip_tree(
+                    grads, clip_scale_from_sq(sq, self.clip_grad_norm))
             params, opt_state = self.zero3.apply(params, grads, opt_state)
             return params, opt_state, loss
 
@@ -356,6 +378,22 @@ class Trainer:
         # reduce_scatter + all_gather pair performs the synchronization.
         grads = self.sync_fn(grads, DATA_AXIS) if self.mesh is not None \
             else self.sync_fn(grads)
+        if self.is_zero:
+            # Clip (if any) happens on the wrapper's dp-scattered slices
+            # — the only place the synced gradient values exist.
+            params, opt_state = self.optimizer.apply(
+                params, grads, opt_state, clip_norm=self.clip_grad_norm)
+            return params, opt_state, loss
+        if self.clip_grad_norm is not None:
+            # Replicated rungs: grads are identical on every replica
+            # after sync, so the local squared sum IS the global one.
+            # (Under strategy 'none' each replica clips by its own
+            # norm — consistent with that rung's no-sync semantics.)
+            from tpu_ddp.ops.optim import clip_scale_from_sq, clip_tree
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for g in jax.tree.leaves(grads))
+            grads = clip_tree(grads,
+                              clip_scale_from_sq(sq, self.clip_grad_norm))
         params, opt_state = self.optimizer.apply(params, grads, opt_state)
         return params, opt_state, loss
 

