@@ -377,7 +377,11 @@ def main() -> dict:
     # Headline pinned to the reference ladder's config — explicit, so
     # TPU_DDP_BENCH_CONFIG (a single-config debugging hook for run_bench)
     # can never relabel the headline or double-run a sub-benchmark.
-    result = run_bench(config="vgg11_cifar10")
+    # 5 windows (vs 3 elsewhere): this is the one tunnel-dispatch-bound
+    # cell, so its median needs the most protection against a tunnel
+    # hiccup landing in a window (on-chip cells sit at <=2.6% spread
+    # with 3; this one has been observed at 15-65% across bad windows).
+    result = run_bench(config="vgg11_cifar10", windows=5)
 
     extra = result["extra"]
     # Throughput vs batch size: the headline batch (the reference's
@@ -422,24 +426,28 @@ def main() -> dict:
             **cfg_r, "batch_sweep": rsweep}
     # The MFU-headline LM config (round-3 verdict item 1b): ~740M params,
     # every matmul K,N >= 2048, head_dim 128. remat off — it fits at
-    # batch 4, and the recomputed forward would burn 25% of counted MFU
-    # (MFU counts 3x fwd; remat executes 4x). Measured on the v5e:
-    # batch 4 no-remat 0.509-0.513 MFU > batch 6 (0.484; +vocab_chunk
-    # 0.471) > batch 8 no-remat 0.457 (XLA spills) > batch 8 remat
-    # 0.399 > batch 4 remat 0.395; non-flash attention fails to compile
-    # at this scale (the (B,H,L,L) score tensor).
+    # batch 4 microbatches, and the recomputed forward would burn 25% of
+    # counted MFU (MFU counts 3x fwd; remat executes 4x). Round-4
+    # tuning, measured on the v5e (median-of-3 windows, ~0.3% spread):
+    # flash tiles fwd 512/1024 + bwd 512/512 (now the kernel defaults)
+    # took batch 4 from 0.5145 -> 0.5857; grad_accum=4 at batch 16
+    # (microbatch 4, 32k tokens/optimizer step) adds the update
+    # amortization -> 0.594-0.596. Non-flash attention fails to compile
+    # at this scale (the (B,H,L,L) score tensor); remat variants sit
+    # ~0.40; vocab_chunk measured worse (0.471).
     extra["configs"]["transformer_lm_large"] = _sub(
-        run_lm_bench, model_name="TransformerLM-large", batch_size=4,
-        timed_iters=10, with_decode=True,
-        model_overrides={"remat_blocks": False})
+        run_lm_bench, model_name="TransformerLM-large", batch_size=16,
+        timed_iters=6, with_decode=True,
+        model_overrides={"remat_blocks": False},
+        trainer_overrides={"grad_accum": 4})
     # Long-context training (TransformerLM-large, seq 8192, flash): the
     # regime where the O(L*D)-memory kernel is the enabling piece — the
     # jnp attention path cannot even compile the O(L^2) score tensor
     # here. batch 1, remat off (remat OOMs at this length; the no-remat
-    # step fits). Measured v5e: ~12.7k tok/s, 0.415 MFU — attention's
-    # FLOP share grows with L, so lower than the seq-2048 cell by
-    # construction. (The small model at seq 8192 sits at 0.19 MFU:
-    # d_model 512 leaves attention dominant.)
+    # step fits). Measured v5e round 4: ~18.6k tok/s, 0.607 MFU with
+    # the tuned tiles (was 0.4165 at the old 256/512+256/256 tiles) —
+    # the seq-8192 rows amortize the kernel's per-grid-step scratch
+    # best, so this cell now leads the MFU table.
     extra["configs"]["transformer_lm_long"] = _sub(
         run_lm_bench, model_name="TransformerLM-large", batch_size=1,
         seq_len=8192, timed_iters=5, with_xla_flops=False,

@@ -64,7 +64,15 @@ def main(argv=None) -> int:
     fsdp = os.environ.get("TPU_DDP_LM_FSDP", "0") == "1"
     accum = int(os.environ.get("TPU_DDP_LM_ACCUM", "1"))
     sp_mode = os.environ.get("TPU_DDP_LM_SP_MODE", "ring")
-    zero1 = os.environ.get("TPU_DDP_LM_ZERO1", "0") == "1"
+    # TPU_DDP_LM_OPT_SHARD: replicated | zero1 | zero2 (zero2 =
+    # dp-scattered grad accumulation; pair with TPU_DDP_LM_ACCUM).
+    # TPU_DDP_LM_ZERO1=1 is the legacy spelling of zero1.
+    opt_shard = os.environ.get(
+        "TPU_DDP_LM_OPT_SHARD",
+        "zero1" if os.environ.get("TPU_DDP_LM_ZERO1", "0") == "1"
+        else "replicated")
+    # TPU_DDP_LM_CLIP: global-norm gradient clip threshold (0 = off).
+    clip = float(os.environ.get("TPU_DDP_LM_CLIP", "0")) or None
     opt_name = os.environ.get("TPU_DDP_LM_OPT", "adamw")
     tp = int(os.environ.get("TPU_DDP_LM_TP", "1"))
     if tp < 1:
@@ -93,13 +101,14 @@ def main(argv=None) -> int:
     trainer = LMTrainer(
         model, mesh,
         param_sharding="fsdp" if fsdp else "replicated",
-        opt_sharding="zero1" if zero1 else "replicated",
+        opt_sharding=opt_shard,
         optimizer=optimizer,
-        grad_accum=accum, sp_mode=sp_mode)
+        grad_accum=accum, sp_mode=sp_mode, clip_grad_norm=clip)
     state = trainer.init_state(seed=0)
     print(f"[lm_train] rank={rank} world={world} dp={trainer.dp} "
-          f"sp={trainer.sp} tp={trainer.tp} fsdp={fsdp} zero1={zero1} "
-          f"opt={opt_name} accum={accum} preset={preset}")
+          f"sp={trainer.sp} tp={trainer.tp} fsdp={fsdp} "
+          f"opt_shard={opt_shard} opt={opt_name} accum={accum} "
+          f"clip={clip} preset={preset}")
 
     # Deterministic synthetic tokens, identical on every process; each
     # process feeds ITS contiguous shard of the global batch.

@@ -1,0 +1,149 @@
+"""Per-layer roofline for the ResNet-50 training step on TPU v5e.
+
+Answers the round-3 verdict's ResNet question with math instead of a
+missing 0.40: the batch sweep (bench_full.json batch_sweep) plateaus at
+~0.25 MFU because the TRAINING conv stack is HBM-bandwidth-bound on
+v5e, not because the batch was too small.
+
+Model of one training step (per layer):
+
+- FLOPs: 3x the forward conv FLOPs (backward does dX and dW matmuls).
+- HBM traffic: training BatchNorm with batch statistics (the
+  reference's track_running_stats=False semantic) forces the conv
+  OUTPUT tensor through HBM several times per layer — it is written by
+  the conv, read for the mean/var reduction, read again to normalize
+  (the two reads cannot fuse: the statistics depend on the whole
+  tensor), and the backward pass reads the saved activation twice more
+  (dBN and dW) and writes dX once. We charge bf16 activations
+  ``T = 6 * bytes(conv output)`` per layer plus the weight traffic
+  (negligible next to activations at these spatial sizes).
+
+Per-layer time = max(flops / MXU_peak, traffic / HBM_BW); predicted
+step time = sum over layers; predicted MFU = counted_flops /
+(MXU_peak * step_time). Also reports each stage's MXU channel-fill
+(K and N vs the 128-wide systolic array) — the early stages' K=64 rows
+halve the usable MXU even when compute-bound.
+
+Writes experiments/resnet_roofline.json; render in EXPERIMENTS.md.
+Pure arithmetic — runs anywhere, no device needed.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# TPU v5e (the bench chip): bf16 peak and HBM bandwidth.
+PEAK_TFLOPS = 394.0
+HBM_GBPS = 819.0
+ACT_BYTES = 2          # bf16 activations
+TRAFFIC_FACTOR = 6     # conv-out tensor HBM passes per training step
+
+
+def layers(batch: int, image_size: int = 224, num_classes: int = 1000):
+    """(name, flops_fwd, act_elems_out, k_dim, n_dim) per conv layer of
+    ResNet-50, mirroring utils/flops.py:resnet_fwd_flops's shape walk."""
+    stage_blocks = (3, 4, 6, 3)
+    stage_widths = (64, 128, 256, 512)
+    out = []
+    h = image_size // 2
+    out.append(("stem7x7", 2 * 49 * 3 * 64 * h * h * batch,
+                64 * h * h * batch, 3 * 49, 64))
+    h //= 2
+    c_in = 64
+    for si, n_blocks in enumerate(stage_blocks):
+        w = stage_widths[si]
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            h_out = h // stride
+            out.append((f"s{si}b{bi}c1", 2 * c_in * w * h * h * batch,
+                        w * h * h * batch, c_in, w))
+            out.append((f"s{si}b{bi}c2",
+                        2 * 9 * w * w * h_out * h_out * batch,
+                        w * h_out * h_out * batch, 9 * w, w))
+            out.append((f"s{si}b{bi}c3",
+                        2 * w * 4 * w * h_out * h_out * batch,
+                        4 * w * h_out * h_out * batch, w, 4 * w))
+            if bi == 0 and c_in != 4 * w:
+                out.append((f"s{si}b{bi}proj",
+                            2 * c_in * 4 * w * h_out * h_out * batch,
+                            4 * w * h_out * h_out * batch, c_in, 4 * w))
+            c_in = 4 * w
+            h = h_out
+    out.append(("head", 2 * c_in * num_classes * batch,
+                num_classes * batch, c_in, num_classes))
+    return out
+
+
+def roofline(batch: int) -> dict:
+    peak = PEAK_TFLOPS * 1e12
+    bw = HBM_GBPS * 1e9
+    t_total = t_total_fill = flops_total = 0.0
+    t_compute = t_memory = 0.0
+    rows = []
+    for name, f_fwd, elems, k, n in layers(batch):
+        f_train = 3.0 * f_fwd
+        traffic = TRAFFIC_FACTOR * ACT_BYTES * elems
+        fill = (min(k, 128) / 128) * (min(n, 128) / 128)
+        tc = f_train / peak
+        tm = traffic / bw
+        t_total += max(tc, tm)
+        # Second estimate: the 128x128 systolic array only streams
+        # min(K,128) x min(N,128) useful lanes — K=64 rows (stage-0
+        # 1x1 convs) leave half the MXU idle even when compute-bound.
+        t_total_fill += max(tc / fill, tm)
+        t_compute += tc
+        t_memory += tm
+        flops_total += f_train
+        rows.append({"layer": name, "train_gflops": round(f_train / 1e9, 2),
+                     "traffic_mb": round(traffic / 1e6, 1),
+                     "t_compute_us": round(tc * 1e6, 1),
+                     "t_memory_us": round(tm * 1e6, 1),
+                     "bound": "memory" if tm > tc else "compute",
+                     "mxu_fill": round(fill, 2)})
+    mem_bound = sum(1 for r in rows if r["bound"] == "memory")
+    return {
+        "batch": batch,
+        "predicted_step_s": round(t_total, 5),
+        "predicted_mfu": round(flops_total / (peak * t_total), 4),
+        "predicted_mfu_mxu_fill": round(
+            flops_total / (peak * t_total_fill), 4),
+        "pure_compute_s": round(t_compute, 5),
+        "pure_memory_s": round(t_memory, 5),
+        "memory_bound_layers": mem_bound,
+        "total_layers": len(rows),
+        "layers": rows,
+    }
+
+
+def main() -> int:
+    cells = [roofline(b) for b in (128, 256, 512, 1024)]
+    out = {
+        "chip": f"TPU v5e: {PEAK_TFLOPS} bf16 TFLOPs, {HBM_GBPS} GB/s HBM",
+        "model": ("per-layer max(flops/peak, traffic/bw); training "
+                  f"traffic = {TRAFFIC_FACTOR} bf16 passes over each "
+                  "conv output (conv write, BN stats read, BN normalize "
+                  "read, bwd dBN + dW reads, dX write) — batch-stats BN "
+                  "training cannot fuse these"),
+        "cells": [{k: v for k, v in c.items() if k != "layers"}
+                  for c in cells],
+        "per_layer_batch512": roofline(512)["layers"],
+    }
+    (REPO / "experiments" / "resnet_roofline.json").write_text(
+        json.dumps(out, indent=1))
+    for c in out["cells"]:
+        print(f"[roofline] batch {c['batch']}: predicted MFU "
+              f"{c['predicted_mfu']} (mxu-fill-adjusted "
+              f"{c['predicted_mfu_mxu_fill']}; step "
+              f"{c['predicted_step_s']}s, "
+              f"{c['memory_bound_layers']}/{c['total_layers']} layers "
+              "memory-bound)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

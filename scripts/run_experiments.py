@@ -560,6 +560,48 @@ def render(out_path: Path | None = None) -> str:
             "",
         ]
 
+    p = OUT_DIR / "resnet_roofline.json"
+    if p.exists():
+        d = json.loads(p.read_text())
+        lines += [
+            _section(lines, "ResNet-50 training roofline on v5e — why "
+                     "the MFU plateau is ~0.25, at any batch"),
+            "",
+            f"`scripts/resnet_roofline.py`; chip model: {d['chip']}. "
+            "Traffic model: " + d["model"] + ".",
+            "",
+            "| batch | predicted MFU | (MXU-fill adj.) | pure-compute s "
+            "| pure-memory s | memory-bound layers |",
+            "|---|---|---|---|---|---|",
+        ]
+        for c in d["cells"]:
+            lines.append(
+                f"| {c['batch']} | {c['predicted_mfu']} | "
+                f"{c['predicted_mfu_mxu_fill']} | "
+                f"{c['pure_compute_s']} | {c['pure_memory_s']} | "
+                f"{c['memory_bound_layers']}/{c['total_layers']} |")
+        lines += [
+            "",
+            "Reading: the roofline CEILING is ~0.355 MFU and is "
+            "batch-independent — pure HBM time exceeds pure MXU time "
+            "(31 of 54 conv layers are memory-bound; the whole first "
+            "half of the network streams large spatial maps through "
+            "batch-stats BN). The measured sweep "
+            "(bench_full.json `configs.resnet50_imagenet.batch_sweep`) "
+            "is flat at 0.23-0.25 across batch 128-1024 with ~1% "
+            "sample spread — the same batch-independent shape, at "
+            "~0.7x the ideal ceiling (residual adds, maxpool, dX of "
+            "strided convs and imperfect fusion are uncounted "
+            "traffic). Raising batch cannot lift a bandwidth-bound "
+            "stack; the levers that would are layout-level (channels-"
+            "last + fused BN-stats epilogues) or algorithmic (ghost "
+            "BN / BN-free variants), which change the reference "
+            "semantics this config exists to preserve "
+            "(track_running_stats=False batch statistics, reference "
+            "part1/model.py:24).",
+            "",
+        ]
+
     p = OUT_DIR / "bench_full.json"
     if p.exists():
         d = json.loads(p.read_text())
@@ -583,17 +625,19 @@ def render(out_path: Path | None = None) -> str:
                              f"{best['images_per_sec']:,.0f} img/s",
                              best["mfu"]))
         for key, label, unit in (
-                ("resnet50_imagenet", "ResNet-50 / ImageNet-1k, batch "
-                 "128", "img/s"),
+                ("resnet50_imagenet", "ResNet-50 / ImageNet-1k",
+                 "img/s"),
                 ("transformer_lm", "TransformerLM-small, seq 2048, "
                  "flash", "tok/s"),
                 ("transformer_lm_long", "TransformerLM-large, seq 8192 "
                  "(long context, flash)", "tok/s"),
                 ("transformer_lm_large", "TransformerLM-large (~740M, "
-                 "head_dim 128), batch 4", "tok/s")):
+                 "head_dim 128)", "tok/s")):
             c = e.get("configs", {}).get(key)
             if c and "value" in c:
-                rows.append((label, f"{c['value']:,.0f} {unit}",
+                bs = c.get("extra", {}).get("batch_size")
+                lbl = f"{label}, batch {bs}" if bs else label
+                rows.append((lbl, f"{c['value']:,.0f} {unit}",
                              c.get("extra", {}).get("mfu")))
         dec = (e.get("configs", {}).get("transformer_lm_large", {})
                .get("extra", {}).get("decode"))

@@ -96,6 +96,37 @@ class TestPipelineEquivalence:
                 np.asarray(b), np.asarray(a), rtol=3e-4, atol=3e-5,
                 err_msg=f"dp={dp} pp={pp} tp={tp} micro={micro}")
 
+    @pytest.mark.parametrize("dp,sp,schedule,sp_mode", [
+        (1, 2, "gpipe", "ring"),
+        (2, 2, "1f1b", "ring"),
+        pytest.param(1, 2, "gpipe", "ulysses", marks=_slow),
+        pytest.param(1, 4, "1f1b", "ring", marks=_slow),
+    ])
+    def test_sp_composition_matches_dense(self, devices, dp, sp,
+                                          schedule, sp_mode):
+        """pp x sp (round 4): ring/Ulysses attention inside the pipeline
+        stages — one step equals the dense single-device step."""
+        tokens = _tokens()
+        dense_p, dense_loss = self._dense_step(devices, tokens)
+
+        model = _tiny()
+        mesh = make_mesh(devices[:dp * sp * 2], dp=dp, sp=sp, pp=2)
+        tr = PipelineLMTrainer(model, mesh, num_micro=2,
+                               optimizer=_sgd(), schedule=schedule,
+                               sp_mode=sp_mode)
+        state = tr.init_state(seed=7)
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        state, loss = tr.train_step(state, x, y)
+        got_loss = float(np.mean(np.asarray(loss)))
+        assert abs(got_loss - dense_loss) < 1e-4, (dp, sp, schedule)
+
+        got = unstack_block_params(jax.device_get(state.params),
+                                   model.num_layers)
+        for a, b in zip(jax.tree.leaves(dense_p), jax.tree.leaves(got)):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=3e-4, atol=3e-5,
+                err_msg=f"dp={dp} sp={sp} {schedule} {sp_mode}")
+
     def test_adamw_decay_mask_uses_original_ranks(self, devices):
         """Stacking raises LN scales/biases to rank 2; AdamW must still
         exempt them from weight decay (regression: a pipelined AdamW step
@@ -170,10 +201,12 @@ class TestPipelineValidation:
         with pytest.raises(ValueError, match="num_layers"):
             PipelineLMTrainer(_tiny(), mesh)
 
-    def test_sp_composition_rejected(self, devices):
+    def test_seq_indivisible_by_sp_raises(self, devices):
         mesh = make_mesh(devices[:4], dp=1, sp=2, mp=1, pp=2)
-        with pytest.raises(ValueError, match="sequence parallelism"):
-            PipelineLMTrainer(_tiny(), mesh)
+        tr = PipelineLMTrainer(_tiny(), mesh, num_micro=2)
+        with pytest.raises(ValueError, match="sp"):
+            tr.put_batch(np.zeros((4, 31), np.int32),
+                         np.zeros((4, 31), np.int32))
 
     def test_batch_divisibility(self, devices):
         mesh = make_mesh(devices[:4], dp=2, sp=1, mp=1, pp=2)

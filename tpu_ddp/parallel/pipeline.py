@@ -133,6 +133,16 @@ def _make_run_stage(model, blocks, pos, rng, pp_axis: str):
     return run_stage
 
 
+def _check_seq_len(model, local_len: int) -> None:
+    """Validate the GLOBAL sequence length (local x sp under sequence
+    parallelism) against the model's maximum."""
+    sp = model.sp_size if model.sp_axis is not None else 1
+    if local_len * sp > model.max_seq_len:
+        raise ValueError(
+            f"global sequence length {local_len * sp} (local {local_len}"
+            f" x sp {sp}) exceeds max_seq_len={model.max_seq_len}")
+
+
 def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
                   num_micro: int, pp_axis: str = PIPE_AXIS, rng=None):
     """(masked_loss_sum, local_n) for this shard's (B, L) batch.
@@ -146,9 +156,7 @@ def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
     masks are pipeline-geometry-independent.
     """
     B, L = inputs.shape
-    if L > model.max_seq_len:
-        raise ValueError(f"sequence length {L} exceeds "
-                         f"max_seq_len={model.max_seq_len}")
+    _check_seq_len(model, L)
     if B % num_micro:
         raise ValueError(f"local batch {B} not divisible by "
                          f"num_micro={num_micro}")
@@ -156,7 +164,10 @@ def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
     S, M = pp_size, num_micro
     cd = model.compute_dtype
     stage = lax.axis_index(pp_axis)
-    pos = jnp.arange(L)
+    # Global positions of this shard's chunk: under sequence parallelism
+    # (sp > 1) L is the LOCAL chunk length and the model offsets by the
+    # sp coordinate (models/transformer.py:_positions).
+    pos = model._positions(L)
 
     micro = inputs.reshape(M, mb, L)
     x_embed = _embed_micro(model, params, micro, rng, M)  # (M, mb, L, dm)
@@ -225,9 +236,7 @@ def pipeline_1f1b_grads(model, params, inputs, targets, *, pp_size: int,
     bit-comparable to the GPipe path (tested: tests/test_pipeline.py).
     """
     B, L = inputs.shape
-    if L > model.max_seq_len:
-        raise ValueError(f"sequence length {L} exceeds "
-                         f"max_seq_len={model.max_seq_len}")
+    _check_seq_len(model, L)
     if B % num_micro:
         raise ValueError(f"local batch {B} not divisible by "
                          f"num_micro={num_micro}")
@@ -235,7 +244,7 @@ def pipeline_1f1b_grads(model, params, inputs, targets, *, pp_size: int,
     S, M = pp_size, num_micro
     cd = model.compute_dtype
     stage = lax.axis_index(pp_axis)
-    pos = jnp.arange(L)
+    pos = model._positions(L)  # sp-aware global chunk positions
     K = 2 * S - 1  # ring-buffer slots: max fwd->bwd gap is 2(S-1) ticks
 
     micro = inputs.reshape(M, mb, L)

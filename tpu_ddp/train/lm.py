@@ -624,14 +624,16 @@ class PipelineLMTrainer(_MeshTrainer):
     The layer stack shards into ``pp`` stages (stacked block params,
     tpu_ddp/parallel/pipeline.py); each dp slice's batch is split into
     ``num_micro`` microbatches that stream through the stage ring.
-    Composes with tensor parallelism (mp > 1), dropout (keys derive
-    from (microbatch, global layer), so masks are pipeline-geometry-
-    independent), and ZeRO-1 optimizer-state sharding
+    Composes with tensor parallelism (mp > 1), sequence parallelism
+    (sp > 1, round 4: each microbatch's activations hold their L/sp
+    chunk and attention inside every stage runs ring K/V rotation or
+    Ulysses all-to-all over ``sp`` — the same in-block collectives the
+    dense trunk uses, orthogonal to the stage ring over ``pp``),
+    dropout (keys derive from (microbatch, global layer), so masks are
+    pipeline-geometry-independent), and ZeRO-1 optimizer-state sharding
     (``opt_sharding="zero1"``: stacked leaves' state laid out
-    P((pp, dp)), replicated leaves' P(dp) — with tp = 1); sequence
-    parallelism under the pipeline is not supported (ring attention
-    would rotate K/V inside every pipeline tick — a composition this
-    engine does not schedule). Gradient accumulation needs no separate
+    P((pp, dp)) — P((pp, mp, dp)) with stage-internal tp). Gradient
+    accumulation needs no separate
     mechanism here: ``num_micro`` IS accumulation — every microbatch's
     gradient sums into one optimizer step, and raising it shrinks both
     per-microbatch activation memory and (under 1F1B, where residency
@@ -643,7 +645,8 @@ class PipelineLMTrainer(_MeshTrainer):
                  optimizer: AdamW | None = None, dropout_seed: int = 0,
                  schedule: str = "gpipe",
                  opt_sharding: str = "replicated",
-                 clip_grad_norm: float | None = None):
+                 clip_grad_norm: float | None = None,
+                 sp_mode: str = "ring"):
         from tpu_ddp.parallel.pipeline import pipeline_param_specs
         if clip_grad_norm is not None and clip_grad_norm <= 0:
             raise ValueError(f"clip_grad_norm must be > 0, got "
@@ -653,9 +656,10 @@ class PipelineLMTrainer(_MeshTrainer):
         self.dp = mesh.shape[DATA_AXIS]
         self.pp = mesh.shape[PIPE_AXIS]
         self.tp = mesh.shape.get(MODEL_AXIS, 1)
-        if mesh.shape[SEQ_AXIS] != 1:
-            raise ValueError("PipelineLMTrainer does not compose with "
-                             "sequence parallelism (sp must be 1)")
+        self.sp = mesh.shape[SEQ_AXIS]
+        if sp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown sequence-parallel mode {sp_mode!r};"
+                             " expected 'ring' or 'ulysses'")
         if mesh.shape.get(EXPERT_AXIS, 1) != 1:
             raise ValueError("PipelineLMTrainer does not compose with "
                              "expert parallelism (ep must be 1); MoE "
@@ -664,6 +668,9 @@ class PipelineLMTrainer(_MeshTrainer):
         if model.num_layers % self.pp:
             raise ValueError(f"num_layers={model.num_layers} not "
                              f"divisible by pp={self.pp}")
+        if self.sp > 1:
+            model = model.with_sequence_parallel(SEQ_AXIS, self.sp,
+                                                 mode=sp_mode)
         if self.tp > 1:
             model = model.with_tensor_parallel(MODEL_AXIS, self.tp)
         self.model = model
@@ -708,10 +715,11 @@ class PipelineLMTrainer(_MeshTrainer):
                 param_specs=self._param_specs,
                 mesh_axis_sizes=dict(mesh.shape))
         self._opt_specs = self.optimizer.state_specs(self._param_specs)
-        self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        batch_spec = P(DATA_AXIS, SEQ_AXIS)
+        self._batch_sharding = NamedSharding(mesh, batch_spec)
         self._param_shardings = self._shardings(self._param_specs)
         self._opt_shardings = self._shardings(self._opt_specs)
-        self._train_step = self._compile_step(P(DATA_AXIS), P(DATA_AXIS))
+        self._train_step = self._compile_step(batch_spec, batch_spec)
 
     def init_state(self, seed: int = 0) -> LMTrainState:
         """Same seed -> same parameters as the dense model, re-laid-out:
@@ -730,14 +738,20 @@ class PipelineLMTrainer(_MeshTrainer):
         return self.optimizer.decay_mask(proto)
 
     def _sync_grads(self, grads, skip_dp: bool = False):
-        """Stacked block leaves are stage-local (mean over dp only);
+        """Stacked block leaves are stage-local (mean over dp/sp only);
         replicated leaves (embed/head/ln_f) got their real gradient on one
-        stage and zeros elsewhere — sum over pp reassembles it.
+        stage and zeros elsewhere — sum over pp reassembles it. Under
+        sequence parallelism every leaf's gradient is a partial from
+        this shard's L/sp chunk — the mean over ``sp`` (with the loss
+        scaled by the (dp, sp) shard count) telescopes to the global
+        token mean, the LMTrainer algebra.
         ``skip_dp``: ZeRO-1 delegates the dp mean to its psum_scatter —
-        only the pp reassembly happens here."""
+        pp reassembly and the sp mean still happen here."""
         def leaf(g, spec):
             if PIPE_AXIS not in tuple(spec):
                 g = lax.psum(g, PIPE_AXIS)
+            if self.sp > 1:
+                g = lax.pmean(g, SEQ_AXIS)
             return g if skip_dp else lax.pmean(g, DATA_AXIS)
         return jax.tree.map(leaf, grads, self._param_specs)
 
@@ -748,13 +762,16 @@ class PipelineLMTrainer(_MeshTrainer):
         return (jax.random.fold_in(self._dropout_key, state.step),)
 
     def _decorrelate_rng(self, rng):
-        """Distinct dropout keys per dp shard (different tokens); the
+        """Distinct dropout keys per dp/sp shard (different tokens); the
         SAME key across pp stages — a microbatch's (mb, layer) mask
         derivation must agree on whichever stage runs that layer — and
         across mp shards (replicated residual stream)."""
         if self.model.dropout_rate <= 0.0:
             return None
-        return jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+        if self.sp > 1:
+            rng = jax.random.fold_in(rng, lax.axis_index(SEQ_AXIS))
+        return rng
 
     def _base_step(self, params, opt_state, inputs, targets, rng):
         from tpu_ddp.parallel.pipeline import (pipeline_1f1b_grads,
@@ -762,26 +779,32 @@ class PipelineLMTrainer(_MeshTrainer):
 
         rng = self._decorrelate_rng(rng)
 
+        # The loss is normalized over the (dp, sp) token shards: scale
+        # by the shard count so the pmean in _sync_grads telescopes to
+        # the grad of the GLOBAL token mean (the LMTrainer algebra).
+        data_axes = ((DATA_AXIS, SEQ_AXIS) if self.sp > 1
+                     else (DATA_AXIS,))
         if self.schedule == "1f1b":
             masked_sum, local_n, grads = pipeline_1f1b_grads(
                 self.model, params, inputs, targets, pp_size=self.pp,
                 num_micro=self.num_micro, rng=rng)
-            total = lax.psum(local_n, DATA_AXIS)
-            n_dp = lax.psum(1.0, DATA_AXIS)
+            total = lax.psum(local_n, data_axes)
+            n_shards = lax.psum(1.0, data_axes)
             # Same normalization the gpipe loss_fn differentiates.
-            grads = jax.tree.map(lambda g: g * (n_dp / total), grads)
+            grads = jax.tree.map(lambda g: g * (n_shards / total), grads)
             local_mean = masked_sum / local_n
         else:
             def loss_fn(p):
                 masked_sum, local_n = pipeline_loss(
                     self.model, p, inputs, targets, pp_size=self.pp,
                     num_micro=self.num_micro, rng=rng)
-                total = lax.psum(local_n, DATA_AXIS)
-                n_dp = lax.psum(1.0, DATA_AXIS)
-                # Scale so pmean-over-dp of grads == grad of the global
-                # token mean; masked_sum is nonzero on the last stage
-                # only and the pp-psum in _sync_grads completes the sum.
-                return n_dp * masked_sum / total, masked_sum / local_n
+                total = lax.psum(local_n, data_axes)
+                n_shards = lax.psum(1.0, data_axes)
+                # Scale so pmean-over-(dp,sp) of grads == grad of the
+                # global token mean; masked_sum is nonzero on the last
+                # stage only and the pp-psum in _sync_grads completes
+                # the sum.
+                return n_shards * masked_sum / total, masked_sum / local_n
 
             (_, local_mean), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
@@ -803,17 +826,20 @@ class PipelineLMTrainer(_MeshTrainer):
                 params, grads, opt_state,
                 decay_mask=self._decay_mask(params))
         # Real chunk mean lives on the last stage; share it with everyone
-        # (outside the differentiated path).
+        # (outside the differentiated path). (1, 1) per shard so the
+        # out spec P(dp, sp) stacks to a (dp, sp) global.
         mean = lax.psum(local_mean, PIPE_AXIS)
-        return params, opt_state, mean.reshape(1)
+        return params, opt_state, mean.reshape(1, 1)
 
     def put_batch(self, inputs, targets):
         inputs = np.ascontiguousarray(inputs, np.int32)
         targets = np.ascontiguousarray(targets, np.int32)
-        b = inputs.shape[0]
+        b, L = inputs.shape
         gb = self._global_batch(b, self.dp)
         if gb % (self.dp * self.num_micro):
             raise ValueError(f"global batch {gb} not divisible by "
                              f"dp*num_micro={self.dp * self.num_micro}")
+        if L % self.sp:
+            raise ValueError(f"seq len {L} not divisible by sp={self.sp}")
         return (self._put_sharded(inputs, self._batch_sharding),
                 self._put_sharded(targets, self._batch_sharding))
