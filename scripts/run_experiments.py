@@ -580,19 +580,36 @@ def render(out_path: Path | None = None) -> str:
                 f"{c['predicted_mfu_mxu_fill']} | "
                 f"{c['pure_compute_s']} | {c['pure_memory_s']} | "
                 f"{c['memory_bound_layers']}/{c['total_layers']} |")
+        ceil = d["cells"][0]["predicted_mfu"]
+        mb_frac = (f"{d['cells'][0]['memory_bound_layers']} of "
+                   f"{d['cells'][0]['total_layers']}")
+        # Measured plateau from the committed bench artifact, when there.
+        measured = ""
+        bf = OUT_DIR / "bench_full.json"
+        if bf.exists():
+            rs = (json.loads(bf.read_text()).get("extra", {})
+                  .get("configs", {}).get("resnet50_imagenet", {})
+                  .get("extra", {}).get("batch_sweep", {}))
+            mfus = [v["mfu"] for v in rs.values()
+                    if isinstance(v, dict) and v.get("mfu") is not None]
+            if mfus:
+                measured = (
+                    f"is flat at {min(mfus):.2f}-{max(mfus):.2f} across "
+                    f"batch {min(map(int, rs))}-{max(map(int, rs))} — "
+                    "the same batch-independent shape, at "
+                    f"~{max(mfus) / ceil:.1f}x the ideal ceiling "
+                    "(residual adds, maxpool, dX of strided convs and "
+                    "imperfect fusion are uncounted traffic)")
         lines += [
             "",
-            "Reading: the roofline CEILING is ~0.355 MFU and is "
+            f"Reading: the roofline CEILING is {ceil} MFU and is "
             "batch-independent — pure HBM time exceeds pure MXU time "
-            "(31 of 54 conv layers are memory-bound; the whole first "
+            f"({mb_frac} conv layers are memory-bound; the whole first "
             "half of the network streams large spatial maps through "
             "batch-stats BN). The measured sweep "
             "(bench_full.json `configs.resnet50_imagenet.batch_sweep`) "
-            "is flat at 0.23-0.25 across batch 128-1024 with ~1% "
-            "sample spread — the same batch-independent shape, at "
-            "~0.7x the ideal ceiling (residual adds, maxpool, dX of "
-            "strided convs and imperfect fusion are uncounted "
-            "traffic). Raising batch cannot lift a bandwidth-bound "
+            + (measured or "tracks the same batch-independent shape")
+            + ". Raising batch cannot lift a bandwidth-bound "
             "stack; the levers that would are layout-level (channels-"
             "last + fused BN-stats epilogues) or algorithmic (ghost "
             "BN / BN-free variants), which change the reference "

@@ -100,6 +100,8 @@ class TestClipVGGLadder:
             params = tr.zero3.unshard_host(params)
         return params, float(np.mean(np.asarray(loss)))
 
+    @pytest.mark.slow  # 3 VGG trainers x 2 steps ~10s; the LM layout
+    # agreement test below pins the same cross-layout norm algebra fast
     def test_fused_zero_fsdp_agree(self, devices):
         p_fused, l_fused = self._step(devices, "fused")
         for strategy in ("zero", "fsdp"):

