@@ -69,7 +69,13 @@ def _pick_block(lp: int, want: int) -> int:
 def _block_env(name: str, default: int) -> int:
     """Block-size tuning hook (TPU_DDP_FLASH_{BQ,BK,BWD_BQ,BWD_BK}):
     read at trace time, so a bench sweep can try tile shapes without a
-    code edit. Defaults are the shipped, measured-best values (v5e
+    code edit. Trace-time means once a given shape has been traced in a
+    process, jax's jit cache (keyed on avals, not env) silently reuses
+    the previously-traced tiles — an in-process sweep would record
+    identical timings for "different" tiles. Each tile configuration
+    therefore needs a fresh process (the round-4 sweep ran one
+    subprocess per tile config for exactly this reason).
+    Defaults are the shipped, measured-best values (v5e
     sweep, round 4): fwd 512/1024 and bwd 512/512 beat the previous
     256/512 + 256/256 by 14% on the TransformerLM-large step (0.512 ->
     0.586 MFU at batch 4 seq 2048), +28% on the small LM, +46% at seq

@@ -129,9 +129,22 @@ class Trainer:
         # everywhere after sync), ZeRO-1 from its dp-scattered slices
         # (ZeRO1.apply_scattered), FSDP from its flat dp shards — all
         # exactly equal up to reduction order (tests/test_clip_norm.py).
+        # Exception: strategy 'none' never syncs, so each replica clips
+        # by its OWN local norm and the clipped rung diverges across
+        # replicas by design (consistent with that rung's no-sync
+        # semantics) — warned below so nobody assumes torch-style
+        # global clipping there.
         if clip_grad_norm is not None and clip_grad_norm <= 0:
             raise ValueError(
                 f"clip_grad_norm must be > 0, got {clip_grad_norm}")
+        if (clip_grad_norm is not None and mesh is not None
+                and canonical_strategy(strategy) == "none"):
+            import warnings
+            warnings.warn(
+                "clip_grad_norm with strategy 'none': each replica clips "
+                "by its own LOCAL gradient norm (no sync), so replicas "
+                "diverge; use a syncing rung for global-norm clipping.",
+                stacklevel=2)
         self.clip_grad_norm = clip_grad_norm
         self.metrics = metrics if metrics is not None else MetricsLogger()
         self.strategy_name = strategy

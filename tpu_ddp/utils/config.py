@@ -120,7 +120,11 @@ class TrainConfig:
         # (EXPERIMENTS.md §6 measured ~4x/iter amplification at 0.1).
         env_lr = os.environ.get("TPU_DDP_LR")
         if env_lr:
-            self.learning_rate = float(env_lr)
+            lr = float(env_lr)
+            if not lr > 0:  # also rejects NaN
+                raise ValueError(f"TPU_DDP_LR={env_lr!r}: expected a "
+                                 "positive learning rate")
+            self.learning_rate = lr
         env_ck = os.environ.get("TPU_DDP_CKPT_EVERY")
         if env_ck:
             self.ckpt_every_iters = int(env_ck)
