@@ -155,3 +155,86 @@ class TestZeRO2:
                         jax.tree.leaves(jax.device_get(resumed.params))):
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        rtol=1e-5, atol=1e-6)
+
+
+class TestZeRO2Pipeline:
+    """ZeRO-2 under the 1F1B pipeline (round-4 verdict item 5): each
+    tick's block-gradient contribution is reduce-scattered over dp
+    inside the scan, so the accumulation carry holds 1/dp f32 slices —
+    num_micro IS the accumulation regime ZeRO-2 exists for."""
+
+    def _run_pp(self, devices, opt_sharding, clip=None, mp=1, steps=2,
+                num_micro=4):
+        from tpu_ddp.train.lm import PipelineLMTrainer
+        mesh = make_mesh(devices[:4 * mp], dp=2, pp=2, mp=mp)
+        tr = PipelineLMTrainer(
+            _model(), mesh, num_micro=num_micro, schedule="1f1b",
+            opt_sharding=opt_sharding, clip_grad_norm=clip,
+            optimizer=SGD(learning_rate=0.1, momentum=0.9,
+                          weight_decay=1e-4))
+        state = tr.init_state(seed=21)
+        x, y = tr.put_batch(*make_lm_batch(_tokens()))
+        losses = []
+        for _ in range(steps):
+            state, loss = tr.train_step(state, x, y)
+            losses.append(float(np.mean(np.asarray(loss))))
+        return tr, jax.device_get(state.params), losses
+
+    def test_matches_zero1(self, devices):
+        """Per-tick scattered accumulation == zero1's scatter-at-the-end
+        (SGD: linear in the gradient, so fp roundoff only)."""
+        _, p1, l1 = self._run_pp(devices, "zero1")
+        _, p2, l2 = self._run_pp(devices, "zero2")
+        np.testing.assert_allclose(l2, l1, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=2e-5, atol=1e-6)
+
+    def test_matches_zero1_with_clip_and_tp(self, devices):
+        """Global-norm clip on the mixed slice tree + stage-internal tp
+        (P((pp, mp, dp)) state): still exactly zero1."""
+        _, p1, l1 = self._run_pp(devices, "zero1", clip=0.5, mp=2)
+        _, p2, l2 = self._run_pp(devices, "zero2", clip=0.5, mp=2)
+        np.testing.assert_allclose(l2, l1, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=2e-5, atol=1e-6)
+
+    def test_gpipe_refused(self, devices):
+        """GPipe differentiates the whole tick scan at once — no
+        per-microbatch accumulator exists to scatter, so the combination
+        is refused loudly rather than silently running as zero1."""
+        from tpu_ddp.train.lm import PipelineLMTrainer
+        mesh = make_mesh(devices[:4], dp=2, pp=2)
+        with pytest.raises(ValueError, match="1f1b"):
+            PipelineLMTrainer(_model(), mesh, num_micro=4,
+                              schedule="gpipe", opt_sharding="zero2")
+
+    @pytest.mark.slow  # two 1f1b compiles just for memory_analysis;
+    # scripts/zero2_memory.py records the same claim as an artifact
+    def test_accumulation_carry_is_sharded(self, devices):
+        """XLA's live-memory accounting must show the win: the zero2
+        program's peak temp allocation is smaller than zero1's (the
+        1F1B scan carry holds 1/dp block-gradient slices)."""
+        import pytest as _pytest
+        from tpu_ddp.train.lm import PipelineLMTrainer
+        mesh = make_mesh(devices[:2], dp=2, pp=1)
+
+        def compiled_peak(sharding):
+            tr = PipelineLMTrainer(
+                _model(), mesh, num_micro=4, schedule="1f1b",
+                opt_sharding=sharding,
+                optimizer=SGD(learning_rate=0.1, momentum=0.9,
+                              weight_decay=1e-4))
+            state = tr.init_state(seed=0)
+            x, y = tr.put_batch(*make_lm_batch(_tokens()))
+            lowered = tr._train_step.lower(state.params, state.opt_state,
+                                           x, y, *tr._extra_args(state))
+            try:
+                mem = lowered.compile().memory_analysis()
+                return int(mem.temp_size_in_bytes)
+            except Exception:
+                _pytest.skip("backend exposes no memory analysis")
+
+        z1, z2 = compiled_peak("zero1"), compiled_peak("zero2")
+        assert z2 < z1, (z1, z2)

@@ -138,6 +138,7 @@ class Trainer:
             raise ValueError(
                 f"clip_grad_norm must be > 0, got {clip_grad_norm}")
         if (clip_grad_norm is not None and mesh is not None
+                and mesh.shape[DATA_AXIS] > 1
                 and canonical_strategy(strategy) == "none"):
             import warnings
             warnings.warn(

@@ -157,8 +157,9 @@ class _MeshTrainer:
         """Divisibility constraints apply to the ASSEMBLED batch: in a
         multi-process launch each process's put_batch sees only its own
         shard of the batch axis. ``shard_ways`` = how many ways the
-        batch axis is sharded (dp*ep for the LM trainer, dp for the
-        pipeline): processes in the same model-parallel group feed the
+        batch axis is sharded (dp*ep for both trainers — pipeline
+        tokens are data-parallel over dp x ep too since round 5):
+        processes in the same model-parallel group feed the
         SAME rows, so the multiplier is capped at the shard count —
         ``local_b * process_count`` alone would overcount by the tp/pp
         replication factor and false-pass the divisibility checks."""
@@ -630,10 +631,15 @@ class PipelineLMTrainer(_MeshTrainer):
     Ulysses all-to-all over ``sp`` — the same in-block collectives the
     dense trunk uses, orthogonal to the stage ring over ``pp``),
     dropout (keys derive from (microbatch, global layer), so masks are
-    pipeline-geometry-independent), and ZeRO-1 optimizer-state sharding
-    (``opt_sharding="zero1"``: stacked leaves' state laid out
-    P((pp, dp)) — P((pp, mp, dp)) with stage-internal tp). Gradient
-    accumulation needs no separate
+    pipeline-geometry-independent), expert parallelism (ep > 1, round 5:
+    experts shard over ``ep`` within each stage — the MoE all_to_all
+    runs inside the stage's blocks, orthogonal to the stage ring, and
+    tokens are data-parallel over dp x ep), and ZeRO-1/2 optimizer-state
+    sharding (``opt_sharding="zero1"``: stacked leaves' state laid out
+    P((pp, dp)) — P((pp, mp, dp)) with stage-internal tp;
+    ``"zero2"``, 1F1B only: additionally reduce-scatters each tick's
+    block-gradient contribution over dp so the accumulation carry holds
+    1/dp f32 slices). Gradient accumulation needs no separate
     mechanism here: ``num_micro`` IS accumulation — every microbatch's
     gradient sums into one optimizer step, and raising it shrinks both
     per-microbatch activation memory and (under 1F1B, where residency
@@ -657,14 +663,10 @@ class PipelineLMTrainer(_MeshTrainer):
         self.pp = mesh.shape[PIPE_AXIS]
         self.tp = mesh.shape.get(MODEL_AXIS, 1)
         self.sp = mesh.shape[SEQ_AXIS]
+        self.ep = mesh.shape.get(EXPERT_AXIS, 1)
         if sp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown sequence-parallel mode {sp_mode!r};"
                              " expected 'ring' or 'ulysses'")
-        if mesh.shape.get(EXPERT_AXIS, 1) != 1:
-            raise ValueError("PipelineLMTrainer does not compose with "
-                             "expert parallelism (ep must be 1); MoE "
-                             "models do run under pp, with experts "
-                             "stage-local")
         if model.num_layers % self.pp:
             raise ValueError(f"num_layers={model.num_layers} not "
                              f"divisible by pp={self.pp}")
@@ -673,6 +675,15 @@ class PipelineLMTrainer(_MeshTrainer):
                                                  mode=sp_mode)
         if self.tp > 1:
             model = model.with_tensor_parallel(MODEL_AXIS, self.tp)
+        if self.ep > 1:
+            # Round-5: experts shard over ep WITHIN each pp stage — the
+            # MoE layer's all_to_all runs inside the stage's blocks,
+            # orthogonal to the stage ring over pp (exactly as the
+            # pp x sp composition runs ring attention inside stages).
+            # Tokens are data-parallel over (dp x ep), so the batch
+            # axis shards over both; stacked expert leaves' specs gain
+            # the ep axis (P(pp, ep, ...)) via pipeline_param_specs.
+            model = model.with_expert_parallel(EXPERT_AXIS, self.ep)
         self.model = model
         self.num_micro = num_micro if num_micro is not None else self.pp
         self.optimizer = optimizer or AdamW()
@@ -693,10 +704,23 @@ class PipelineLMTrainer(_MeshTrainer):
         # pp-sharded stacked block leaves is laid out P((pp, dp)) — each
         # stage's slice dp-sharded — via the same partition-aware ZeRO1
         # the LMTrainer uses for tp.
-        if opt_sharding not in ("replicated", "zero1"):
+        # ZeRO-2 under pp (round-5): 1F1B's per-tick block-gradient
+        # contributions are reduce-scattered over dp immediately and the
+        # scan carry holds 1/dp f32 slices — num_micro IS the
+        # accumulation here, so this is exactly the regime ZeRO-2's
+        # scattered accumulation pays in (arXiv:1910.02054 §5).
+        if opt_sharding not in ("replicated", "zero1", "zero2"):
             raise ValueError(f"unknown opt_sharding {opt_sharding!r}; "
-                             "choose 'replicated' or 'zero1'")
-        self.opt_zero1 = opt_sharding == "zero1"
+                             "choose 'replicated', 'zero1' or 'zero2'")
+        self.opt_zero1 = opt_sharding in ("zero1", "zero2")
+        self.opt_zero2 = opt_sharding == "zero2"
+        if self.opt_zero2 and schedule != "1f1b":
+            raise ValueError(
+                "opt_sharding='zero2' under the pipeline requires "
+                "schedule='1f1b': GPipe differentiates the whole tick "
+                "scan at once, so there is no per-microbatch gradient "
+                "accumulator to scatter — ZeRO-2's memory saving only "
+                "exists where the accumulation buffer does")
         if self.opt_zero1:
             from tpu_ddp.ops.optim import Adafactor
             from tpu_ddp.parallel.zero import ZeRO1
@@ -715,7 +739,7 @@ class PipelineLMTrainer(_MeshTrainer):
                 param_specs=self._param_specs,
                 mesh_axis_sizes=dict(mesh.shape))
         self._opt_specs = self.optimizer.state_specs(self._param_specs)
-        batch_spec = P(DATA_AXIS, SEQ_AXIS)
+        batch_spec = P((DATA_AXIS, EXPERT_AXIS), SEQ_AXIS)
         self._batch_sharding = NamedSharding(mesh, batch_spec)
         self._param_shardings = self._shardings(self._param_specs)
         self._opt_shardings = self._shardings(self._opt_specs)
@@ -738,20 +762,33 @@ class PipelineLMTrainer(_MeshTrainer):
         return self.optimizer.decay_mask(proto)
 
     def _sync_grads(self, grads, skip_dp: bool = False):
-        """Stacked block leaves are stage-local (mean over dp/sp only);
-        replicated leaves (embed/head/ln_f) got their real gradient on one
-        stage and zeros elsewhere — sum over pp reassembles it. Under
-        sequence parallelism every leaf's gradient is a partial from
-        this shard's L/sp chunk — the mean over ``sp`` (with the loss
-        scaled by the (dp, sp) shard count) telescopes to the global
-        token mean, the LMTrainer algebra.
-        ``skip_dp``: ZeRO-1 delegates the dp mean to its psum_scatter —
-        pp reassembly and the sp mean still happen here."""
+        """Stacked block leaves are stage-local (mean over dp/sp/ep
+        only); replicated leaves (embed/head/ln_f) got their real
+        gradient on one stage and zeros elsewhere — sum over pp
+        reassembles it. Under sequence parallelism every leaf's gradient
+        is a partial from this shard's L/sp chunk — the mean over ``sp``
+        (with the loss scaled by the (dp, sp, ep) shard count)
+        telescopes to the global token mean, the LMTrainer algebra.
+        An ep-sharded expert leaf owns its slice (no ep collective) BUT
+        its gradient already holds the SUM over every ep token shard's
+        contribution (the backward all_to_all delivered them), so its
+        mean over ``ep`` is a plain division — LMTrainer._sync_grads'
+        excluded-axis algebra.
+        ``skip_dp``: ZeRO-1/2 delegate the dp mean to their psum_scatter
+        — pp reassembly and the sp/ep means still happen here (under
+        ZeRO-2 the block leaves arrive as dp-scattered f32 slices; every
+        op here is elementwise or a non-dp collective, and linear ops
+        commute with slicing)."""
         def leaf(g, spec):
-            if PIPE_AXIS not in tuple(spec):
+            sharded = _spec_axes(spec)
+            if PIPE_AXIS not in sharded:
                 g = lax.psum(g, PIPE_AXIS)
-            if self.sp > 1:
-                g = lax.pmean(g, SEQ_AXIS)
+            sync = tuple(a for a in (SEQ_AXIS, EXPERT_AXIS)
+                         if self.mesh.shape[a] > 1 and a not in sharded)
+            if sync:
+                g = lax.pmean(g, sync)
+            if EXPERT_AXIS in sharded and self.ep > 1:
+                g = g / float(self.ep)
             return g if skip_dp else lax.pmean(g, DATA_AXIS)
         return jax.tree.map(leaf, grads, self._param_specs)
 
@@ -762,8 +799,8 @@ class PipelineLMTrainer(_MeshTrainer):
         return (jax.random.fold_in(self._dropout_key, state.step),)
 
     def _decorrelate_rng(self, rng):
-        """Distinct dropout keys per dp/sp shard (different tokens); the
-        SAME key across pp stages — a microbatch's (mb, layer) mask
+        """Distinct dropout keys per dp/sp/ep shard (different tokens);
+        the SAME key across pp stages — a microbatch's (mb, layer) mask
         derivation must agree on whichever stage runs that layer — and
         across mp shards (replicated residual stream)."""
         if self.model.dropout_rate <= 0.0:
@@ -771,6 +808,8 @@ class PipelineLMTrainer(_MeshTrainer):
         rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
         if self.sp > 1:
             rng = jax.random.fold_in(rng, lax.axis_index(SEQ_AXIS))
+        if self.ep > 1:
+            rng = jax.random.fold_in(rng, lax.axis_index(EXPERT_AXIS))
         return rng
 
     def _base_step(self, params, opt_state, inputs, targets, rng):
@@ -779,15 +818,23 @@ class PipelineLMTrainer(_MeshTrainer):
 
         rng = self._decorrelate_rng(rng)
 
-        # The loss is normalized over the (dp, sp) token shards: scale
-        # by the shard count so the pmean in _sync_grads telescopes to
-        # the grad of the GLOBAL token mean (the LMTrainer algebra).
-        data_axes = ((DATA_AXIS, SEQ_AXIS) if self.sp > 1
-                     else (DATA_AXIS,))
+        # The loss is normalized over the (dp, sp, ep) token shards:
+        # scale by the shard count so the pmean in _sync_grads
+        # telescopes to the grad of the GLOBAL token mean (the LMTrainer
+        # algebra).
+        data_axes = ((DATA_AXIS,)
+                     + ((SEQ_AXIS,) if self.sp > 1 else ())
+                     + ((EXPERT_AXIS,) if self.ep > 1 else ()))
         if self.schedule == "1f1b":
+            scatter = (self.optimizer.scatter_grads if self.opt_zero2
+                       else None)
             masked_sum, local_n, grads = pipeline_1f1b_grads(
                 self.model, params, inputs, targets, pp_size=self.pp,
-                num_micro=self.num_micro, rng=rng)
+                num_micro=self.num_micro, rng=rng,
+                scatter_blocks=scatter,
+                blocks_grad_init=(
+                    self.optimizer.shard_zeros(params["blocks"])
+                    if self.opt_zero2 else None))
             total = lax.psum(local_n, data_axes)
             n_shards = lax.psum(1.0, data_axes)
             # Same normalization the gpipe loss_fn differentiates.
@@ -811,7 +858,19 @@ class PipelineLMTrainer(_MeshTrainer):
         # Under ZeRO-1 only the pp half of the sync happens here (the
         # wrapper's psum_scatter is the dp half); one shared apply.
         grads = self._sync_grads(grads, skip_dp=self.opt_zero1)
-        if self.opt_zero1:
+        if self.opt_zero2:
+            # Block slices were scattered per tick inside the 1F1B scan;
+            # the small replicated leaves (embed/ln_f/head, now
+            # pp-reassembled) scatter once here, and apply_scattered
+            # finishes the step (clip on slices, update, all_gather).
+            rest = {k: grads[k] for k in ("embed", "ln_f", "head")}
+            g_sh = dict(self.optimizer.scatter_grads(rest),
+                        blocks=grads["blocks"])
+            params, opt_state = self.optimizer.apply_scattered(
+                params, g_sh, opt_state,
+                decay_mask=self._decay_mask(params),
+                clip_norm=self.clip_grad_norm)
+        elif self.opt_zero1:
             params, opt_state = self.optimizer.apply(
                 params, grads, opt_state,
                 decay_mask=self._decay_mask(params),
@@ -835,10 +894,11 @@ class PipelineLMTrainer(_MeshTrainer):
         inputs = np.ascontiguousarray(inputs, np.int32)
         targets = np.ascontiguousarray(targets, np.int32)
         b, L = inputs.shape
-        gb = self._global_batch(b, self.dp)
-        if gb % (self.dp * self.num_micro):
-            raise ValueError(f"global batch {gb} not divisible by "
-                             f"dp*num_micro={self.dp * self.num_micro}")
+        gb = self._global_batch(b, self.dp * self.ep)
+        if gb % (self.dp * self.ep * self.num_micro):
+            raise ValueError(
+                f"global batch {gb} not divisible by dp*ep*num_micro="
+                f"{self.dp * self.ep * self.num_micro}")
         if L % self.sp:
             raise ValueError(f"seq len {L} not divisible by sp={self.sp}")
         return (self._put_sharded(inputs, self._batch_sharding),
