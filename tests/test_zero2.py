@@ -121,8 +121,9 @@ class TestZeRO2:
             x, y = tr.put_batch(*make_lm_batch(_tokens()))
             lowered = tr._train_step.lower(state.params, state.opt_state,
                                            x, y, *tr._extra_args(state))
+            compiled = lowered.compile()  # a compile FAILURE must fail
             try:
-                mem = lowered.compile().memory_analysis()
+                mem = compiled.memory_analysis()
                 return int(mem.temp_size_in_bytes)
             except Exception:
                 pytest.skip("backend exposes no memory analysis")
@@ -230,8 +231,9 @@ class TestZeRO2Pipeline:
             x, y = tr.put_batch(*make_lm_batch(_tokens()))
             lowered = tr._train_step.lower(state.params, state.opt_state,
                                            x, y, *tr._extra_args(state))
+            compiled = lowered.compile()  # a compile FAILURE must fail
             try:
-                mem = lowered.compile().memory_analysis()
+                mem = compiled.memory_analysis()
                 return int(mem.temp_size_in_bytes)
             except Exception:
                 _pytest.skip("backend exposes no memory analysis")

@@ -215,9 +215,15 @@ class Adafactor:
     row-sharded wrapper ``tpu_ddp.parallel.zero.FactoredZeRO1``
     (``LMTrainer(opt_sharding="zero1")`` selects it automatically) — the
     generic flat re-layout (``map_param_like``) cannot host factored
-    state and refuses loudly. Tensor-sharded (tp/ep) parameter leaves
-    are likewise refused by ``state_specs``; those stacks use
-    :class:`AdamW`.
+    state and refuses loudly. Tensor-sharded (tp/ep/pp-stacked)
+    parameter leaves compose via PER-CELL factoring (round-5): the
+    trainers wrap this optimizer in
+    ``tpu_ddp.parallel.zero.CellAdafactor`` (replicated opt) or the
+    partition-aware ``FactoredZeRO1`` (``opt_sharding="zero1"``) — each
+    model-parallel cell factors its own local slice, the T5X semantic.
+    The BARE ``state_specs`` still refuses sharded leaves (its reduced
+    state shapes have no global layout without the cell axes those
+    wrappers add).
     """
 
     learning_rate: Any = None       # None -> relative step size schedule
@@ -308,9 +314,11 @@ class Adafactor:
         def check(spec):
             if tuple(x for x in spec if x is not None):
                 raise NotImplementedError(
-                    "Adafactor's factored state does not compose with "
-                    f"sharded parameter leaves (got spec {spec}); use "
-                    "AdamW for tp/ep-sharded models")
+                    "bare Adafactor's factored state does not compose "
+                    f"with sharded parameter leaves (got spec {spec}); "
+                    "wrap it in tpu_ddp.parallel.zero.CellAdafactor "
+                    "(per-cell factoring — the LM trainers do this "
+                    "automatically) or use AdamW")
             return spec
         jax.tree.map(check, param_specs,
                      is_leaf=lambda x: isinstance(x, PartitionSpec))
@@ -330,59 +338,73 @@ class Adafactor:
             "(LMTrainer(opt_sharding='zero1')) which shards the factored "
             "state natively, or AdamW under FSDP")
 
-    def apply(self, params, grads, state, decay_mask=None):
-        count = state["count"] + 1
+    def _schedule_terms(self, count):
+        """(beta2t, rho, lr) for 1-based step ``count`` — the shared
+        per-step scalars of :meth:`apply` and the per-cell wrapper
+        (tpu_ddp/parallel/zero.py:CellAdafactor)."""
         c = count.astype(jnp.float32)
         beta2t = 1.0 - c ** (-self.decay_rate)
         if self.learning_rate is None:
-            rho = jnp.minimum(1e-2, 1.0 / jnp.sqrt(c))
-            lr = None
+            return beta2t, jnp.minimum(1e-2, 1.0 / jnp.sqrt(c)), None
+        lr = (self.learning_rate(c) if callable(self.learning_rate)
+              else self.learning_rate)
+        return beta2t, None, lr
+
+    def _leaf_update(self, p, g, vr, vc, v, mu, dk, beta2t, rho, lr):
+        """One leaf's Adafactor update — factoring planned from
+        ``p.shape``, update-RMS clip and relative step size over THIS
+        leaf only. Inside a shard_map ``p`` is the local cell, so
+        calling this per cell IS the T5X per-cell factoring semantic
+        (each shard maintains row/col moments of its own slice)."""
+        g32 = g.astype(jnp.float32)
+        g2 = jnp.square(g32) + self.eps1
+        if self._factored(p.shape):
+            # Factoring runs over the plan's 2-D-per-matrix view
+            # (identical to the leaf itself under the "batch" plan).
+            view = self._view_shape(p.shape)
+            g2v = g2.reshape(view)
+            new_vr = beta2t * vr + (1 - beta2t) * jnp.mean(g2v, axis=-1)
+            new_vc = beta2t * vc + (1 - beta2t) * jnp.mean(g2v, axis=-2)
+            new_v = v
+            # V[i,j] ≈ vr[i]·vc[j] / mean_i(vr) — exact for rank-1
+            # g² (with mean-form accumulators the normalizer is the
+            # row-moment MEAN, not its sum); rsqrt applied factored
+            # so the (n, m) moment matrix is never materialized.
+            r = new_vr / jnp.mean(new_vr, axis=-1, keepdims=True)
+            u = (g32.reshape(view) * jax.lax.rsqrt(r[..., :, None])
+                 * jax.lax.rsqrt(new_vc[..., None, :])).reshape(p.shape)
         else:
-            lr = (self.learning_rate(c) if callable(self.learning_rate)
-                  else self.learning_rate)
+            new_vr, new_vc = vr, vc
+            new_v = beta2t * v + (1 - beta2t) * g2
+            u = g32 * jax.lax.rsqrt(new_v)
+        rms_u = jnp.sqrt(jnp.mean(jnp.square(u)))
+        u = u / jnp.maximum(1.0, rms_u / self.clip_threshold)
+        if lr is None:
+            rms_p = jnp.sqrt(jnp.mean(jnp.square(
+                p.astype(jnp.float32))))
+            alpha = rho * jnp.maximum(self.eps2, rms_p)
+        else:
+            alpha = lr
+        if self.b1 is not None:
+            new_mu = self.b1 * mu + (1 - self.b1) * u.astype(p.dtype)
+            step = new_mu
+        else:
+            new_mu = mu
+            step = u
+        new_p = p - (alpha * step
+                     + (alpha * self.weight_decay * p if dk else 0.0)
+                     ).astype(p.dtype)
+        return new_p, new_vr, new_vc, new_v, new_mu
+
+    def apply(self, params, grads, state, decay_mask=None):
+        count = state["count"] + 1
+        beta2t, rho, lr = self._schedule_terms(count)
         if decay_mask is None:
             decay_mask = self.decay_mask(params)
 
         def upd(p, g, vr, vc, v, mu, dk):
-            g32 = g.astype(jnp.float32)
-            g2 = jnp.square(g32) + self.eps1
-            if self._factored(p.shape):
-                # Factoring runs over the plan's 2-D-per-matrix view
-                # (identical to the leaf itself under the "batch" plan).
-                view = self._view_shape(p.shape)
-                g2v = g2.reshape(view)
-                new_vr = beta2t * vr + (1 - beta2t) * jnp.mean(g2v, axis=-1)
-                new_vc = beta2t * vc + (1 - beta2t) * jnp.mean(g2v, axis=-2)
-                new_v = v
-                # V[i,j] ≈ vr[i]·vc[j] / mean_i(vr) — exact for rank-1
-                # g² (with mean-form accumulators the normalizer is the
-                # row-moment MEAN, not its sum); rsqrt applied factored
-                # so the (n, m) moment matrix is never materialized.
-                r = new_vr / jnp.mean(new_vr, axis=-1, keepdims=True)
-                u = (g32.reshape(view) * jax.lax.rsqrt(r[..., :, None])
-                     * jax.lax.rsqrt(new_vc[..., None, :])).reshape(p.shape)
-            else:
-                new_vr, new_vc = vr, vc
-                new_v = beta2t * v + (1 - beta2t) * g2
-                u = g32 * jax.lax.rsqrt(new_v)
-            rms_u = jnp.sqrt(jnp.mean(jnp.square(u)))
-            u = u / jnp.maximum(1.0, rms_u / self.clip_threshold)
-            if lr is None:
-                rms_p = jnp.sqrt(jnp.mean(jnp.square(
-                    p.astype(jnp.float32))))
-                alpha = rho * jnp.maximum(self.eps2, rms_p)
-            else:
-                alpha = lr
-            if self.b1 is not None:
-                new_mu = self.b1 * mu + (1 - self.b1) * u.astype(p.dtype)
-                step = new_mu
-            else:
-                new_mu = mu
-                step = u
-            new_p = p - (alpha * step
-                         + (alpha * self.weight_decay * p if dk else 0.0)
-                         ).astype(p.dtype)
-            return new_p, new_vr, new_vc, new_v, new_mu
+            return self._leaf_update(p, g, vr, vc, v, mu, dk,
+                                     beta2t, rho, lr)
 
         p_l, treedef = jax.tree.flatten(params)
         outs = [upd(*args) for args in zip(

@@ -426,7 +426,156 @@ class ZeRO1(_FlatLayout):
         return jax.tree.map(reassemble, params, new_p_sh), new_state
 
 
+class CellAdafactor:
+    """Adafactor over model-parallel-sharded leaves — PER-CELL factoring
+    (round-5; the T5X semantic: each mp/ep/pp cell maintains row/column
+    moments of its OWN local slice).
+
+    The bare :class:`~tpu_ddp.ops.optim.Adafactor` refuses sharded
+    parameter leaves: its factored moments have reduced shapes, and a
+    cell's row/column means are means over the LOCAL slice — there is
+    no global array those per-cell factors are a plain slice of (the
+    "split"-plan flattening mixes sharded dims into the view, and the
+    reduction that built ``vr`` erased the very axis ``mp`` shards).
+    This wrapper makes the per-cell layout explicit instead:
+
+    - UPDATE: inside shard_map every parameter leaf already IS its
+      local cell, so each cell simply runs Adafactor's per-leaf update
+      on its slice — factoring plan, update-RMS clip and relative step
+      size all per-cell, zero collectives added. Exactly "dense
+      Adafactor run on the sliced parameter tree" (tests/
+      test_adafactor.py pins that ground truth, which is NOT the dense
+      run's factored state sliced).
+    - STATE LAYOUT: reduced-shape state (``vr``/``vc``) gains one
+      leading cell axis per sharding mesh axis — global
+      ``(R1, ..., *cell_state_shape)`` sharded ``P(ax1, ...)`` — so
+      each cell's shard_map block is its own state (leading singletons
+      squeezed in ``apply``). Param-shaped state (unfactored ``v``,
+      momentum ``mu``) keeps the parameter's own spec: its local block
+      already aligns with the cell. Replicated leaves take the bare
+      optimizer's layout unchanged. State is replicated over dp
+      (:class:`FactoredZeRO1` additionally shards it 1/dp).
+
+    Checkpoint note: per-cell factored moments are coupled to the mesh
+    partitioning (as in T5X) — the state restores exactly into the
+    SAME tp/ep/pp layout; a different layout fails the restore shape
+    check loudly (utils/checkpoint.py). Parameters are full-size and
+    restore anywhere.
+    """
+
+    def __init__(self, inner, template, param_specs,
+                 mesh_axis_sizes: dict):
+        from tpu_ddp.ops.optim import Adafactor
+        if not isinstance(inner, Adafactor):
+            raise ValueError(
+                "CellAdafactor wraps Adafactor (per-cell factored "
+                "state); elementwise optimizers already shard state in "
+                "their parameter's own spec")
+        self.inner = inner
+        self.meta = jax.tree.map(_LeafMeta, template)
+        self._param_specs = param_specs
+        # dp_axis="": EVERY spec axis is a model-parallel cell axis here
+        # (never matches a real axis name, so nothing is refused as dp);
+        # sharding the state over dp as well is FactoredZeRO1's job.
+        self.part = jax.tree.map(
+            lambda s, m: _leaf_partition(s, m, mesh_axis_sizes, ""),
+            param_specs, self.meta,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def decay_mask(self, params):
+        return self.inner.decay_mask(params)
+
+    def _rows(self, *extra_trees):
+        """Flat per-leaf (meta, part, spec, *extras) rows aligned on the
+        params treedef; returns (treedef, rows)."""
+        m_l, treedef = jax.tree.flatten(self.meta)
+        pt_l = jax.tree.leaves(
+            self.part,
+            is_leaf=lambda x: x is None or isinstance(x, _LeafPart))
+        s_l = jax.tree.leaves(self._param_specs,
+                              is_leaf=lambda x: isinstance(x, P))
+        extras = [jax.tree.leaves(t) for t in extra_trees]
+        return treedef, list(zip(m_l, pt_l, s_l, *extras))
+
+    def _cell_shapes(self, local_shape):
+        """(vr, vc) cell-state shapes, or None when the CELL does not
+        factor (full second moment)."""
+        if self.inner._plan(local_shape) is None:
+            return None
+        view = self.inner._view_shape(local_shape)
+        return view[:-1], view[:-2] + view[-1:]
+
+    def init(self, params) -> dict:
+        one = lambda: jnp.zeros((1,), jnp.float32)  # noqa: E731
+        treedef, rows = self._rows(params)
+        vr_l, vc_l, v_l, mu_l = [], [], [], []
+        for m, pt, _, p in rows:
+            local = pt.local_shape if pt is not None else m.shape
+            cells = tuple(r for _, _, r in pt.parts) if pt else ()
+            cs = self._cell_shapes(local)
+            if cs is None:
+                vr_l.append(one())
+                vc_l.append(one())
+                v_l.append(jnp.zeros(m.shape, jnp.float32))
+            else:
+                vr_l.append(jnp.zeros(cells + cs[0], jnp.float32))
+                vc_l.append(jnp.zeros(cells + cs[1], jnp.float32))
+                v_l.append(one())
+            mu_l.append(jnp.zeros(m.shape, m.dtype)
+                        if self.inner.b1 is not None else one())
+        unf = treedef.unflatten
+        return {"vr": unf(vr_l), "vc": unf(vc_l), "v": unf(v_l),
+                "mu": unf(mu_l), "count": jnp.zeros((), jnp.int32)}
+
+    def state_specs(self, param_specs=None):
+        treedef, rows = self._rows()
+        vr_l, v_l, mu_l = [], [], []
+        for m, pt, spec in rows:
+            local = pt.local_shape if pt is not None else m.shape
+            factored = self._cell_shapes(local) is not None
+            vr_l.append(P(*pt.axes) if (factored and pt is not None)
+                        else P())
+            v_l.append(P() if factored else spec)
+            mu_l.append(spec if self.inner.b1 is not None else P())
+        unf = treedef.unflatten
+        vr = unf(vr_l)
+        return {"vr": vr, "vc": vr, "v": unf(v_l), "mu": unf(mu_l),
+                "count": P()}
+
+    def apply(self, params, grads, state, decay_mask=None):
+        """One per-cell step; call INSIDE shard_map when any leaf is
+        partitioned (each leaf must be its local cell — the factoring
+        plan is derived from the shapes seen here, which init derived
+        from the cells)."""
+        count = state["count"] + 1
+        beta2t, rho, lr = self.inner._schedule_terms(count)
+        if decay_mask is None:
+            decay_mask = self.inner.decay_mask(params)
+        treedef, rows = self._rows(
+            params, grads, state["vr"], state["vc"], state["v"],
+            state["mu"], decay_mask)
+        outs = []
+        for m, pt, _, p, g, vr, vc, v, mu, dk in rows:
+            k = len(pt.parts) if pt is not None else 0
+            factored = self._cell_shapes(tuple(p.shape)) is not None
+            if k and factored:
+                # (1, ..., *cell_state) shard_map block -> cell state.
+                vr = vr.reshape(vr.shape[k:])
+                vc = vc.reshape(vc.shape[k:])
+            new_p, nvr, nvc, nv, nmu = self.inner._leaf_update(
+                p, g, vr, vc, v, mu, dk, beta2t, rho, lr)
+            if k and factored:
+                nvr = nvr.reshape((1,) * k + nvr.shape)
+                nvc = nvc.reshape((1,) * k + nvc.shape)
+            outs.append((new_p, nvr, nvc, nv, nmu))
+        unf = lambda i: treedef.unflatten(  # noqa: E731
+            [o[i] for o in outs])
+        return unf(0), {"vr": unf(1), "vc": unf(2), "v": unf(3),
+                        "mu": unf(4), "count": count}
+
+
 class FactoredZeRO1:
+
     """ZeRO-1 for FACTORED optimizers (Adafactor) — exact, row-sharded.
 
     :class:`ZeRO1`'s flat slices destroy the row/column structure
@@ -449,10 +598,26 @@ class FactoredZeRO1:
     update compute and the O(nm) momentum 1/N over dp. Leaves too small
     to factor take :class:`ZeRO1`'s flat elementwise path, with the RMS
     terms psum'd so clipping stays global per leaf.
+
+    Round-5: composes with tensor/expert/pipeline sharding via PER-CELL
+    factoring (the :class:`CellAdafactor` semantic — row/column moments
+    of each cell's LOCAL slice). Pass ``param_specs`` +
+    ``mesh_axis_sizes`` and every mp/ep/pp-sharded leaf's state gains
+    one leading cell axis per sharding mesh axis, with the row geometry
+    computed from the CELL shape and the dp row-sharding applied WITHIN
+    each cell (``vr``: ``P((mp..., None..., dp))``). Inside shard_map
+    ``apply`` sees local cells, squeezes the leading singleton cell
+    axes, and runs the unchanged row-sharded update — so the sharded
+    step is exactly "FactoredZeRO1 on the sliced parameter tree".
+    Per-cell factored moments are layout-coupled (as in T5X):
+    checkpoints restore into the SAME mp layout only; a different
+    layout fails the restore shape check loudly. Unpartitioned layouts
+    keep their canonical (any-dp, any-trainer) checkpoint form.
     """
 
     def __init__(self, inner, axis_name: str = DATA_AXIS,
-                 axis_size: int | None = None, template=None):
+                 axis_size: int | None = None, template=None,
+                 param_specs=None, mesh_axis_sizes: dict | None = None):
         if axis_size is None or axis_size < 1:
             raise ValueError("FactoredZeRO1 needs the static dp axis size")
         if not hasattr(inner, "_plan"):
@@ -463,11 +628,31 @@ class FactoredZeRO1:
         self.axis_size = axis_size
         self.meta = (jax.tree.map(_LeafMeta, template)
                      if template is not None else None)
+        self._has_partition_info = param_specs is not None
+        if param_specs is not None:
+            if self.meta is None or mesh_axis_sizes is None:
+                raise ValueError("FactoredZeRO1 with param_specs needs a "
+                                 "params template and mesh_axis_sizes")
+            self.part = jax.tree.map(
+                lambda s, m: _leaf_partition(s, m, mesh_axis_sizes,
+                                             self.axis_name),
+                param_specs, self.meta,
+                is_leaf=lambda x: isinstance(x, P))
+        else:
+            self.part = (jax.tree.map(lambda m: None, self.meta)
+                         if self.meta is not None else None)
 
     # Shared helpers (same semantics as the flat-layout wrappers; aliased,
     # not re-implemented, so the two cannot drift).
     _chunk = _FlatLayout._chunk
     _require_meta = _FlatLayout._require_meta
+    _part_leaves = _FlatLayout._part_leaves
+
+    def decay_mask(self, params):
+        """Inner optimizer's policy, passed through so trainers that
+        override the mask (pipeline stacked leaves) can query the
+        wrapper like they would the bare optimizer."""
+        return self.inner.decay_mask(params)
 
     # ---- per-leaf geometry ---------------------------------------------
 
@@ -483,168 +668,254 @@ class FactoredZeRO1:
 
     # ---- state layout (global view) ------------------------------------
 
+    @staticmethod
+    def _local(m_or_p, pt):
+        """LOCAL cell shape of one leaf (= the full shape sans
+        partition)."""
+        return pt.local_shape if pt is not None else tuple(m_or_p.shape)
+
+    @staticmethod
+    def _cells(pt) -> tuple:
+        """Leading cell-axis extents, major to minor (empty sans
+        partition)."""
+        return tuple(r for _, _, r in pt.parts) if pt is not None else ()
+
+    def _leaf_rows(self, tree):
+        """(treedef, [(leaf, part), ...]) aligned on ``tree``'s leaves."""
+        l_l, treedef = jax.tree.flatten(tree)
+        return treedef, list(zip(l_l, self._part_leaves(len(l_l))))
+
     def init(self, params) -> dict:
         N = self.axis_size
         one = lambda: jnp.zeros((1,), jnp.float32)  # noqa: E731
-
-        def vr(p):
-            g = self._geom(p.shape)
+        treedef, rows = self._leaf_rows(params)
+        vr_l, vc_l, v_l, mu_l = [], [], [], []
+        for p, pt in rows:
+            local = self._local(p, pt)
+            cells = self._cells(pt)
+            g = self._geom(local)
             if g is None:
-                return one()
-            lead, n, m, n_loc = g
-            return jnp.zeros(lead + (n_loc * N,), jnp.float32)
-
-        def vc(p):
-            g = self._geom(p.shape)
-            if g is None:
-                return one()
-            lead, n, m, n_loc = g
-            return jnp.zeros(lead + (m,), jnp.float32)
-
-        def v(p):
-            if self._geom(p.shape) is not None:
-                return one()
-            return jnp.zeros((self._chunk(p.size) * N,), jnp.float32)
-
-        def mu(p):
-            if self.inner.b1 is None:
-                return one()
-            g = self._geom(p.shape)
-            if g is None:
-                return jnp.zeros((self._chunk(p.size) * N,), p.dtype)
-            lead, n, m, n_loc = g
-            return jnp.zeros(lead + (n_loc * N, m), p.dtype)
-
-        return {"vr": jax.tree.map(vr, params),
-                "vc": jax.tree.map(vc, params),
-                "v": jax.tree.map(v, params),
-                "mu": jax.tree.map(mu, params),
-                "count": jnp.zeros((), jnp.int32)}
+                chunk = self._chunk(int(np.prod(local)))
+                vr_l.append(one())
+                vc_l.append(one())
+                v_l.append(jnp.zeros(cells + (chunk * N,), jnp.float32))
+                mu_l.append(jnp.zeros(cells + (chunk * N,), p.dtype)
+                            if self.inner.b1 is not None else one())
+            else:
+                lead, n, m, n_loc = g
+                vr_l.append(jnp.zeros(cells + lead + (n_loc * N,),
+                                      jnp.float32))
+                vc_l.append(jnp.zeros(cells + lead + (m,), jnp.float32))
+                v_l.append(one())
+                mu_l.append(jnp.zeros(cells + lead + (n_loc * N, m),
+                                      p.dtype)
+                            if self.inner.b1 is not None else one())
+        unf = treedef.unflatten
+        return {"vr": unf(vr_l), "vc": unf(vc_l), "v": unf(v_l),
+                "mu": unf(mu_l), "count": jnp.zeros((), jnp.int32)}
 
     def state_specs(self, param_specs=None):
-        """Per-leaf specs over the layout above. Only replicated params
-        are supported — the row geometry is computed from FULL leaf
-        shapes, so tp/ep-sharded leaves are refused loudly (the same
-        refusal the replicated Adafactor makes), not silently mis-rowed.
-        """
+        """Per-leaf specs over the layout above. Without partition info
+        (no ``param_specs`` at construction) sharded parameter leaves
+        are refused loudly — the row geometry would silently be computed
+        from FULL leaf shapes; construct with ``param_specs`` +
+        ``mesh_axis_sizes`` for the per-cell layout."""
         self._require_meta()
-        if param_specs is not None:
+        # Skip the refusal whenever partition INFO was supplied at
+        # construction — even if every sharding axis has extent 1 (all
+        # parts None), the caller already did the right thing and the
+        # layout degenerates correctly.
+        if param_specs is not None and not self._has_partition_info:
             def check(spec):
                 if tuple(x for x in spec if x is not None):
                     raise NotImplementedError(
-                        "FactoredZeRO1 shards over full-leaf row geometry "
-                        f"and does not compose with sharded parameter "
-                        f"leaves (got spec {spec}); use AdamW for "
-                        "tp/ep-sharded models")
+                        "FactoredZeRO1 without partition info shards "
+                        "over full-leaf row geometry and cannot host "
+                        f"sharded parameter leaves (got spec {spec}); "
+                        "construct it with param_specs + mesh_axis_sizes "
+                        "for per-cell factoring")
                 return spec
             jax.tree.map(check, param_specs,
                          is_leaf=lambda x: isinstance(x, P))
         ax = self.axis_name
-
-        def vr_spec(m):
-            g = self._geom(m.shape)
+        treedef, rows = self._leaf_rows(self.meta)
+        vr_l, vc_l, v_l, mu_l = [], [], [], []
+        for m, pt in rows:
+            local = self._local(m, pt)
+            axes = pt.axes if pt is not None else ()
+            g = self._geom(local)
             if g is None:
-                return P()
-            return P(*([None] * len(g[0])), ax)
-
-        def v_spec(m):
-            return P() if self._geom(m.shape) is not None else P(ax)
-
-        def mu_spec(m):
-            if self.inner.b1 is None:
-                return P()
-            g = self._geom(m.shape)
-            if g is None:
-                return P(ax)
-            return P(*([None] * len(g[0])), ax, None)
-
-        return {"vr": jax.tree.map(vr_spec, self.meta),
-                "vc": jax.tree.map(lambda m: P(), self.meta),
-                "v": jax.tree.map(v_spec, self.meta),
-                "mu": jax.tree.map(mu_spec, self.meta),
-                "count": P()}
+                vr_l.append(P())
+                vc_l.append(P())
+                v_l.append(P(*axes, ax))
+                mu_l.append(P(*axes, ax)
+                            if self.inner.b1 is not None else P())
+            else:
+                lead = g[0]
+                vr_l.append(P(*axes, *([None] * len(lead)), ax))
+                vc_l.append(P(*axes) if axes else P())
+                v_l.append(P())
+                mu_l.append(P(*axes, *([None] * len(lead)), ax, None)
+                            if self.inner.b1 is not None else P())
+        unf = treedef.unflatten
+        return {"vr": unf(vr_l), "vc": unf(vc_l), "v": unf(v_l),
+                "mu": unf(mu_l), "count": P()}
 
     # ---- checkpoint canonicalization (host-side) -----------------------
 
     def canonicalize_opt_host(self, state) -> dict:
-        """Gathered (global-layout) host state -> the replicated
-        Adafactor's canonical shapes, so checkpoints restore at any dp
-        size or into an unsharded trainer."""
-        self._require_meta()
+        """Gathered (global-layout) host state -> canonical shapes.
 
-        def vr(x, m):
-            g = self._geom(m.shape)
+        Unpartitioned leaves canonicalize to the replicated Adafactor's
+        shapes (restore at any dp size or into an unsharded trainer).
+        Partitioned (per-cell) leaves strip the dp row padding but KEEP
+        their leading cell axes — per-cell factored moments have no
+        layout-independent form (the cells' factors are distinct
+        statistics), so they restore into the same mp layout only."""
+        self._require_meta()
+        treedef, rows = self._leaf_rows(self.meta)
+
+        def over(leaf_fn, tree):
+            return treedef.unflatten(
+                [leaf_fn(x, m, pt) for x, (m, pt)
+                 in zip(jax.tree.leaves(tree), rows)])
+
+        def vr(x, m, pt):
+            g = self._geom(self._local(m, pt))
             if g is None:
                 return np.asarray(x)
-            lead, n, _, _ = g
-            return np.asarray(x)[..., :n]
+            return np.asarray(x)[..., :g[1]]
 
-        def v(x, m):
-            if self._geom(m.shape) is not None:
+        def v(x, m, pt):
+            local = self._local(m, pt)
+            if self._geom(local) is not None:
                 return np.asarray(x)
-            return np.asarray(x)[:m.size].reshape(m.shape)
+            size = int(np.prod(local))
+            return np.asarray(x)[..., :size].reshape(
+                self._cells(pt) + tuple(local))
 
-        def mu(x, m):
+        def mu(x, m, pt):
             if self.inner.b1 is None:
                 return np.asarray(x)
-            g = self._geom(m.shape)
+            local = self._local(m, pt)
+            g = self._geom(local)
             if g is None:
-                return np.asarray(x)[:m.size].reshape(m.shape)
+                return v(x, m, pt)
             lead, n, mm, _ = g
-            return np.asarray(x)[..., :n, :].reshape(m.shape)
+            return np.asarray(x)[..., :n, :].reshape(
+                self._cells(pt) + tuple(local))
 
-        return {"vr": jax.tree.map(vr, state["vr"], self.meta),
-                "vc": jax.tree.map(lambda x, m: np.asarray(x),
-                                   state["vc"], self.meta),
-                "v": jax.tree.map(v, state["v"], self.meta),
-                "mu": jax.tree.map(mu, state["mu"], self.meta),
+        return {"vr": over(vr, state["vr"]),
+                "vc": over(lambda x, m, pt: np.asarray(x), state["vc"]),
+                "v": over(v, state["v"]),
+                "mu": over(mu, state["mu"]),
                 "count": state["count"]}
+
+    def canonical_opt_template(self, params_template) -> dict:
+        """ShapeDtypeStructs of the canonical (on-disk) state — what
+        :meth:`canonicalize_opt_host` emits — for building a restore
+        template. Reduces to the replicated Adafactor's ``init`` shapes
+        when no leaf is partitioned."""
+        self._require_meta()
+        sds = jax.ShapeDtypeStruct
+        treedef, rows = self._leaf_rows(self.meta)
+        vr_l, vc_l, v_l, mu_l = [], [], [], []
+        for m, pt in rows:
+            local = self._local(m, pt)
+            cells = self._cells(pt)
+            g = self._geom(local)
+            if g is None:
+                vr_l.append(sds((1,), jnp.float32))
+                vc_l.append(sds((1,), jnp.float32))
+                v_l.append(sds(cells + tuple(local), jnp.float32))
+                mu_l.append(sds(cells + tuple(local), m.dtype)
+                            if self.inner.b1 is not None
+                            else sds((1,), jnp.float32))
+            else:
+                lead, n, mm, _ = g
+                vr_l.append(sds(cells + lead + (n,), jnp.float32))
+                vc_l.append(sds(cells + lead + (mm,), jnp.float32))
+                v_l.append(sds((1,), jnp.float32))
+                mu_l.append(sds(cells + tuple(local), m.dtype)
+                            if self.inner.b1 is not None
+                            else sds((1,), jnp.float32))
+        unf = treedef.unflatten
+        return {"vr": unf(vr_l), "vc": unf(vc_l), "v": unf(v_l),
+                "mu": unf(mu_l),
+                "count": sds((), jnp.int32)}
 
     def flatten_opt(self, state) -> dict:
         """Canonical host state -> this wrapper's global layout (restore
         path; inverse of :meth:`canonicalize_opt_host`)."""
         self._require_meta()
         N = self.axis_size
+        treedef, rows = self._leaf_rows(self.meta)
 
-        def vr(x, m):
-            g = self._geom(m.shape)
+        def over(leaf_fn, tree):
+            return treedef.unflatten(
+                [leaf_fn(x, m, pt) for x, (m, pt)
+                 in zip(jax.tree.leaves(tree), rows)])
+
+        def vr(x, m, pt):
+            g = self._geom(self._local(m, pt))
             if g is None:
                 return np.asarray(x)
             lead, n, _, n_loc = g
-            pad = [(0, 0)] * len(lead) + [(0, n_loc * N - n)]
-            return np.pad(np.asarray(x), pad)
+            x = np.asarray(x)
+            pad = [(0, 0)] * (x.ndim - 1) + [(0, n_loc * N - n)]
+            return np.pad(x, pad)
 
-        def v(x, m):
-            if self._geom(m.shape) is not None:
+        def v(x, m, pt):
+            local = self._local(m, pt)
+            if self._geom(local) is not None:
                 return np.asarray(x)
-            flat = np.asarray(x).reshape(-1)
-            return np.pad(flat, (0, self._chunk(m.size) * N - m.size))
+            size = int(np.prod(local))
+            cells = self._cells(pt)
+            flat = np.asarray(x).reshape(cells + (size,))
+            pad = [(0, 0)] * len(cells) \
+                + [(0, self._chunk(size) * N - size)]
+            return np.pad(flat, pad)
 
-        def mu(x, m):
+        def mu(x, m, pt):
             if self.inner.b1 is None:
                 return np.asarray(x)
-            g = self._geom(m.shape)
+            local = self._local(m, pt)
+            g = self._geom(local)
             if g is None:
-                return v(x, m)
+                return v(x, m, pt)
             lead, n, mm, n_loc = g
-            arr = np.asarray(x).reshape(lead + (n, mm))
-            pad = [(0, 0)] * len(lead) + [(0, n_loc * N - n), (0, 0)]
+            cells = self._cells(pt)
+            arr = np.asarray(x).reshape(cells + lead + (n, mm))
+            pad = [(0, 0)] * (len(cells) + len(lead)) \
+                + [(0, n_loc * N - n), (0, 0)]
             return np.pad(arr, pad)
 
-        return {"vr": jax.tree.map(vr, state["vr"], self.meta),
-                "vc": jax.tree.map(lambda x, m: np.asarray(x),
-                                   state["vc"], self.meta),
-                "v": jax.tree.map(v, state["v"], self.meta),
-                "mu": jax.tree.map(mu, state["mu"], self.meta),
+        return {"vr": over(vr, state["vr"]),
+                "vc": over(lambda x, m, pt: np.asarray(x), state["vc"]),
+                "v": over(v, state["v"]),
+                "mu": over(mu, state["mu"]),
                 "count": state["count"]}
 
     # ---- the sharded update (inside shard_map) -------------------------
 
-    def apply(self, params, grads, opt_state):
+    def apply(self, params, grads, opt_state, decay_mask=None,
+              clip_norm=None):
         """One sharded Adafactor step; call inside shard_map over the dp
         axis with ``grads`` UNSYNCED over dp (pre-synced over any other
         data axes). Returns (new_params, new_state) with params full-size
-        and identical on every worker."""
+        and identical on every worker. Under partition-aware layouts
+        each leaf here is its LOCAL cell — the row geometry derives from
+        ``p.shape``, so the unchanged update IS per-cell factoring.
+
+        ``decay_mask``: optional override of the inner policy — the
+        pipeline trainer passes the ORIGINAL per-layer ranks so stacked
+        (L, dm) LayerNorm leaves are not decayed. ``clip_norm`` is
+        refused loudly: Adafactor already clips by update RMS."""
+        if clip_norm is not None:
+            raise ValueError(
+                "clip_norm with FactoredZeRO1 (Adafactor) is not "
+                "supported — Adafactor already clips by update RMS "
+                "(ops/optim.py); use AdamW/SGD or drop the clip")
         o = self.inner
         ax, N = self.axis_name, self.axis_size
         idx = lax.axis_index(ax)
@@ -657,7 +928,8 @@ class FactoredZeRO1:
             lr = (o.learning_rate(c) if callable(o.learning_rate)
                   else o.learning_rate)
             rho = None
-        decay_mask = o.decay_mask(params)
+        if decay_mask is None:
+            decay_mask = o.decay_mask(params)
 
         def alpha_for(p):
             if lr is not None:
@@ -665,13 +937,33 @@ class FactoredZeRO1:
             rms_p = jnp.sqrt(jnp.mean(jnp.square(p.astype(jnp.float32))))
             return rho * jnp.maximum(o.eps2, rms_p)
 
-        def upd(p, g, vr, vc, v, mu, dk):
+        def upd(p, g, vr, vc, v, mu, dk, pt):
+            # Partitioned leaves' REAL state blocks arrive as
+            # (1, ..., *cell_state) inside shard_map: squeeze the
+            # leading singleton cell axes, update, restore them. The
+            # (1,)-placeholder leaves (vr/vc of an unfactored cell, v of
+            # a factored one, mu sans b1) carry no cell axes and pass
+            # through untouched.
+            k = len(pt.parts) if pt is not None else 0
             geom = self._geom(p.shape)
+            has_mu = self.inner.b1 is not None
+            sq = [k and geom is not None, k and geom is not None,
+                  k and geom is None, k and has_mu]  # vr, vc, v, mu
+            if k:
+                vr, vc, v, mu = (x.reshape(x.shape[k:]) if s else x
+                                 for s, x in zip(sq, (vr, vc, v, mu)))
             if geom is None:
-                return self._upd_flat(p, g, vr, vc, v, mu, dk, idx,
-                                      beta2t, alpha_for(p))
-            return self._upd_factored(p, g, vr, vc, v, mu, dk, idx,
-                                      beta2t, alpha_for(p), geom)
+                out = self._upd_flat(p, g, vr, vc, v, mu, dk, idx,
+                                     beta2t, alpha_for(p))
+            else:
+                out = self._upd_factored(p, g, vr, vc, v, mu, dk, idx,
+                                         beta2t, alpha_for(p), geom)
+            if k:
+                new_p, nvr, nvc, nv, nmu = out
+                out = (new_p,) + tuple(
+                    x.reshape((1,) * k + x.shape) if s else x
+                    for s, x in zip(sq, (nvr, nvc, nv, nmu)))
+            return out
 
         p_l, treedef = jax.tree.flatten(params)
         outs = [upd(*args) for args in zip(
@@ -680,7 +972,8 @@ class FactoredZeRO1:
             jax.tree.leaves(opt_state["vc"]),
             jax.tree.leaves(opt_state["v"]),
             jax.tree.leaves(opt_state["mu"]),
-            jax.tree.leaves(decay_mask))]
+            jax.tree.leaves(decay_mask),
+            self._part_leaves(len(p_l)))]
         unf = lambda i: treedef.unflatten([o_[i] for o_ in outs])  # noqa: E731
         return unf(0), {"vr": unf(1), "vc": unf(2), "v": unf(3),
                         "mu": unf(4), "count": count}

@@ -219,7 +219,14 @@ class _MeshTrainer:
             shapes = {"params": params_t, "opt_state": opt_t}
         elif getattr(self, "opt_zero1", False):
             params_t = self._params_template  # built with the wrapper
-            opt_t = jax.eval_shape(self.optimizer.inner.init, params_t)
+            # Per-cell factored layouts (FactoredZeRO1 with partitions)
+            # have their OWN canonical form — ask the wrapper; flat
+            # ZeRO1's canonical form is the inner optimizer's shapes.
+            if hasattr(self.optimizer, "canonical_opt_template"):
+                opt_t = self.optimizer.canonical_opt_template(params_t)
+            else:
+                opt_t = jax.eval_shape(self.optimizer.inner.init,
+                                       params_t)
             shapes = {"params": params_t, "opt_state": opt_t}
         else:
             shapes = jax.eval_shape(
@@ -340,12 +347,6 @@ class LMTrainer(_MeshTrainer):
             # fails loudly in ZeRO1's map_param_like rather than being
             # silently re-laid-out wrong.
             if isinstance(self.optimizer, Adafactor):
-                if self.tp > 1 or self.ep > 1:
-                    raise ValueError(
-                        "opt_sharding='zero1' with Adafactor shards over "
-                        "full-leaf row geometry and does not compose "
-                        "with tensor (mp) or expert (ep) sharding; use "
-                        "AdamW for tp/ep-sharded models")
                 if self.opt_zero2:
                     raise ValueError(
                         "opt_sharding='zero2' (dp-scattered flat "
@@ -358,9 +359,14 @@ class LMTrainer(_MeshTrainer):
                         "Adafactor is not supported (Adafactor already "
                         "clips by update RMS, ops/optim.py); use AdamW/"
                         "SGD or drop the clip")
+                # Round-5: tp/ep-sharded leaves compose via PER-CELL
+                # factoring — row geometry from each cell's LOCAL slice,
+                # dp row-sharding within the cell (zero.py docstrings).
                 self.optimizer = FactoredZeRO1(
                     self.optimizer, DATA_AXIS, self.dp,
-                    template=self._params_template)
+                    template=self._params_template,
+                    param_specs=self.model.param_specs(),
+                    mesh_axis_sizes=dict(mesh.shape))
             else:
                 # Elementwise optimizers compose with tp/ep: each
                 # mp/ep-sharded leaf's state is laid out per model-
@@ -389,6 +395,19 @@ class LMTrainer(_MeshTrainer):
             self._opt_specs = self.zero3.state_specs()
         else:
             self._param_specs = self.model.param_specs()
+            from tpu_ddp.ops.optim import Adafactor
+            if (isinstance(self.optimizer, Adafactor)
+                    and (self.tp > 1 or self.ep > 1)):
+                # Round-5: replicated-opt Adafactor under tp/ep — wrap
+                # into the per-cell layout (each mp/ep cell factors its
+                # own slice; state replicated over dp).
+                from tpu_ddp.parallel.zero import CellAdafactor
+                self.optimizer = CellAdafactor(
+                    self.optimizer,
+                    template=jax.eval_shape(
+                        lambda: self.model.init(jax.random.key(0))),
+                    param_specs=self._param_specs,
+                    mesh_axis_sizes=dict(mesh.shape))
             self._opt_specs = self.optimizer.state_specs(self._param_specs)
         batch_spec = P((DATA_AXIS, EXPERT_AXIS), SEQ_AXIS)
         self._batch_sharding = NamedSharding(mesh, batch_spec)
@@ -721,21 +740,55 @@ class PipelineLMTrainer(_MeshTrainer):
                 "scan at once, so there is no per-microbatch gradient "
                 "accumulator to scatter — ZeRO-2's memory saving only "
                 "exists where the accumulation buffer does")
+        from tpu_ddp.ops.optim import Adafactor
+        from tpu_ddp.parallel.pipeline import stack_block_params
         if self.opt_zero1:
-            from tpu_ddp.ops.optim import Adafactor
-            from tpu_ddp.parallel.zero import ZeRO1
-            if isinstance(self.optimizer, Adafactor):
-                raise ValueError(
-                    "opt_sharding='zero1' with Adafactor does not "
-                    "compose with the pipeline's stacked-leaf layout; "
-                    "use AdamW/SGD")
-            from tpu_ddp.parallel.pipeline import stack_block_params
+            from tpu_ddp.parallel.zero import FactoredZeRO1, ZeRO1
             self._params_template = jax.eval_shape(
                 lambda: stack_block_params(
                     self.model.init(jax.random.key(0))))
-            self.optimizer = ZeRO1(
-                self.optimizer, DATA_AXIS, self.dp,
-                template=self._params_template,
+            if isinstance(self.optimizer, Adafactor):
+                # Round-5: stacked pp(-and-mp/ep)-sharded leaves compose
+                # via PER-CELL factoring — each stage cell factors its
+                # own stacked slice, dp row-sharded within the cell
+                # (zero.py:FactoredZeRO1 round-5 notes).
+                if self.opt_zero2:
+                    raise ValueError(
+                        "opt_sharding='zero2' (dp-scattered flat "
+                        "gradient slices) does not compose with "
+                        "Adafactor's row-sharded factored state; use "
+                        "'zero1' or an elementwise optimizer")
+                if self.clip_grad_norm is not None:
+                    raise ValueError(
+                        "clip_grad_norm with opt_sharding='zero1' "
+                        "Adafactor is not supported (Adafactor already "
+                        "clips by update RMS, ops/optim.py); use AdamW/"
+                        "SGD or drop the clip")
+                self.optimizer = FactoredZeRO1(
+                    self.optimizer, DATA_AXIS, self.dp,
+                    template=self._params_template,
+                    param_specs=self._param_specs,
+                    mesh_axis_sizes=dict(mesh.shape))
+            else:
+                self.optimizer = ZeRO1(
+                    self.optimizer, DATA_AXIS, self.dp,
+                    template=self._params_template,
+                    param_specs=self._param_specs,
+                    mesh_axis_sizes=dict(mesh.shape))
+        elif isinstance(self.optimizer, Adafactor):
+            # Round-5: replicated-opt Adafactor under the pipeline — the
+            # per-cell layout over the STACKED specs (each stage/mp/ep
+            # cell factors its own stacked slice). Wrapped even at
+            # pp=1: pipeline_param_specs stamps PIPE_AXIS on block
+            # specs unconditionally, so the BARE state_specs would
+            # refuse; extent-1 axes partition trivially (parts drop
+            # them) and the wrapper degenerates to the bare layout.
+            from tpu_ddp.parallel.zero import CellAdafactor
+            self.optimizer = CellAdafactor(
+                self.optimizer,
+                template=jax.eval_shape(
+                    lambda: stack_block_params(
+                        self.model.init(jax.random.key(0)))),
                 param_specs=self._param_specs,
                 mesh_axis_sizes=dict(mesh.shape))
         self._opt_specs = self.optimizer.state_specs(self._param_specs)
@@ -863,7 +916,7 @@ class PipelineLMTrainer(_MeshTrainer):
             # the small replicated leaves (embed/ln_f/head, now
             # pp-reassembled) scatter once here, and apply_scattered
             # finishes the step (clip on slices, update, all_gather).
-            rest = {k: grads[k] for k in ("embed", "ln_f", "head")}
+            rest = {k: v for k, v in grads.items() if k != "blocks"}
             g_sh = dict(self.optimizer.scatter_grads(rest),
                         blocks=grads["blocks"])
             params, opt_state = self.optimizer.apply_scattered(
