@@ -1,0 +1,162 @@
+"""Per-layer roofline for the VGG-11 CIFAR-10 training step on TPU v5e.
+
+Round-4 verdict item 1a: the HEADLINE family's batch-sweep plateau
+(~0.43 MFU at batch 16384, bench_full.json batch_sweep) had no committed
+explanation while ResNet got one (scripts/resnet_roofline.py). Same
+model, same machinery, applied to the VGG-11 stack the reference trains
+(reference part1/model.py:3-8 channel plan, 32x32 CIFAR inputs):
+
+- FLOPs: 3x the forward conv FLOPs (backward does dX and dW matmuls).
+- HBM traffic: training BatchNorm with batch statistics (the
+  track_running_stats=False semantic) forces the conv OUTPUT through
+  HBM several times per step — written by the conv, read for the
+  mean/var reduction, read to normalize, read twice more in the
+  backward (dBN and dW), and dX written once: ``6 * bytes(conv out)``
+  bf16 passes per conv layer. Unlike the ResNet script, the VGG one
+  also charges the max-pool layers (read in + write out, forward and
+  backward) — at 32x32 VGG the five pools touch the same order of
+  activation bytes as the early convs.
+
+Per-layer time = max(flops / MXU_peak, traffic / HBM_BW); predicted
+step time = sum over layers; predicted MFU = counted_flops /
+(MXU_peak * step_time). The ``mxu_fill`` column reports each conv's
+K x N systolic-array fill (K = 9*C_in rows: the 3->64 stem fills only
+27/128 rows).
+
+Validation against the COMPILED program (round-4 verdict item 1b) lives
+in scripts/conv_traffic_validate.py — it reads XLA's cost analysis
+(flops + bytes accessed) off the real jitted train step and records the
+model-vs-compiler delta next to these predictions.
+
+Writes experiments/vgg_roofline.json; render in EXPERIMENTS.md §7.
+Pure arithmetic — runs anywhere, no device needed.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# TPU v5e (the bench chip): bf16 peak and HBM bandwidth. 197 is the
+# public v5e bf16 dense number and the SAME denominator the bench's MFU
+# block uses (utils/flops.py _PEAKS) — round-5 fix: the round-4 ResNet
+# roofline used 394 (the int8 TOPS figure), so its predicted-vs-
+# measured comparison mixed denominators.
+PEAK_TFLOPS = 197.0
+HBM_GBPS = 819.0
+ACT_BYTES = 2          # bf16 activations
+TRAFFIC_FACTOR = 6     # conv-out tensor HBM passes per training step
+
+VGG11 = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+
+
+def layers(batch: int, image_size: int = 32, num_classes: int = 10):
+    """(name, flops_fwd, traffic_bytes, k_dim, n_dim) per layer of the
+    VGG-11 training step, mirroring utils/flops.py:vgg_fwd_flops's
+    walk. Pools contribute traffic only (k=n=0 -> fill 1, no flops)."""
+    out = []
+    h = image_size
+    c_in = 3
+    li = 0
+    for width in VGG11:
+        if width == "M":
+            # fwd: read (N,h,h,c) + write (N,h/2,h/2,c); bwd: read dY +
+            # write dX (the saved argmax ride along, charged with dX).
+            elems_in = c_in * h * h * batch
+            traffic = ACT_BYTES * 2 * (elems_in + elems_in // 4)
+            out.append((f"pool{li}", 0.0, traffic, 0, 0))
+            h //= 2
+            continue
+        li += 1
+        flops = 2.0 * 9 * c_in * width * h * h * batch
+        traffic = TRAFFIC_FACTOR * ACT_BYTES * width * h * h * batch
+        out.append((f"conv{li}_{width}x{h}", flops, traffic,
+                    9 * c_in, width))
+        c_in = width
+    out.append(("head", 2.0 * c_in * num_classes * batch,
+                ACT_BYTES * num_classes * batch, c_in, num_classes))
+    return out
+
+
+def roofline(batch: int) -> dict:
+    peak = PEAK_TFLOPS * 1e12
+    bw = HBM_GBPS * 1e9
+    t_total = t_total_fill = flops_total = 0.0
+    t_compute = t_memory = 0.0
+    traffic_total = 0
+    rows = []
+    for name, f_fwd, traffic, k, n in layers(batch):
+        f_train = 3.0 * f_fwd
+        fill = ((min(k, 128) / 128) * (min(n, 128) / 128)
+                if k and n else 1.0)
+        tc = f_train / peak
+        tm = traffic / bw
+        t_total += max(tc, tm)
+        t_total_fill += max(tc / fill, tm)
+        t_compute += tc
+        t_memory += tm
+        flops_total += f_train
+        traffic_total += int(traffic)
+        rows.append({"layer": name,
+                     "train_gflops": round(f_train / 1e9, 2),
+                     "traffic_mb": round(traffic / 1e6, 1),
+                     "t_compute_us": round(tc * 1e6, 1),
+                     "t_memory_us": round(tm * 1e6, 1),
+                     "bound": "memory" if tm > tc else "compute",
+                     "mxu_fill": round(fill, 2)})
+    mem_bound = sum(1 for r in rows if r["bound"] == "memory")
+    return {
+        "batch": batch,
+        "predicted_step_s": round(t_total, 5),
+        "predicted_mfu": round(flops_total / (peak * t_total), 4),
+        "predicted_mfu_mxu_fill": round(
+            flops_total / (peak * t_total_fill), 4),
+        # Serial (no compute/memory overlap) ceiling from the ANALYTIC
+        # bytes. NOTE: the "within 2% of measured" validation of the
+        # serial model (EXPERIMENTS.md §7) uses XLA's REAL bytes from
+        # conv_traffic_validation.json, which are ~2x these analytic
+        # ones — this field shows the serial SHAPE, the validated
+        # ceiling number lives in that artifact.
+        "predicted_mfu_serial": round(
+            flops_total / (peak * (t_compute + t_memory)), 4),
+        "pure_compute_s": round(t_compute, 5),
+        "pure_memory_s": round(t_memory, 5),
+        "predicted_traffic_mb": round(traffic_total / 1e6, 1),
+        "memory_bound_layers": mem_bound,
+        "total_layers": len(rows),
+        "layers": rows,
+    }
+
+
+def main() -> int:
+    cells = [roofline(b) for b in (256, 1024, 4096, 16384)]
+    out = {
+        "chip": f"TPU v5e: {PEAK_TFLOPS} bf16 TFLOPs, {HBM_GBPS} GB/s HBM",
+        "model": ("per-layer max(flops/peak, traffic/bw); training "
+                  f"traffic = {TRAFFIC_FACTOR} bf16 passes over each "
+                  "conv output (conv write, BN stats read, BN normalize "
+                  "read, bwd dBN + dW reads, dX write) + max-pool "
+                  "read/write fwd+bwd — batch-stats BN training cannot "
+                  "fuse the stats reads into the conv"),
+        "cells": [{k: v for k, v in c.items() if k != "layers"}
+                  for c in cells],
+        "per_layer_batch16384": roofline(16384)["layers"],
+    }
+    (REPO / "experiments" / "vgg_roofline.json").write_text(
+        json.dumps(out, indent=1))
+    for c in out["cells"]:
+        print(f"[vgg-roofline] batch {c['batch']}: predicted MFU "
+              f"{c['predicted_mfu']} (mxu-fill-adjusted "
+              f"{c['predicted_mfu_mxu_fill']}; step "
+              f"{c['predicted_step_s']}s, "
+              f"{c['memory_bound_layers']}/{c['total_layers']} layers "
+              "memory-bound)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -172,6 +172,46 @@ def xla_flops(jitted_fn, *args) -> float | None:
         return None
 
 
+# Public HBM bandwidth GB/s per chip, keyed like _PEAKS: v2 700/board,
+# v3 900, v4 1228, v5e 819, v5p 2765, v6e (Trillium) 1640.
+_HBM_GBPS = (
+    ("v6", 1640.0),
+    ("v5p", 2765.0),
+    ("v5 lite", 819.0),
+    ("v5e", 819.0),
+    ("v5litepod", 819.0),
+    ("v5", 2765.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def device_hbm_gbps(device,
+                    default: float = 819.0) -> tuple[float, str]:
+    """(HBM bandwidth GB/s for ``device``, source label).
+
+    ``TPU_DDP_HBM_GBPS`` overrides; unknown kinds fall back to
+    ``default`` (the v5e bench chip) with the source saying so — so
+    bandwidth-utilization accounting degrades to a LABELED estimate,
+    never a number indistinguishable from a real measurement (the
+    peak_tflops contract, with a fallback instead of None)."""
+    env = os.environ.get("TPU_DDP_HBM_GBPS")
+    if env:
+        try:
+            return float(env), "env:TPU_DDP_HBM_GBPS"
+        except ValueError:
+            pass
+    kind = getattr(device, "device_kind", "")
+    for sub, bw in _HBM_GBPS:
+        if sub in kind.lower():
+            return bw, f"device_kind {kind!r}"
+    return default, (f"FALLBACK default (platform "
+                     f"{getattr(device, 'platform', '?')!r}, kind "
+                     f"{kind!r} not in table) — estimate, not the "
+                     "real chip's bandwidth")
+
+
 def mfu_fields(flops_per_step: float | None, step_seconds: float,
                device, xla_flops_per_step: float | None = None) -> dict:
     """The bench JSON's MFU block: achieved TFLOP/s, peak, MFU."""
