@@ -513,7 +513,8 @@ def render(out_path: Path | None = None) -> str:
 
     p = OUT_DIR / "zero2_memory.json"
     if p.exists():
-        cells = json.loads(p.read_text())["cells"]
+        z2doc = json.loads(p.read_text())
+        cells = z2doc["cells"]
         lines += [
             _section(lines, "ZeRO-2 — dp-scattered gradient "
                      "accumulation memory"),
@@ -559,76 +560,184 @@ def render(out_path: Path | None = None) -> str:
             "(arXiv:1910.02054 §5).",
             "",
         ]
+        pp_cells = z2doc.get("pp_cells", [])
+        ok_pp = [c for c in pp_cells
+                 if c.get("zero1", {}).get("temp_bytes")
+                 and c.get("zero2", {}).get("temp_bytes")]
+        if ok_pp:
+            ratios = sorted(c.get("saving_vs_expected", 0)
+                            for c in ok_pp)
+            ex = ok_pp[0]
+            lines += [
+                "**ZeRO-2 under the 1F1B pipeline (round 5).** "
+                "`PipelineLMTrainer(schedule=\"1f1b\", "
+                "opt_sharding=\"zero2\")` reduce-scatters each tick's "
+                "block-gradient contribution inside the scan, so the "
+                "carry accumulator holds 1/dp f32 slices of the "
+                "stage's stacked block leaves (`pp_cells` in "
+                "`experiments/zero2_memory.json`). Here the accounting "
+                "is *byte-exact*: every cell's measured temp saving "
+                "equals the predicted `4*(P_blocks/pp)*(1-1/dp)` "
+                f"(`saving_vs_expected` {ratios[0]}-{ratios[-1]}; "
+                f"e.g. dp={ex['zero1']['dp']} pp={ex['zero1']['pp']}: "
+                f"{ex['zero1']['temp_bytes'] / 1e6:.1f} -> "
+                f"{ex['zero2']['temp_bytes'] / 1e6:.1f} MB, saving "
+                f"{ex['measured_saving_bytes']:,} B = prediction) — "
+                "under 1F1B the per-tick transient gradient is one "
+                "stage-slice of one microbatch, far below the carry, "
+                "so the full carry saving lands in the peak. The "
+                "update is exact vs pp+zero1 incl. global-norm clip "
+                "and stage-internal tp "
+                "(tests/test_zero2.py::TestZeRO2Pipeline); GPipe+zero2 "
+                "is refused loudly — GPipe differentiates the whole "
+                "tick scan at once, so no per-microbatch accumulator "
+                "exists to scatter.",
+                "",
+            ]
 
-    p = OUT_DIR / "resnet_roofline.json"
+    p = OUT_DIR / "conv_traffic_validation.json"
     if p.exists():
         d = json.loads(p.read_text())
+        cells = [c for c in d.get("cells", []) if "error" not in c]
         lines += [
-            _section(lines, "ResNet-50 training roofline on v5e — why "
-                     "the MFU plateau is ~0.25, at any batch"),
+            _section(lines, "Conv-family rooflines on v5e — measured, "
+                     "validated against the compiled program"),
             "",
-            f"`scripts/resnet_roofline.py`; chip model: {d['chip']}. "
-            "Traffic model: " + d["model"] + ".",
+            "Round-5 rework of the round-4 ResNet-only section. Three "
+            "artifacts: `scripts/resnet_roofline.py` + "
+            "`scripts/vgg_roofline.py` (analytic per-layer models) and "
+            "`scripts/conv_traffic_validate.py` -> "
+            "`experiments/conv_traffic_validation.json` (the "
+            "compiled-program ground truth: XLA cost analysis `flops` "
+            "+ `bytes accessed` off the REAL jitted train step, plus a "
+            "measured step time on the bench chip).",
             "",
-            "| batch | predicted MFU | (MXU-fill adj.) | pure-compute s "
-            "| pure-memory s | memory-bound layers |",
-            "|---|---|---|---|---|---|",
+            "**Honesty correction first**: round 4's committed table "
+            "used 394 TFLOP/s as the v5e peak — that is the int8 TOPS "
+            "figure; the bf16 peak is 197, the same denominator the "
+            "bench's MFU block has always used (`utils/flops.py "
+            "_PEAKS`). With the right constant the analytic 6-pass "
+            "model no longer \"explains\" the ResNet plateau (it "
+            "predicts 0.59 where ~0.26 is measured) — which is exactly "
+            "why the verdict asked for validation against the compiled "
+            "program. The validation replaces the story with measured "
+            "terms:",
+            "",
+            "| cell | analytic act. bytes | XLA bytes (real) | "
+            "flops-bound s | bytes-bound s | measured s | "
+            "**achieved HBM** |",
+            "|---|---|---|---|---|---|---|",
         ]
-        for c in d["cells"]:
+        name = {"vgg11_cifar10": "VGG-11", "resnet50_imagenet":
+                "ResNet-50"}
+        for c in cells:
+            if "measured_step_s" not in c:
+                continue
             lines.append(
-                f"| {c['batch']} | {c['predicted_mfu']} | "
-                f"{c['predicted_mfu_mxu_fill']} | "
-                f"{c['pure_compute_s']} | {c['pure_memory_s']} | "
-                f"{c['memory_bound_layers']}/{c['total_layers']} |")
-        ceil = d["cells"][0]["predicted_mfu"]
-        mb_frac = (f"{d['cells'][0]['memory_bound_layers']} of "
-                   f"{d['cells'][0]['total_layers']}")
-        # Measured plateau from the committed bench artifact, when there.
-        measured = ""
-        bf = OUT_DIR / "bench_full.json"
-        if bf.exists():
-            rs = (json.loads(bf.read_text()).get("extra", {})
-                  .get("configs", {}).get("resnet50_imagenet", {})
-                  .get("extra", {}).get("batch_sweep", {}))
-            mfus = [v["mfu"] for v in rs.values()
-                    if isinstance(v, dict) and v.get("mfu") is not None]
-            if mfus:
-                measured = (
-                    f"is flat at {min(mfus):.2f}-{max(mfus):.2f} across "
-                    f"batch {min(map(int, rs))}-{max(map(int, rs))} — "
-                    "the same batch-independent shape, at "
-                    f"~{max(mfus) / ceil:.1f}x the ideal ceiling "
-                    "(residual adds, maxpool, dX of strided convs and "
-                    "imperfect fusion are uncounted traffic)")
+                f"| {name.get(c['config'], c['config'])} "
+                f"b={c['batch']} | "
+                f"{c['model_activation_bytes'] / 1e9:.1f} GB | "
+                f"{c['xla_bytes_accessed'] / 1e9:.1f} GB | "
+                f"{c['flops_bound_step_s']:.4f} | "
+                f"{c['bytes_bound_step_s']:.4f} | "
+                f"{c['measured_step_s']:.4f} | "
+                f"{c['achieved_hbm_gbps']:.0f} GB/s "
+                f"({c['achieved_hbm_frac']:.2f}) |")
+        r128 = next((c for c in cells
+                     if c["config"] == "resnet50_imagenet"
+                     and c["batch"] == 128), None)
+        vbig = next((c for c in cells
+                     if c["config"] == "vgg11_cifar10"
+                     and c["batch"] >= 16384), None)
+        serial_note = ""
+        if vbig and "measured_step_s" in vbig:
+            serial = (vbig["flops_bound_step_s"]
+                      + vbig["bytes_bound_step_s"])
+            serial_note = (
+                f"(b={vbig['batch']}: "
+                f"{vbig['flops_bound_step_s'] * 1e3:.1f} + "
+                f"{vbig['bytes_bound_step_s'] * 1e3:.1f} = "
+                f"{serial * 1e3:.1f} ms predicted serial vs "
+                f"{vbig['measured_step_s'] * 1e3:.1f} measured — "
+                f"{100 * serial / vbig['measured_step_s']:.0f}% "
+                "explained)")
+        bn = [c for c in d.get("bn_stats", []) if "error" not in c]
+        bn_txt = ""
+        if bn:
+            b0 = bn[0]
+            bn_txt = (
+                f"compiling the same forward with `batch_norm` swapped "
+                "for a stats-free affine changes forward bytes by "
+                f"**exactly {b0.get('fwd_stats_bytes_delta', 0):.1f}** "
+                "— XLA already fuses the mean/var reads into the conv "
+                "epilogue in the forward pass, so the Pallas "
+                "conv-epilogue-stats kernel the round-4 text was asked "
+                "to attempt has *no forward traffic to claim* "
+                "(consistent with round 3's measured bn_relu kernel "
+                "loss: a separate kernel only ADDS a pass). The "
+                "remaining statistics cost is in the BACKWARD — "
+                f"{b0.get('train_stats_bytes_delta_pct', 0)}% of "
+                "train-step bytes (the dscale/dbias reductions "
+                "re-reading saved activations) — attached to XLA's "
+                "conv-backward fusions, where a custom kernel would "
+                "have to beat the native conv to break even")
         lines += [
             "",
-            f"Reading: the roofline CEILING is {ceil} MFU and is "
-            "batch-independent — pure HBM time exceeds pure MXU time "
-            f"({mb_frac} conv layers are memory-bound; the whole first "
-            "half of the network streams large spatial maps through "
-            "batch-stats BN). The measured sweep "
-            "(bench_full.json `configs.resnet50_imagenet.batch_sweep`) "
-            + (measured or "tracks the same batch-independent shape")
-            + ". Raising batch cannot lift a bandwidth-bound "
-            "stack; the levers that would are layout-level (channels-"
-            "last + fused BN-stats epilogues) or algorithmic (ghost "
-            "BN / BN-free variants), which change the reference "
-            "semantics this config exists to preserve "
-            "(track_running_stats=False batch statistics, reference "
-            "part1/model.py:24).",
+            "Readings, term by term:",
             "",
+            "1. **The 6-pass activation model undercounts real "
+            "traffic 2-3x** (`model_over_xla_bytes` 0.34-0.50): the "
+            "compiled step also moves f32 BN intermediates, "
+            "conv-backward im2col/transpose materializations, pool "
+            "paths and param/grad/optimizer traffic. The analytic "
+            "scripts remain useful for the per-layer SHAPE (which "
+            "layers are memory-bound, MXU fill); the roofline "
+            "DENOMINATOR must be XLA's own bytes.",
         ]
+        if r128 and "achieved_hbm_frac" in r128:
+            lines.append(
+                "2. **ResNet-50's plateau is proven tight**: at batch "
+                f"128 the step sustains {r128['achieved_hbm_gbps']:.0f} "
+                f"GB/s = **{100 * r128['achieved_hbm_frac']:.1f}% of "
+                "the chip's 819 GB/s HBM peak** against XLA's real "
+                "byte count. There is no headroom; ~0.26 MFU is what a "
+                "batch-stats-BN ResNet-50 training step IS on this "
+                "chip. (Bigger batches drop to ~83% — larger working "
+                "sets schedule less efficiently; the bench default "
+                "stays 512 for throughput, and the sweep records "
+                "both.)")
+        lines.append(
+            "3. **VGG-11 is NOT bandwidth-saturated — it is "
+            "serialized**: measured step ~= flops-bound + bytes-bound "
+            + serial_note + ". The compute and memory phases barely "
+            "overlap; achieved bandwidth alone would wrongly suggest "
+            "headroom. The serial-sum ceiling explains the measured "
+            "plateau to ~2%; raising batch asymptotes toward exactly "
+            "this serial limit (the achieved-BW climb with batch is "
+            "the dispatch/latency share amortizing).")
+        if bn_txt:
+            lines.append(
+                "4. **The round-4 \"fused BN-stats epilogue\" "
+                "hypothesis is settled by measurement** (`bn_stats` "
+                "cells): " + bn_txt + ". Round 4's sentence lumping "
+                "\"fused BN-stats epilogues\" with semantics-changing "
+                "levers was wrong about the *category* (the fusion "
+                "preserves batch-stats semantics bit-for-bit) but "
+                "right about the outcome for the forward — and now "
+                "both halves are measured, not asserted.")
+        lines.append("")
 
     p = OUT_DIR / "bench_full.json"
     if p.exists():
         d = json.loads(p.read_text())
         e = d.get("extra", {})
-        rows = [("VGG-11 / CIFAR-10 (headline, batch 256)",
-                 f"{d.get('value', 0):,.0f} img/s", e.get("mfu"))]
-        ms = e.get("multi_step")
-        if ms:
-            rows.append(("VGG-11, 16 steps/dispatch (chip-side)",
-                         f"{ms['images_per_sec']:,.0f} img/s", None))
+        ms = e.get("multi_step") or {}
+        promoted = "images_per_sec" in ms
+        head_lbl = ("VGG-11 / CIFAR-10 (headline, batch 256, "
+                    "differenced multi-step)" if promoted else
+                    "VGG-11 / CIFAR-10 (headline, batch 256)")
+        rows = [(head_lbl, f"{d.get('value', 0):,.0f} img/s",
+                 e.get("mfu"))]
         sweep = e.get("batch_sweep", {})
         if sweep:
             # mfu is None on non-TPU hosts (no peak table) — filter, or
@@ -638,9 +747,20 @@ def render(out_path: Path | None = None) -> str:
                  if v.get("mfu") is not None),
                 key=lambda kv: kv[1]["mfu"], default=(None, None))
             if best:
-                rows.append((f"VGG-11, batch {best_bs} (MFU plateau)",
+                rows.append((f"VGG-11, batch {best_bs} (chained "
+                             "protocol, carries dispatch)",
                              f"{best['images_per_sec']:,.0f} img/s",
                              best["mfu"]))
+
+        def lm_plateau(cfg):
+            """Best batch_sweep cell of an LM config, or None."""
+            sw = cfg.get("extra", {}).get("batch_sweep", {})
+            good = [(k, v) for k, v in sw.items()
+                    if isinstance(v, dict) and v.get("mfu") is not None]
+            if not good:
+                return None
+            return max(good, key=lambda kv: kv[1]["mfu"])
+
         for key, label, unit in (
                 ("resnet50_imagenet", "ResNet-50 / ImageNet-1k",
                  "img/s"),
@@ -653,24 +773,55 @@ def render(out_path: Path | None = None) -> str:
             c = e.get("configs", {}).get(key)
             if c and "value" in c:
                 bs = c.get("extra", {}).get("batch_size")
+                ga = None
+                if key.startswith("transformer_lm"):
+                    plateau = lm_plateau(c)
+                    if plateau and plateau[1]["mfu"] > (
+                            c.get("extra", {}).get("mfu") or 0):
+                        lbl = (f"{label}, {plateau[0]} "
+                               "(batch x accum plateau)")
+                        rows.append(
+                            (lbl,
+                             f"{plateau[1]['tokens_per_sec']:,.0f} "
+                             f"{unit}", plateau[1]["mfu"]))
+                        continue
+                del ga
                 lbl = f"{label}, batch {bs}" if bs else label
                 rows.append((lbl, f"{c['value']:,.0f} {unit}",
                              c.get("extra", {}).get("mfu")))
         dec = (e.get("configs", {}).get("transformer_lm_large", {})
                .get("extra", {}).get("decode"))
+        dec_small = (e.get("configs", {}).get("transformer_lm", {})
+                     .get("extra", {}).get("decode"))
         if dec and "tokens_per_sec" in dec:
+            util = dec.get("hbm_util", {}).get("utilization")
             rows.append(
                 (f"TransformerLM-large KV-cache decode, batch "
                  f"{dec['batch']}",
                  f"{dec['tokens_per_sec']:,.0f} tok/s "
-                 f"({dec['ms_per_token_step']} ms/step)", None))
+                 f"({dec['ms_per_token_step']} ms/step)",
+                 None if util is None else
+                 f"**{100 * util:.1f}% of HBM peak**"))
         fd = e.get("flash_attention_delta", {})
+        protocol = (
+            "**Round-5 protocol** (see bench.py docstring): the "
+            "headline is the chip-side DIFFERENCED multi-step scan — "
+            "two window sizes (2 and 10 calls of a 16-step `lax.scan`) "
+            "whose wall-clock difference cancels the tunnel's fixed "
+            "readback, leaving pure chip time (recorded spread "
+            f"{ms.get('sample_spread_pct', '—')}%); the chained number "
+            "rides the tunnel dispatch stream and is kept as "
+            "`extra.chained_dispatch`. Every number is the median of "
+            ">= 3 gated windows (`_gated_samples` extends up to 3x "
+            "until the recent slice settles <= 5%)."
+            if promoted else
+            "protocol: chained dispatch, single final readback — see "
+            "bench.py docstring.")
         lines += [
             _section(lines, "Single-chip benchmark summary (TPU v5e)"),
             "",
             "`python bench.py` (full details in "
-            "`experiments/bench_full.json`; protocol: chained dispatch, "
-            "single final readback — see bench.py docstring). MFU = "
+            "`experiments/bench_full.json`). " + protocol + " MFU = "
             "achieved / 197 bf16 TFLOP/s peak, counting 3x-forward "
             "train FLOPs (no remat credit).",
             "",
@@ -678,7 +829,8 @@ def render(out_path: Path | None = None) -> str:
             "|---|---|---|",
         ]
         for label, thr, mfu in rows:
-            lines.append(f"| {label} | {thr} | {_fmt(mfu, 3)} |")
+            mfu_txt = mfu if isinstance(mfu, str) else _fmt(mfu, 3)
+            lines.append(f"| {label} | {thr} | {mfu_txt} |")
         if fd.get("speedup"):
             lines += ["",
                       f"Pallas flash attention vs jnp attention on the "
@@ -686,6 +838,62 @@ def render(out_path: Path | None = None) -> str:
                       ""]
         else:
             lines.append("")
+        small = e.get("configs", {}).get("transformer_lm", {})
+        small_plateau = lm_plateau(small) if small else None
+        if small_plateau:
+            k, v = small_plateau
+            lines += [
+                "**LM-small explained (round-4 verdict item 6).** The "
+                "sweep (`batch_sweep` on the transformer_lm cell) "
+                "shows the round-4 0.36-MFU single-batch cell was an "
+                "artifact of the tiny per-step workload: plain batch "
+                "> 32 fails to compile (no remat; the activation "
+                "working set outgrows the compile helper), but batch "
+                "x grad_accum — microbatch-8 chunks under one "
+                "`lax.scan` — climbs monotonically and plateaus at "
+                f"**{v['mfu']}** ({k}). The remaining gap to "
+                "LM-large is structural, not tunable: (i) head_dim 64 "
+                "contracts the attention matmuls over 64 of the MXU's "
+                "128 rows — half fill on the ~40% of FLOPs that live "
+                "in attention at seq 2048 (4*L*dm per token vs 24*dm^2 "
+                "in the projections/MLP); (ii) d_model 512 gives 4x "
+                "less matmul work per elementwise byte than LM-large's "
+                "2048, so LN/softmax/RoPE overhead weighs 4x more. "
+                "Both terms favor the wide model by construction — "
+                "the plateau is now measured rather than unexplained.",
+                "",
+            ]
+        if dec and dec.get("hbm_util"):
+            hu = dec["hbm_util"]
+            small_u = ((dec_small or {}).get("hbm_util") or {}
+                       ).get("utilization")
+            lines += [
+                "**Decode efficiency (round-4 verdict item 4).** "
+                "Decode is HBM-bound, so the recorded yardstick is "
+                "achieved bytes/s vs the chip's "
+                f"{hu.get('peak_gbps')} GB/s (`decode.hbm_util` in "
+                "`bench_full.json`): per token-step the chip reads "
+                "the non-embedding parameters (bf16 — XLA hoists the "
+                "loop-invariant f32->bf16 casts out of the decode "
+                "scan; counting f32 storage measured an impossible "
+                ">1x peak, which is how the byte model was validated), "
+                "gathers batch-many embedding rows, and reads both "
+                "full preallocated K/V caches (the masked attention "
+                "contracts over `prompt+new` slots every step, static "
+                "shapes). TransformerLM-large: "
+                f"{hu['bytes_per_token_step'] / 1e9:.2f} GB/token-step "
+                f"at {dec['ms_per_token_step']} ms = "
+                f"**{hu['achieved_gbps']} GB/s achieved = "
+                f"{100 * hu['utilization']:.1f}% of peak** — the "
+                "decode path is near the bandwidth wall, so a "
+                "regression now shows as a utilization drop, not an "
+                "invisible 2x."
+                + (f" LM-small: {100 * small_u:.0f}% (too little work "
+                   "per step to saturate the HBM system — the same "
+                   "small-workload effect the training sweep shows)."
+                   if small_u else ""),
+                "",
+            ]
 
     p = OUT_DIR / "divergence_part2.json"
     if p.exists():
