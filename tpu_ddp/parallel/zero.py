@@ -256,6 +256,33 @@ class _FlatLayout:
         """Canonical optimizer state -> flat padded (restore path)."""
         return self.inner.map_param_like(state, self.shard_params)
 
+    def shard_zeros(self, params):
+        """f32 zero tree shaped like :meth:`scatter_grads` output — the
+        ZeRO-2 accumulation buffer (1/N of each local leaf per worker).
+        ``params`` are the LOCAL leaves seen inside shard_map."""
+        return jax.tree.map(
+            lambda p: jnp.zeros((self._chunk(p.size),), jnp.float32),
+            params)
+
+    def scatter_grads(self, grads):
+        """INSIDE shard_map: reduce-scatter each leaf over the flat
+        layout's axis — this worker's 1/N slice of the axis-MEAN
+        gradient, in f32. The ZeRO-2 building block (a trainer
+        accumulating microbatch gradients sums THESE slices), and the
+        1F1B x FSDP bridge (full stage-local grads -> the flat shards
+        ZeRO3.apply consumes). Works on LOCAL leaves: each leaf's
+        chunking derives from its local size, matching the flat state
+        layout cell by cell."""
+        ax, n = self.axis_name, self.axis_size
+
+        def slc(g):
+            chunk = self._chunk(g.size)
+            flat = jnp.pad(g.astype(jnp.float32).reshape(-1),
+                           (0, chunk * n - g.size))
+            return lax.psum_scatter(flat.reshape(n, chunk), ax,
+                                    scatter_dimension=0) / n
+        return jax.tree.map(slc, grads)
+
 
 class ZeRO1(_FlatLayout):
     """Wrap an elementwise optimizer; shard its state over ``axis_name``.
@@ -325,29 +352,6 @@ class ZeRO1(_FlatLayout):
             [P((*pt.axes, self.axis_name)) if pt is not None
              else P(self.axis_name) for pt in pt_l])
         return self.inner.state_specs(specs)
-
-    def shard_zeros(self, params):
-        """f32 zero tree shaped like :meth:`scatter_grads` output — the
-        ZeRO-2 accumulation buffer (1/N of each local leaf per worker)."""
-        return jax.tree.map(
-            lambda p: jnp.zeros((self._chunk(p.size),), jnp.float32),
-            params)
-
-    def scatter_grads(self, grads):
-        """INSIDE shard_map: reduce-scatter each leaf over dp — this
-        worker's 1/N slice of the dp-MEAN gradient, in f32. The ZeRO-2
-        building block: a trainer accumulating microbatch gradients sums
-        THESE slices (1/N the buffer memory of full-leaf accumulation)
-        and feeds the result to :meth:`apply_scattered`."""
-        ax, n = self.axis_name, self.axis_size
-
-        def slc(g):
-            chunk = self._chunk(g.size)
-            flat = jnp.pad(g.astype(jnp.float32).reshape(-1),
-                           (0, chunk * n - g.size))
-            return lax.psum_scatter(flat.reshape(n, chunk), ax,
-                                    scatter_dimension=0) / n
-        return jax.tree.map(slc, grads)
 
     def apply(self, params, grads, opt_state, decay_mask=None,
               clip_norm=None):
@@ -1138,8 +1142,13 @@ class ZeRO3(_FlatLayout):
         are all rank-1; _LeafMeta exposes .ndim for the policy)."""
         return self.inner.decay_mask(self.meta)
 
-    def apply(self, flat_params, flat_grads, opt_state):
+    def apply(self, flat_params, flat_grads, opt_state, decay_mask=None):
         """Shard-local update; grads must already be the psum_scatter'd
-        shards divided by the axis size (the trainer's job)."""
-        return self.inner.apply(flat_params, flat_grads, opt_state,
-                                decay_mask=self.decay_mask())
+        shards divided by the axis size (the trainer's job).
+        ``decay_mask`` overrides the meta-rank policy — the pipeline
+        trainer passes the ORIGINAL per-layer ranks so stacked (L, dm)
+        LayerNorm leaves are not decayed (same hook as ZeRO1.apply)."""
+        return self.inner.apply(
+            flat_params, flat_grads, opt_state,
+            decay_mask=(decay_mask if decay_mask is not None
+                        else self.decay_mask()))

@@ -658,7 +658,11 @@ class PipelineLMTrainer(_MeshTrainer):
     P((pp, dp)) — P((pp, mp, dp)) with stage-internal tp;
     ``"zero2"``, 1F1B only: additionally reduce-scatters each tick's
     block-gradient contribution over dp so the accumulation carry holds
-    1/dp f32 slices). Gradient accumulation needs no separate
+    1/dp f32 slices) and FSDP within each stage
+    (``param_sharding="fsdp"``, round 5: stacked leaves live as
+    P((pp[, mp], dp)) flat dp shards, gathered per step — parameter AND
+    optimizer memory 1/dp at rest). Gradient accumulation needs no
+    separate
     mechanism here: ``num_micro`` IS accumulation — every microbatch's
     gradient sums into one optimizer step, and raising it shrinks both
     per-microbatch activation memory and (under 1F1B, where residency
@@ -670,6 +674,7 @@ class PipelineLMTrainer(_MeshTrainer):
                  optimizer: AdamW | None = None, dropout_seed: int = 0,
                  schedule: str = "gpipe",
                  opt_sharding: str = "replicated",
+                 param_sharding: str = "replicated",
                  clip_grad_norm: float | None = None,
                  sp_mode: str = "ring"):
         from tpu_ddp.parallel.pipeline import pipeline_param_specs
@@ -731,6 +736,27 @@ class PipelineLMTrainer(_MeshTrainer):
         if opt_sharding not in ("replicated", "zero1", "zero2"):
             raise ValueError(f"unknown opt_sharding {opt_sharding!r}; "
                              "choose 'replicated', 'zero1' or 'zero2'")
+        # FSDP within each stage (round-5, the last structural gap of
+        # the composition matrix): the STACKED block leaves' flat
+        # layout is partition-aware over pp (P((pp, [mp/ep,] dp))), so
+        # ZeRO3.gather_params reassembles exactly this stage's stacked
+        # slice from its dp shards — the leaf the pipeline body
+        # expects. Under GPipe the gather sits inside the
+        # differentiated function and autodiff's transpose delivers
+        # dp-scattered gradient shards (the LMTrainer FSDP trick);
+        # under 1F1B (hand-scheduled vjp) the gather runs once at step
+        # start and the full stage-local gradients reduce-scatter at
+        # the end — parameter and optimizer memory are 1/dp at rest
+        # either way.
+        if param_sharding not in ("replicated", "fsdp"):
+            raise ValueError(f"unknown param_sharding {param_sharding!r}"
+                             "; choose 'replicated' or 'fsdp'")
+        self.is_fsdp = param_sharding == "fsdp"
+        if self.is_fsdp and opt_sharding != "replicated":
+            raise ValueError(
+                f"opt_sharding={opt_sharding!r} is redundant under "
+                "param_sharding='fsdp' (ZeRO-3 already shards the "
+                "optimizer state over dp)")
         self.opt_zero1 = opt_sharding in ("zero1", "zero2")
         self.opt_zero2 = opt_sharding == "zero2"
         if self.opt_zero2 and schedule != "1f1b":
@@ -775,7 +801,7 @@ class PipelineLMTrainer(_MeshTrainer):
                     template=self._params_template,
                     param_specs=self._param_specs,
                     mesh_axis_sizes=dict(mesh.shape))
-        elif isinstance(self.optimizer, Adafactor):
+        elif isinstance(self.optimizer, Adafactor) and not self.is_fsdp:
             # Round-5: replicated-opt Adafactor under the pipeline — the
             # per-cell layout over the STACKED specs (each stage/mp/ep
             # cell factors its own stacked slice). Wrapped even at
@@ -791,7 +817,36 @@ class PipelineLMTrainer(_MeshTrainer):
                         self.model.init(jax.random.key(0)))),
                 param_specs=self._param_specs,
                 mesh_axis_sizes=dict(mesh.shape))
-        self._opt_specs = self.optimizer.state_specs(self._param_specs)
+        if self.is_fsdp:
+            from tpu_ddp.parallel.zero import ZeRO3
+            if isinstance(self.optimizer, Adafactor):
+                raise ValueError(
+                    "param_sharding='fsdp' re-lays leaves out flat, "
+                    "which cannot host Adafactor's factored state; use "
+                    "AdamW/SGD under fsdp, or opt_sharding='zero1' "
+                    "with Adafactor (per-cell FactoredZeRO1)")
+            self._params_template = jax.eval_shape(
+                lambda: stack_block_params(
+                    self.model.init(jax.random.key(0))))
+            self._orig_specs = self._param_specs
+            self.zero3 = ZeRO3(self.optimizer, DATA_AXIS, self.dp,
+                               template=self._params_template,
+                               param_specs=self._orig_specs,
+                               mesh_axis_sizes=dict(mesh.shape))
+            self._param_specs = self.zero3.flat_param_specs()
+            self._opt_specs = self.zero3.state_specs()
+            # Decay policy on the ORIGINAL per-layer ranks (flat shards
+            # are rank-1 and stacked leaves rank+1): proto of one
+            # layer's leaves, the _decay_mask trick, precomputed from
+            # the template since flat params carry no layer shapes.
+            proto = dict(self._params_template)
+            proto["blocks"] = jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(m.shape[1:], m.dtype),
+                self._params_template["blocks"])
+            self._fsdp_decay_mask = self.optimizer.decay_mask(proto)
+        else:
+            self._opt_specs = self.optimizer.state_specs(
+                self._param_specs)
         batch_spec = P((DATA_AXIS, EXPERT_AXIS), SEQ_AXIS)
         self._batch_sharding = NamedSharding(mesh, batch_spec)
         self._param_shardings = self._shardings(self._param_specs)
@@ -800,9 +855,13 @@ class PipelineLMTrainer(_MeshTrainer):
 
     def init_state(self, seed: int = 0) -> LMTrainState:
         """Same seed -> same parameters as the dense model, re-laid-out:
-        blocks stacked on a leading layer axis, sharded over pp."""
+        blocks stacked on a leading layer axis, sharded over pp (and
+        under fsdp additionally flattened into dp shards per cell)."""
         from tpu_ddp.parallel.pipeline import stack_block_params
         params = stack_block_params(self.model.init(jax.random.key(seed)))
+        if self.is_fsdp:
+            params = self.zero3.shard_params(params)
+            return self._place_state(params, self.zero3.init(params))
         return self._place_state(params, self.optimizer.init(params))
 
     def _decay_mask(self, params):
@@ -814,7 +873,7 @@ class PipelineLMTrainer(_MeshTrainer):
         proto["blocks"] = jax.tree.map(lambda p: p[0], params["blocks"])
         return self.optimizer.decay_mask(proto)
 
-    def _sync_grads(self, grads, skip_dp: bool = False):
+    def _sync_grads(self, grads, skip_dp: bool = False, specs=None):
         """Stacked block leaves are stage-local (mean over dp/sp/ep
         only); replicated leaves (embed/head/ln_f) got their real
         gradient on one stage and zeros elsewhere — sum over pp
@@ -831,7 +890,14 @@ class PipelineLMTrainer(_MeshTrainer):
         — pp reassembly and the sp/ep means still happen here (under
         ZeRO-2 the block leaves arrive as dp-scattered f32 slices; every
         op here is elementwise or a non-dp collective, and linear ops
-        commute with slicing)."""
+        commute with slicing).
+        ``specs``: the spec tree matching the GRADS' layout — defaults
+        to the trainer's param specs; the fsdp paths pass the ORIGINAL
+        (pre-flattening) stacked specs since their algebra runs on
+        stage-local leaves or their aligned flat shards."""
+        if specs is None:
+            specs = self._param_specs
+
         def leaf(g, spec):
             sharded = _spec_axes(spec)
             if PIPE_AXIS not in sharded:
@@ -843,7 +909,7 @@ class PipelineLMTrainer(_MeshTrainer):
             if EXPERT_AXIS in sharded and self.ep > 1:
                 g = g / float(self.ep)
             return g if skip_dp else lax.pmean(g, DATA_AXIS)
-        return jax.tree.map(leaf, grads, self._param_specs)
+        return jax.tree.map(leaf, grads, specs)
 
     def _extra_in_specs(self) -> tuple:
         return (P(),)  # dropout key: replicated on every shard
@@ -865,19 +931,30 @@ class PipelineLMTrainer(_MeshTrainer):
             rng = jax.random.fold_in(rng, lax.axis_index(EXPERT_AXIS))
         return rng
 
+    def _loss_norm(self, masked_sum, local_n, data_axes):
+        """(grad scale, local chunk mean) for one shard's masked loss
+        sum — THE loss-normalization algebra, shared by every schedule
+        and param layout so the paths cannot drift. Scale by the
+        (dp, sp, ep) shard count so the pmean in _sync_grads telescopes
+        to the grad of the GLOBAL token mean (the LMTrainer algebra);
+        masked_sum is nonzero on the last stage only and the pp-psum in
+        _sync_grads completes the sum."""
+        total = lax.psum(local_n, data_axes)
+        n_shards = lax.psum(1.0, data_axes)
+        return n_shards / total, masked_sum / local_n
+
     def _base_step(self, params, opt_state, inputs, targets, rng):
         from tpu_ddp.parallel.pipeline import (pipeline_1f1b_grads,
                                                pipeline_loss)
 
         rng = self._decorrelate_rng(rng)
 
-        # The loss is normalized over the (dp, sp, ep) token shards:
-        # scale by the shard count so the pmean in _sync_grads
-        # telescopes to the grad of the GLOBAL token mean (the LMTrainer
-        # algebra).
         data_axes = ((DATA_AXIS,)
                      + ((SEQ_AXIS,) if self.sp > 1 else ())
                      + ((EXPERT_AXIS,) if self.ep > 1 else ()))
+        if self.is_fsdp:
+            return self._fsdp_step(params, opt_state, inputs, targets,
+                                   rng, data_axes)
         if self.schedule == "1f1b":
             scatter = (self.optimizer.scatter_grads if self.opt_zero2
                        else None)
@@ -888,23 +965,18 @@ class PipelineLMTrainer(_MeshTrainer):
                 blocks_grad_init=(
                     self.optimizer.shard_zeros(params["blocks"])
                     if self.opt_zero2 else None))
-            total = lax.psum(local_n, data_axes)
-            n_shards = lax.psum(1.0, data_axes)
+            scale, local_mean = self._loss_norm(masked_sum, local_n,
+                                                data_axes)
             # Same normalization the gpipe loss_fn differentiates.
-            grads = jax.tree.map(lambda g: g * (n_shards / total), grads)
-            local_mean = masked_sum / local_n
+            grads = jax.tree.map(lambda g: g * scale, grads)
         else:
             def loss_fn(p):
                 masked_sum, local_n = pipeline_loss(
                     self.model, p, inputs, targets, pp_size=self.pp,
                     num_micro=self.num_micro, rng=rng)
-                total = lax.psum(local_n, data_axes)
-                n_shards = lax.psum(1.0, data_axes)
-                # Scale so pmean-over-(dp,sp) of grads == grad of the
-                # global token mean; masked_sum is nonzero on the last
-                # stage only and the pp-psum in _sync_grads completes
-                # the sum.
-                return n_shards * masked_sum / total, masked_sum / local_n
+                scale, local_mean = self._loss_norm(masked_sum, local_n,
+                                                    data_axes)
+                return masked_sum * scale, local_mean
 
             (_, local_mean), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
@@ -940,6 +1012,60 @@ class PipelineLMTrainer(_MeshTrainer):
         # Real chunk mean lives on the last stage; share it with everyone
         # (outside the differentiated path). (1, 1) per shard so the
         # out spec P(dp, sp) stacks to a (dp, sp) global.
+        mean = lax.psum(local_mean, PIPE_AXIS)
+        return params, opt_state, mean.reshape(1, 1)
+
+    def _fsdp_step(self, params, opt_state, inputs, targets, rng,
+                   data_axes):
+        """FSDP within each stage: ``params`` are flat dp shards of the
+        STACKED tree (blocks per (pp[, mp/ep]) cell). GPipe
+        differentiates through ``gather_params`` so the AD transpose
+        reduce-scatters cotangents into dp shards; 1F1B gathers once at
+        step start (hand-scheduled vjp) and reduce-scatters the full
+        stage-local gradients afterwards. Either way the non-dp sync
+        (pp reassembly of embed/head, sp/ep means) runs with the
+        ORIGINAL stacked specs' algebra, aligned shard-by-shard."""
+        from tpu_ddp.parallel.pipeline import (pipeline_1f1b_grads,
+                                               pipeline_loss)
+
+        if self.schedule == "1f1b":
+            p_full = self.zero3.gather_params(params)
+            masked_sum, local_n, g_full = pipeline_1f1b_grads(
+                self.model, p_full, inputs, targets, pp_size=self.pp,
+                num_micro=self.num_micro, rng=rng)
+            scale, local_mean = self._loss_norm(masked_sum, local_n,
+                                                data_axes)
+            g_full = jax.tree.map(lambda g: g * scale, g_full)
+            # pp/sp/ep halves of the sync on the full stage-local
+            # leaves, then reduce-scatter over dp into the flat shards
+            # ZeRO3.apply consumes (scatter_grads yields the dp MEAN).
+            g_full = self._sync_grads(g_full, skip_dp=True,
+                                      specs=self._orig_specs)
+            grads = self.zero3.scatter_grads(g_full)
+        else:
+            def loss_fn(p_flat):
+                masked_sum, local_n = pipeline_loss(
+                    self.model, self.zero3.gather_params(p_flat),
+                    inputs, targets, pp_size=self.pp,
+                    num_micro=self.num_micro, rng=rng)
+                scale, local_mean = self._loss_norm(masked_sum, local_n,
+                                                    data_axes)
+                return masked_sum * scale, local_mean
+
+            (_, local_mean), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            # The gather's transpose psum_scatter SUMMED over dp;
+            # recover the mean, then run the pp/sp/ep algebra on the
+            # flat shards (aligned across pp/sp/ep: same chunking).
+            grads = jax.tree.map(lambda g: g / float(self.dp), grads)
+            grads = self._sync_grads(grads, skip_dp=True,
+                                     specs=self._orig_specs)
+        if self.clip_grad_norm is not None:
+            # Flat shards: the flat specs carry the (pp[, mp/ep], dp)
+            # axes each slice is distinct over.
+            grads = self._clip_by_global_norm(grads, self._param_specs)
+        params, opt_state = self.zero3.apply(
+            params, grads, opt_state, decay_mask=self._fsdp_decay_mask)
         mean = lax.psum(local_mean, PIPE_AXIS)
         return params, opt_state, mean.reshape(1, 1)
 
