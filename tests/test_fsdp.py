@@ -401,6 +401,8 @@ class TestPipelineFSDP:
                                        rtol=2e-5, atol=1e-6,
                                        err_msg=schedule)
 
+    @pytest.mark.slow  # four 8-device compiles; the bare fsdp-pp
+    # exactness runs fast above, this pins the x tp x clip frontier
     @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
     def test_matches_replicated_with_tp_and_clip(self, devices,
                                                  schedule):

@@ -216,6 +216,8 @@ class TestMoEComposition:
                                        rtol=3e-4, atol=3e-5,
                                        err_msg=schedule)
 
+    @pytest.mark.slow  # two two-step 8-device pp x ep runs; the
+    # one-step pp x ep exactness above stays in the default tier
     def test_pp_ep_zero1_matches_replicated_opt(self, devices):
         """pp x ep x ZeRO-1: stacked expert leaves' optimizer state lays
         out P((pp, ep, dp)) and the two-step update (momentum through
@@ -239,6 +241,8 @@ class TestMoEComposition:
         assert w1.sharding.spec == P((PIPE_AXIS, EXPERT_AXIS, DATA_AXIS))
         assert w1.addressable_shards[0].data.size == w1.size // 8
 
+    @pytest.mark.slow  # two 8-device MoE compiles; the pairwise cells
+    # cover the semantics in the default tier
     def test_four_axis_matches_folded(self, devices):
         """The full dense-trainer matrix in ONE cell: sp x tp x ep
         (round-5 coverage pin — each pairwise composition was exact-
@@ -264,6 +268,8 @@ class TestMoEComposition:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        rtol=3e-4, atol=3e-5)
 
+    @pytest.mark.slow  # two 8-device pp x sp x ep compiles; pp x ep
+    # and pp x sp are pinned fast
     def test_pp_sp_ep_matches_folded(self, devices):
         """pp x sp x ep (round-5): ring attention AND the expert
         all_to_all both ride inside the pipeline stages, orthogonal to
