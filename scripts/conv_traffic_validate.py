@@ -142,7 +142,8 @@ def measure(config: str, batch: int) -> dict:
         step_s = _time_step(trainer, state, staged)
         out["measured_step_s"] = round(step_s, 6)
         peak, _ = F.peak_tflops(jax.devices()[0])
-        bw = F.device_hbm_gbps(jax.devices()[0]) * 1e9
+        bw_gbps, _ = F.device_hbm_gbps(jax.devices()[0])
+        bw = bw_gbps * 1e9
         out["hbm_peak_gbps"] = bw / 1e9
         if "xla_bytes_accessed" in out:
             xb = out["xla_bytes_accessed"]
