@@ -120,7 +120,7 @@ def roofline(batch: int) -> dict:
         # Serial (no overlap) ceiling from the ANALYTIC bytes — shape
         # only. The validated numbers use XLA's real bytes (~2.5-3x
         # these): ResNet measures at the OVERLAPPED (max) roofline
-        # (97.7% of HBM peak at b=128), VGG at the serial sum — see
+        # (~96% of HBM peak at b=128), VGG at the serial sum — see
         # conv_traffic_validation.json / EXPERIMENTS.md §7.
         "predicted_mfu_serial": round(
             flops_total / (peak * (t_compute + t_memory)), 4),
