@@ -1,0 +1,228 @@
+"""Chaos sweep — every fault kind through its recovery path, once.
+
+Runs one drill per fault kind in ``tpu_ddp.resilience.chaos.FAULT_KINDS``
+as a REAL 2-process cluster (tpu_ddp.launch: per-rank processes,
+jax.distributed rendezvous, cross-process collectives) on the virtual
+CPU platform at smoke scale, and asserts the matching recovery mechanism
+engaged:
+
+=============  ======================================================
+drill          pass criterion
+=============  ======================================================
+hard-exit      launch_elastic restarts once, run resumes from the
+               mid-epoch checkpoint and completes
+nan-grad       the step guard skips the poisoned step on BOTH ranks
+               (per-rank metrics JSONL), the per-step replica check
+               stays clean, training completes
+stalled-step   the heartbeat watchdog kills the hung cluster in
+               ~heartbeat_timeout (not the full run timeout) and the
+               elastic restart completes the run
+corrupt-ckpt   (+ hard-exit) the restarted run quarantines the
+               truncated newest checkpoint to ``*.corrupt`` and
+               resumes from the previous verified step
+slow-rank      the run completes despite a persistent straggler rank
+=============  ======================================================
+
+Writes ``experiments/chaos_sweep.json`` — one cell per drill with
+pass/fail, wall time, and the observed evidence — so resilience
+coverage is a committed artifact, not a claim.
+
+Usage::
+
+    python scripts/chaos_sweep.py            # all drills
+    python scripts/chaos_sweep.py --only nan-grad,hard-exit
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tpu_ddp.launch import launch, launch_elastic  # noqa: E402
+from tpu_ddp.resilience.chaos import FAULT_KINDS  # noqa: E402
+
+SMOKE_ENV = {
+    "TPU_DDP_SYNTH_SIZE": "64",
+    "TPU_DDP_MAX_ITERS": "3",
+    "TPU_DDP_GLOBAL_BATCH": "16",
+    "CIFAR10_DIR": "/nonexistent-so-synthetic",
+}
+PART = "part3"
+TIMEOUT = 600.0
+
+
+def _check(cell: dict, name: str, ok: bool, detail=None) -> bool:
+    cell["checks"][name] = {"ok": bool(ok)}
+    if detail is not None:
+        cell["checks"][name]["detail"] = detail
+    return bool(ok)
+
+
+def _skipped_steps(metrics_path: Path) -> list[int]:
+    if not metrics_path.exists():
+        return []
+    events = [json.loads(ln) for ln in
+              metrics_path.read_text().splitlines() if ln.strip()]
+    return [e["step"] for e in events if e.get("event") == "step_skipped"]
+
+
+def drill_hard_exit(work: Path, cell: dict) -> bool:
+    """Crash one rank after its step-2 checkpoint; recovery = elastic
+    restart + checkpoint resume (the original TPU_DDP_FAIL_AT_STEP
+    story, now through the FaultInjector)."""
+    env = dict(SMOKE_ENV,
+               TPU_DDP_CHAOS_FAULTS="hard-exit@2",
+               TPU_DDP_CHAOS_SENTINEL=str(work / "sentinels"),
+               TPU_DDP_CKPT_EVERY="1")
+    res = launch_elastic(PART, nproc=2, max_restarts=1,
+                         min_restart_interval=0.0, echo=False,
+                         timeout=TIMEOUT, env=env,
+                         extra_args=["--ckpt-dir", str(work / "ckpt")])
+    ok = _check(cell, "run_ok", res.ok, res.returncode)
+    ok &= _check(cell, "restarted_once", res.restarts == 1, res.restarts)
+    ok &= _check(cell, "resumed_from_checkpoint",
+                 "resumed from" in res.output_of(0))
+    return ok
+
+
+def drill_nan_grad(work: Path, cell: dict) -> bool:
+    """Poison rank 1's step-2 batch; recovery = step guard. The skip
+    decision is psum-agreed, so BOTH ranks must log step_skipped and the
+    every-step replica check must stay clean."""
+    env = dict(SMOKE_ENV,
+               TPU_DDP_CHAOS_FAULTS="nan-grad@2:rank=1",
+               TPU_DDP_CHAOS_SENTINEL=str(work / "sentinels"),
+               TPU_DDP_CHECK_REPLICAS_EVERY="1",
+               TPU_DDP_METRICS_FILE=str(work / "metrics_{rank}.jsonl"))
+    res = launch(PART, nproc=2, env=env, echo=False, timeout=TIMEOUT)
+    ok = _check(cell, "run_ok", res.ok, res.returncode)
+    skips = {r: _skipped_steps(work / f"metrics_{r}.jsonl")
+             for r in (0, 1)}
+    ok &= _check(cell, "skipped_step2_on_all_ranks",
+                 skips == {0: [2], 1: [2]}, skips)
+    ok &= _check(cell, "replicas_consistent",
+                 "replica" not in res.output_of(0).lower()
+                 or "divergence" not in res.output_of(0).lower())
+    return ok
+
+
+def drill_stalled_step(work: Path, cell: dict) -> bool:
+    """Wedge rank 0 mid-step for an hour; recovery = heartbeat watchdog
+    kill + elastic restart. Pass requires the kill to land on the
+    heartbeat deadline, not the 600 s run timeout."""
+    env = dict(SMOKE_ENV,
+               TPU_DDP_CHAOS_FAULTS="stalled-step@2",
+               TPU_DDP_CHAOS_SENTINEL=str(work / "sentinels"),
+               TPU_DDP_CKPT_EVERY="1")
+    t0 = time.monotonic()
+    res = launch_elastic(PART, nproc=2, max_restarts=1,
+                         min_restart_interval=0.0, echo=False,
+                         timeout=TIMEOUT, heartbeat_timeout=20.0, env=env,
+                         extra_args=["--ckpt-dir", str(work / "ckpt")])
+    elapsed = time.monotonic() - t0
+    ok = _check(cell, "run_ok", res.ok, res.returncode)
+    ok &= _check(cell, "restarted_once", res.restarts == 1, res.restarts)
+    ok &= _check(cell, "killed_by_watchdog_not_timeout",
+                 elapsed < TIMEOUT * 0.8, round(elapsed, 1))
+    return ok
+
+
+def drill_corrupt_ckpt(work: Path, cell: dict) -> bool:
+    """Truncate the newest checkpoint then crash; recovery = digest
+    verification + quarantine + fallback to the previous verified step."""
+    ckpt = work / "ckpt"
+    env = dict(SMOKE_ENV,
+               TPU_DDP_CHAOS_FAULTS="corrupt-ckpt@2,hard-exit@2",
+               TPU_DDP_CHAOS_SENTINEL=str(work / "sentinels"),
+               TPU_DDP_CKPT_EVERY="1")
+    res = launch_elastic(PART, nproc=2, max_restarts=1,
+                         min_restart_interval=0.0, echo=False,
+                         timeout=TIMEOUT, env=env,
+                         extra_args=["--ckpt-dir", str(ckpt)])
+    out0 = res.output_of(0)
+    ok = _check(cell, "run_ok", res.ok, res.returncode)
+    ok &= _check(cell, "resumed_from_verified_step1",
+                 "resumed from" in out0 and "at step 1" in out0)
+    quarantined = sorted(p.name for p in ckpt.glob("*.corrupt*")) \
+        if ckpt.exists() else []
+    ok &= _check(cell, "corrupt_checkpoint_quarantined",
+                 any(q.startswith("step_00000002") for q in quarantined),
+                 quarantined)
+    return ok
+
+
+def drill_slow_rank(work: Path, cell: dict) -> bool:
+    """Make rank 1 a persistent straggler; recovery = none needed — the
+    collectives wait, the run completes, nothing restarts or diverges."""
+    env = dict(SMOKE_ENV,
+               TPU_DDP_CHAOS_FAULTS="slow-rank@1:rank=1",
+               TPU_DDP_CHAOS_SLOW_S="0.5",
+               TPU_DDP_CHECK_REPLICAS_EVERY="1")
+    res = launch(PART, nproc=2, env=env, echo=False, timeout=TIMEOUT)
+    ok = _check(cell, "run_ok", res.ok, res.returncode)
+    ok &= _check(cell, "completed_eval",
+                 "Test set: average loss" in res.output_of(0))
+    return ok
+
+
+DRILLS = {
+    "hard-exit": drill_hard_exit,
+    "nan-grad": drill_nan_grad,
+    "stalled-step": drill_stalled_step,
+    "corrupt-ckpt": drill_corrupt_ckpt,
+    "slow-rank": drill_slow_rank,
+}
+assert set(DRILLS) == set(FAULT_KINDS), \
+    "a fault kind exists without a sweep drill"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of fault kinds")
+    ap.add_argument("--out", default=str(REPO / "experiments"
+                                         / "chaos_sweep.json"))
+    args = ap.parse_args(argv)
+    kinds = (args.only.split(",") if args.only else list(DRILLS))
+    for k in kinds:
+        if k not in DRILLS:
+            ap.error(f"unknown fault kind {k!r}; have {sorted(DRILLS)}")
+
+    results = {"part": PART, "nproc": 2, "env": SMOKE_ENV, "cells": {}}
+    for kind in kinds:
+        work = Path(tempfile.mkdtemp(prefix=f"chaos_{kind.replace('-', '_')}_"))
+        cell = {"checks": {}}
+        print(f"[chaos-sweep] {kind}...", flush=True)
+        t0 = time.monotonic()
+        try:
+            cell["passed"] = DRILLS[kind](work, cell)
+        except Exception as e:  # noqa: BLE001 — record, keep sweeping
+            cell["passed"] = False
+            cell["error"] = f"{type(e).__name__}: {e}"
+        cell["wall_s"] = round(time.monotonic() - t0, 1)
+        results["cells"][kind] = cell
+        print(f"[chaos-sweep] {kind}: "
+              f"{'PASS' if cell['passed'] else 'FAIL'} "
+              f"({cell['wall_s']}s) {cell['checks']}", flush=True)
+        shutil.rmtree(work, ignore_errors=True)
+
+    results["all_passed"] = all(c["passed"]
+                                for c in results["cells"].values())
+    out = Path(args.out)
+    out.parent.mkdir(exist_ok=True)
+    out.write_text(json.dumps(results, indent=1))
+    print(f"[chaos-sweep] wrote {out} "
+          f"(all_passed={results['all_passed']})")
+    return 0 if results["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

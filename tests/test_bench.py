@@ -12,6 +12,8 @@ import bench
 
 
 class TestBenchEntry:
+    @pytest.mark.slow  # full bench entrypoint run; the config plumbing is
+    # covered fast by test_lm_config
     def test_headline_vgg_contract(self):
         # with_xla_flops=False skips the AOT cost-analysis recompile
         # (seconds on this host); the xla-flops path has its own test
@@ -50,6 +52,7 @@ class TestBenchEntry:
         with pytest.raises(ValueError, match="unknown preset"):
             bench.run_bench(config="resnet9000")
 
+    @pytest.mark.slow  # another full bench run just to read two fields
     def test_mfu_fields_present(self, monkeypatch):
         monkeypatch.delenv("TPU_DDP_PEAK_TFLOPS", raising=False)
         out = bench.run_bench(batch_size=4, timed_iters=1,

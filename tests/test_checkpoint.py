@@ -110,6 +110,53 @@ class TestAsyncWriter:
         w.wait()
         assert ckpt.all_steps(str(tmp_path)) == [2]
 
+    def test_write_failure_surfaces_from_next_submit(self, tmp_path):
+        """The contract's other half: a train loop that only ever calls
+        submit() (never wait()) still hears about a dead writer at the
+        NEXT submit — the failure cannot be silently ignored."""
+        w = ckpt.AsyncCheckpointWriter()
+        target = tmp_path / "f"
+        target.write_text("not a directory")
+        w.submit(str(target), _tree(), step=1)
+        with pytest.raises(RuntimeError, match="background checkpoint"):
+            w.submit(str(tmp_path), _tree(), step=2)
+        # The failed submit consumed the error and did NOT start a new
+        # write; the writer is clean for reuse.
+        w.submit(str(tmp_path), _tree(), step=3)
+        w.wait()
+        assert ckpt.all_steps(str(tmp_path)) == [3]
+
+    def test_write_failure_surfaces_from_atexit_drain(self, tmp_path):
+        """A failed in-flight write with NO later submit/wait must still
+        surface at the registered atexit drain — a clean process exit
+        cannot swallow the loss of the final checkpoint."""
+        w = ckpt.AsyncCheckpointWriter()
+        target = tmp_path / "f"
+        target.write_text("not a directory")
+        w.submit(str(target), _tree(), step=1)
+        with pytest.raises(RuntimeError, match="background checkpoint"):
+            ckpt.AsyncCheckpointWriter._drain_all()
+        # Consumed: a second drain (the real atexit would run once) is
+        # clean, as is later reuse.
+        ckpt.AsyncCheckpointWriter._drain_all()
+        w.submit(str(tmp_path), _tree(), step=2)
+        w.wait()
+
+    def test_drain_all_drains_every_writer_despite_failure(self,
+                                                           tmp_path):
+        """One failed writer must not abandon other writers' in-flight
+        checkpoints: the drain completes them all, THEN re-raises."""
+        bad = ckpt.AsyncCheckpointWriter()
+        good = ckpt.AsyncCheckpointWriter()
+        target = tmp_path / "f"
+        target.write_text("not a directory")
+        bad.submit(str(target), _tree(), step=1)
+        good_dir = tmp_path / "ok"
+        good.submit(str(good_dir), _tree(), step=5)
+        with pytest.raises(RuntimeError, match="background checkpoint"):
+            ckpt.AsyncCheckpointWriter._drain_all()
+        assert ckpt.all_steps(str(good_dir)) == [5]
+
     def test_trainer_background_save(self, tmp_path, devices):
         from tpu_ddp.models.transformer import make_transformer
         from tpu_ddp.parallel.mesh import make_mesh
@@ -144,6 +191,8 @@ class TestTrainerResume:
         y = (np.arange(n) % 10).astype(np.int32)
         return x, y
 
+    @pytest.mark.slow  # three trainer steps + restore compile; roundtrip
+    # layout checks stay fast above
     def test_resume_continues_identically(self, tmp_path, devices):
         """save -> restore -> one step == uninterrupted two steps."""
         import jax.numpy as jnp
@@ -212,6 +261,8 @@ class TestLMCheckpoint:
                 np.asarray(a), np.asarray(b), rtol=1e-6),
             jax.device_get(state.params), jax.device_get(state2.params))
 
+    @pytest.mark.slow  # pp trainer compile just for a save/restore pass;
+    # tp and dense roundtrips stay in the default tier
     def test_pipeline_trainer_roundtrip(self, tmp_path, devices):
         import jax.numpy as jnp
 

@@ -142,6 +142,8 @@ class TestClipLM:
             params = tr.zero3.unshard_host(params)
         return params, losses
 
+    @pytest.mark.slow  # four LM trainer compiles; tp agreement is covered
+    # fast by test_tp_layouts_agree
     def test_layouts_agree(self, devices):
         p_ref, l_ref = self._run(devices)
         variants = {

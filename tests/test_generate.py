@@ -108,6 +108,8 @@ class TestGenerate:
 
 
 class TestShardedCheckpointToGenerate:
+    @pytest.mark.slow  # sharded trainer + dense-twin generate compiles;
+    # the class's other drills already live in the slow tier
     def test_dp_sp_tp_checkpoint_generates_like_dense_twin(self, devices,
                                                            tmp_path):
         """The documented serving path: train under dp x sp x tp,

@@ -97,7 +97,9 @@ class TestPipelineEquivalence:
                 err_msg=f"dp={dp} pp={pp} tp={tp} micro={micro}")
 
     @pytest.mark.parametrize("dp,sp,schedule,sp_mode", [
-        (1, 2, "gpipe", "ring"),
+        # the 2x2 1f1b cell exercises dp x sp x pp in one program; the
+        # 1x2 gpipe cell adds only the other schedule at another layout
+        pytest.param(1, 2, "gpipe", "ring", marks=_slow),
         (2, 2, "1f1b", "ring"),
         pytest.param(1, 2, "gpipe", "ulysses", marks=_slow),
         pytest.param(1, 4, "1f1b", "ring", marks=_slow),
@@ -132,6 +134,8 @@ class TestPipelineEquivalence:
                 np.asarray(b), np.asarray(a), rtol=5e-4, atol=5e-5,
                 err_msg=f"dp={dp} sp={sp} {schedule} {sp_mode}")
 
+    @pytest.mark.slow  # pp + dense AdamW double compile pinning one mask
+    # property; the step-equivalence tests above exercise the same path
     def test_adamw_decay_mask_uses_original_ranks(self, devices):
         """Stacking raises LN scales/biases to rank 2; AdamW must still
         exempt them from weight decay (regression: a pipelined AdamW step
@@ -157,6 +161,8 @@ class TestPipelineEquivalence:
         np.testing.assert_allclose(pipe_ln, dense_ln, rtol=1e-4,
                                    atol=1e-6)
 
+    @pytest.mark.slow  # two dropout-pp compiles; pp dropout geometry is
+    # also pinned fast by test_dropout's pipeline invariant
     def test_1f1b_matches_gpipe_with_dropout(self, devices):
         """The two schedules draw IDENTICAL dropout masks (keys derive
         from (microbatch, global layer), independent of the schedule), so

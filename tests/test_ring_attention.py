@@ -213,6 +213,8 @@ class TestRemat:
     """remat_blocks recomputes activations in the backward pass without
     changing any value or gradient."""
 
+    @pytest.mark.slow  # remat + dense fwd/bwd double compile; remat
+    # identity is also pinned fast by test_vit's flash+remat check
     def test_values_and_grads_identical(self):
         dense = make_transformer("TransformerLM-tiny", max_seq_len=16,
                                  compute_dtype=jnp.float32)

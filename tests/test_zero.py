@@ -27,6 +27,8 @@ from conftest import cached_vgg_trainer as _trainer  # noqa: E402
 
 
 class TestZeROEquivalence:
+    @pytest.mark.slow  # two-step momentum sequence; single-step zero1
+    # equivalence and the checkpoint roundtrip stay in the default tier
     def test_steps_match_fused(self, devices):
         """Two part4 steps produce the same parameters as part3 (two,
         not one: step 2 exercises momentum carried in the flat layout)."""

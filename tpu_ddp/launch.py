@@ -24,13 +24,19 @@ from __future__ import annotations
 
 import argparse
 import os
+import random
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from pathlib import Path
+
+from tpu_ddp.resilience.watchdog import (HEARTBEAT_ENV, STALL_EXIT_CODE,
+                                         HeartbeatMonitor)
 
 PARTS_DIR = Path(__file__).resolve().parent.parent / "parts"
 PARTS = ("part1", "part2a", "part2b", "part3", "part4", "part5")
@@ -59,6 +65,10 @@ class LaunchResult:
     # Number of cluster restarts performed before this (final) attempt —
     # nonzero only for launch_elastic.
     restarts: int = 0
+    # True when the heartbeat watchdog killed this attempt: every rank
+    # was alive but none had completed a step within heartbeat_timeout
+    # (the hung-collective failure mode — see resilience/watchdog.py).
+    stalled: bool = False
 
     @property
     def returncode(self) -> int:
@@ -100,6 +110,8 @@ def launch(
     env: dict | None = None,
     echo: bool = True,
     timeout: float | None = None,
+    heartbeat_timeout: float | None = None,
+    heartbeat_dir: str | None = None,
 ) -> LaunchResult:
     """Run ``nproc`` rank processes of ``parts/<part>/main.py`` and wait.
 
@@ -107,6 +119,14 @@ def launch(
     host-platform device count of ``devices_per_proc``, so a laptop/CI host
     emulates an ``nproc``-node cluster with ``nproc * devices_per_proc``
     total dp slots. Extra env wins over the computed defaults.
+
+    ``heartbeat_timeout`` arms the watchdog: workers inherit
+    ``TPU_DDP_HEARTBEAT_DIR`` (a fresh temp dir unless ``heartbeat_dir``
+    pins it) and touch a per-rank file each step; once heartbeats exist,
+    a cluster whose NEWEST beat is older than the deadline is killed and
+    reported with ``stalled=True`` / exit :data:`STALL_EXIT_CODE` —
+    catching hung collectives in seconds instead of waiting out
+    ``timeout`` (which still bounds never-started clusters).
     """
     if nproc < 1:
         raise ValueError("nproc must be >= 1")
@@ -129,6 +149,10 @@ def launch(
             "(parts/ and examples/ are not part of the installed "
             "package)")
     port = port or find_free_port()
+    monitor = None
+    if heartbeat_timeout is not None:
+        hb_dir = heartbeat_dir or tempfile.mkdtemp(prefix="tpu_ddp_hb_")
+        monitor = HeartbeatMonitor(hb_dir, nproc, heartbeat_timeout)
 
     procs = []
     sinks = []
@@ -136,6 +160,8 @@ def launch(
     for rank in range(nproc):
         child_env = dict(os.environ)
         child_env["JAX_PLATFORMS"] = platform
+        if monitor is not None:
+            child_env[HEARTBEAT_ENV] = monitor.directory
         if platform == "cpu":
             # Replace (not append) any inherited forced device count.
             flags = [f for f in child_env.get("XLA_FLAGS", "").split()
@@ -185,6 +211,20 @@ def launch(
                     if other.poll() is None:
                         other.kill()
         if len(rcs) < len(procs):
+            if monitor is not None and not first_failure \
+                    and monitor.stalled():
+                # Watchdog: every remaining rank is alive but the whole
+                # cluster stopped completing steps — a hung collective.
+                # Kill it now; launch_elastic will restart with backoff.
+                print(f"[launch] heartbeat stall: no step completed in "
+                      f"{monitor.timeout:.0f}s — killing the cluster",
+                      flush=True)
+                for rank, proc in enumerate(procs):
+                    if rank not in rcs:
+                        proc.kill()
+                        rcs[rank] = proc.wait()
+                first_failure = STALL_EXIT_CODE
+                break
             if deadline is not None and time.monotonic() > deadline:
                 # A rank may have exited with a real code (even 0, or a
                 # real signal like SIGSEGV) between the last poll and
@@ -204,7 +244,8 @@ def launch(
                 first_failure = first_failure or sweep_real or -9
                 break
             time.sleep(0.05)
-    result = LaunchResult(first_failure=first_failure)
+    result = LaunchResult(first_failure=first_failure,
+                          stalled=first_failure == STALL_EXIT_CODE)
     for rank in range(len(procs)):
         result.workers.append(WorkerResult(rank=rank, returncode=rcs[rank]))
     for t in threads:
@@ -214,11 +255,32 @@ def launch(
     return result
 
 
+def backoff_delay(attempt: int, floor: float = 1.0, cap: float = 60.0,
+                  rng: random.Random | None = None) -> float:
+    """Seconds to wait before restart ``attempt`` (1-based).
+
+    Exponential from ``floor`` (doubling per attempt, capped at ``cap``)
+    plus 0–25% multiplicative jitter: a flaky shared dependency that
+    fails N clusters at once must not have them all re-stampede it in
+    lockstep. ``floor <= 0`` disables the wait entirely (tests).
+    ``rng`` injects a seeded generator for deterministic schedules.
+    """
+    if attempt < 1:
+        raise ValueError(f"attempt is 1-based, got {attempt}")
+    if floor <= 0:
+        return 0.0
+    base = min(cap, floor * (2.0 ** (attempt - 1)))
+    return base * (1.0 + (rng or random).uniform(0.0, 0.25))
+
+
 def launch_elastic(
     part: str,
     nproc: int,
     max_restarts: int = 0,
     extra_args: list | None = None,
+    min_restart_interval: float = 1.0,
+    restart_window: float | None = None,
+    backoff_cap: float = 60.0,
     **kwargs,
 ) -> LaunchResult:
     """:func:`launch` with elastic recovery — the failure-handling layer
@@ -228,6 +290,17 @@ def launch_elastic(
     given a ``--ckpt-dir`` and a checkpoint exists, retries append
     ``--resume`` so training continues from the last saved step instead
     of restarting from scratch.
+
+    Restarts back off exponentially from ``min_restart_interval``
+    (doubling per attempt up to ``backoff_cap``, with jitter —
+    :func:`backoff_delay`), so a persistent failure burns budget slowly
+    instead of crash-looping. ``restart_window`` makes the budget a
+    SLIDING window: only restarts within the last ``restart_window``
+    seconds count against ``max_restarts``, so a long healthy run that
+    hits one preemption a day restarts indefinitely while a crash loop
+    still stops after ``max_restarts`` attempts. ``None`` keeps the
+    lifetime budget. Extra ``kwargs`` reach :func:`launch` — pass
+    ``heartbeat_timeout`` to also arm the stall watchdog per attempt.
     """
     if max_restarts < 0:
         raise ValueError("max_restarts must be >= 0")
@@ -240,21 +313,39 @@ def launch_elastic(
             ckpt_dir = extra[idx + 1]
         elif tok.startswith("--ckpt-dir="):
             ckpt_dir = tok.split("=", 1)[1]
-    res = None
-    for attempt in range(max_restarts + 1):
+    restart_times: deque = deque()  # monotonic stamps of restarts done
+    attempt = 0
+    while True:
         args = list(extra)
         if attempt > 0 and ckpt_dir and "--resume" not in args:
             from tpu_ddp.utils.checkpoint import latest_step
             if latest_step(ckpt_dir) is not None:
                 args.append("--resume")
-        if attempt > 0:
-            print(f"[launch] attempt {attempt + 1}/{max_restarts + 1} "
-                  f"(resume={'--resume' in args})", flush=True)
-            kwargs.pop("port", None)  # fresh coordinator port per attempt
         res = launch(part, nproc, extra_args=args, **kwargs)
         res.restarts = attempt
         if res.ok:
             break
+        # Budget for one more restart? Under a sliding window, stamps
+        # older than the window no longer count.
+        now = time.monotonic()
+        if restart_window is not None:
+            while restart_times and now - restart_times[0] \
+                    > restart_window:
+                restart_times.popleft()
+            if len(restart_times) >= max_restarts:
+                break
+        elif attempt >= max_restarts:
+            break
+        attempt += 1
+        delay = backoff_delay(attempt, floor=min_restart_interval,
+                              cap=backoff_cap)
+        why = "stalled" if res.stalled else f"rc={res.returncode}"
+        print(f"[launch] attempt failed ({why}); restart {attempt} in "
+              f"{delay:.2f}s", flush=True)
+        if delay > 0:
+            time.sleep(delay)
+        restart_times.append(time.monotonic())
+        kwargs.pop("port", None)  # fresh coordinator port per attempt
     return res
 
 
@@ -277,11 +368,24 @@ def main(argv=None) -> int:
     p.add_argument("--max-restarts", type=int, default=0,
                    help="respawn the cluster up to N times on failure, "
                         "resuming from --ckpt-dir when possible")
+    p.add_argument("--min-restart-interval", type=float, default=1.0,
+                   help="backoff floor in seconds before the first "
+                        "restart; doubles per attempt with jitter "
+                        "(<= 0 restarts immediately)")
+    p.add_argument("--restart-window", type=float, default=None,
+                   help="count only restarts within the last N seconds "
+                        "against --max-restarts (default: lifetime)")
+    p.add_argument("--heartbeat-timeout", type=float, default=None,
+                   help="kill + restart a cluster whose ranks all stop "
+                        "completing steps for N seconds (stall watchdog)")
     args, extra = p.parse_known_args(argv)
     try:
         res = launch_elastic(args.part, args.nproc,
                              max_restarts=args.max_restarts,
                              extra_args=extra,
+                             min_restart_interval=args.min_restart_interval,
+                             restart_window=args.restart_window,
+                             heartbeat_timeout=args.heartbeat_timeout,
                              platform=args.platform,
                              devices_per_proc=args.devices_per_proc,
                              port=args.port)
@@ -289,6 +393,8 @@ def main(argv=None) -> int:
         p.error(str(e))  # clean usage error, not a traceback
     for w in res.workers:
         print(f"[launch] rank {w.rank} exited {w.returncode}")
+    if res.stalled:
+        print("[launch] final attempt killed by the heartbeat watchdog")
     if res.restarts:
         print(f"[launch] recovered after {res.restarts} restart(s)")
     return res.returncode

@@ -1,0 +1,36 @@
+"""Resilience subsystem — the failure-handling layer the reference lacks.
+
+The reference cluster has no failure handling at all: a dead gloo rank
+hangs the whole cluster and a single bad batch silently poisons the
+parameters (SURVEY.md §5). On preemptible TPU slices long runs WILL hit
+preemptions, corrupt reads and numerical blow-ups, so recoverability is
+a first-class design axis here (cf. arXiv:2004.13336, veScale
+arXiv:2509.07003). Four pieces:
+
+- :mod:`tpu_ddp.resilience.guard` — in-step non-finite detection: a bad
+  batch's update is skipped (params/opt state pass through unchanged) and
+  K consecutive bad steps raise :class:`TrainingDivergedError` so the
+  elastic layer rolls back to the last checkpoint.
+- :mod:`tpu_ddp.resilience.integrity` — per-leaf sha256 digests in every
+  checkpoint manifest, verified on restore, with automatic fallback to
+  the newest checkpoint that passes (corrupt dirs quarantined to
+  ``step_N.corrupt``, never silently deleted).
+- :mod:`tpu_ddp.resilience.watchdog` — per-rank heartbeat files touched
+  each step; the launcher kills and restarts a cluster whose heartbeats
+  have ALL stalled past a deadline (hung collective / dead rank).
+- :mod:`tpu_ddp.resilience.chaos` — deterministic, seeded fault
+  injection (hard-exit, NaN-gradient, stalled-step, corrupted
+  checkpoint, slow-rank) so every recovery path above is exercised by
+  tests (``TPU_DDP_CHAOS_*`` env knobs; scripts/chaos_sweep.py).
+"""
+
+from tpu_ddp.resilience.chaos import (  # noqa: F401
+    FAULT_EXIT_CODE, FAULT_KINDS, FaultInjector, FaultSpec,
+    maybe_inject_failure)
+from tpu_ddp.resilience.guard import (  # noqa: F401
+    StepGuard, TrainingDivergedError)
+from tpu_ddp.resilience.integrity import (  # noqa: F401
+    CheckpointCorruptError, leaf_digest, quarantine_checkpoint,
+    verify_checkpoint)
+from tpu_ddp.resilience.watchdog import (  # noqa: F401
+    HEARTBEAT_ENV, HeartbeatMonitor, heartbeat_path, touch_heartbeat)

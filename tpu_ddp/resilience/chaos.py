@@ -1,0 +1,308 @@
+"""Deterministic chaos injection — every recovery path gets a drill.
+
+Generalizes the original single-knob ``TPU_DDP_FAIL_AT_STEP`` hard-exit
+(kept, verbatim, as :func:`maybe_inject_failure` — ``utils/invariants``
+re-exports it for back-compat) into a pluggable :class:`FaultInjector`
+with five fault kinds, each exercising one recovery mechanism:
+
+========================  =============================================
+fault kind                recovery path it drills
+========================  =============================================
+``hard-exit``             elastic restart + checkpoint resume
+``nan-grad``              step guard (update skipped on ALL ranks)
+``stalled-step``          heartbeat watchdog kill + elastic restart
+``corrupt-ckpt``          digest verification + quarantine + fallback
+``slow-rank``             straggler tolerance (run completes, slower)
+========================  =============================================
+
+Faults are configured by env so they reach launcher-spawned worker
+processes unchanged:
+
+- ``TPU_DDP_CHAOS_FAULTS`` — comma-separated specs, each
+  ``kind@step`` (fire at that global step) or ``kind@p<float>`` (fire
+  each step with that probability), with an optional ``:rank=R`` suffix
+  (default rank 0). Example: ``nan-grad@3:rank=1,hard-exit@5``.
+- ``TPU_DDP_CHAOS_SEED`` — seed for the probabilistic mode; the
+  fire/no-fire decision is a pure function of (seed, kind, step), so a
+  replayed run injects the identical fault sequence.
+- ``TPU_DDP_CHAOS_SENTINEL`` — a directory; each one-shot fault drops a
+  marker file there before firing, so an elastically-restarted run does
+  not re-fire it (``slow-rank`` is persistent by design and never
+  marks).
+- ``TPU_DDP_CHAOS_STALL_S`` / ``TPU_DDP_CHAOS_SLOW_S`` — sleep lengths
+  for ``stalled-step`` (default 3600: long enough that only the
+  watchdog ends it) and ``slow-rank`` (default 0.25 per step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+
+import numpy as np
+
+FAULT_EXIT_CODE = 13
+
+FAULT_KINDS = ("hard-exit", "nan-grad", "stalled-step", "corrupt-ckpt",
+               "slow-rank")
+
+CHAOS_ENV = "TPU_DDP_CHAOS_FAULTS"
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One configured fault: fire ``kind`` at ``step`` (exactly; or every
+    step >= it for ``slow-rank``) or with probability ``prob`` per step,
+    on process ``rank``."""
+
+    kind: str
+    step: int | None = None
+    prob: float | None = None
+    rank: int = 0
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"available: {FAULT_KINDS}")
+        if (self.step is None) == (self.prob is None):
+            raise ValueError(
+                f"fault {self.kind!r} needs exactly one of step/prob")
+        if self.prob is not None and not 0.0 < self.prob <= 1.0:
+            raise ValueError(f"fault probability must be in (0, 1], "
+                             f"got {self.prob}")
+
+    @property
+    def key(self) -> str:
+        """Stable sentinel-file name for this spec."""
+        trig = f"p{self.prob}" if self.step is None else str(self.step)
+        return f"{self.kind}@{trig}.rank{self.rank}"
+
+
+def parse_faults(spec: str) -> list[FaultSpec]:
+    """Parse a ``TPU_DDP_CHAOS_FAULTS`` value. Raises ValueError with the
+    offending entry on any malformed spec (silently ignoring a typo'd
+    fault would fake chaos coverage)."""
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        head, _, tail = entry.partition(":")
+        kind, at, trigger = head.partition("@")
+        if not at:
+            raise ValueError(f"bad fault spec {entry!r}: expected "
+                             f"kind@step or kind@p<prob>")
+        rank = 0
+        if tail:
+            if not tail.startswith("rank="):
+                raise ValueError(f"bad fault spec {entry!r}: unknown "
+                                 f"option {tail!r} (only rank=R)")
+            rank = int(tail[len("rank="):])
+        try:
+            if trigger.startswith("p"):
+                out.append(FaultSpec(kind, prob=float(trigger[1:]),
+                                     rank=rank))
+            else:
+                out.append(FaultSpec(kind, step=int(trigger), rank=rank))
+        except ValueError as e:
+            raise ValueError(f"bad fault spec {entry!r}: {e}") from None
+    return out
+
+
+def chaos_env_active() -> bool:
+    """True when any fault-injection env knob is set — the engine forces
+    the per-step epoch path then, so faults land on exact steps."""
+    return bool(os.environ.get(CHAOS_ENV)
+                or os.environ.get("TPU_DDP_FAIL_AT_STEP"))
+
+
+class FaultInjector:
+    """Executes configured faults at their steps, on their rank.
+
+    The engine calls :meth:`before_step` with the global step the
+    upcoming update will produce (batch poisoning and delays must land
+    before the step runs) and :meth:`after_step` with the completed
+    step (crashes and checkpoint corruption fire after the step's save,
+    preserving the original ``maybe_inject_failure`` property that a
+    crash-step checkpoint is always on disk).
+    """
+
+    def __init__(self, specs, seed: int = 0,
+                 sentinel_dir: str | None = None,
+                 stall_s: float = 3600.0, slow_s: float = 0.25,
+                 rank: int | None = None):
+        self.specs = list(specs)
+        self.seed = seed
+        self.sentinel_dir = sentinel_dir
+        self.stall_s = stall_s
+        self.slow_s = slow_s
+        self._rank = rank
+
+    @classmethod
+    def from_env(cls, rank: int | None = None) -> "FaultInjector":
+        return cls(
+            parse_faults(os.environ.get(CHAOS_ENV, "")),
+            seed=int(os.environ.get("TPU_DDP_CHAOS_SEED", "0")),
+            sentinel_dir=os.environ.get("TPU_DDP_CHAOS_SENTINEL"),
+            stall_s=float(os.environ.get("TPU_DDP_CHAOS_STALL_S",
+                                         "3600")),
+            slow_s=float(os.environ.get("TPU_DDP_CHAOS_SLOW_S", "0.25")),
+            rank=rank,
+        )
+
+    @property
+    def active(self) -> bool:
+        return bool(self.specs)
+
+    # ---- firing logic --------------------------------------------------
+
+    def rank(self) -> int:
+        if self._rank is not None:
+            return self._rank
+        import jax
+        return jax.process_index()
+
+    def _sentinel_blocks(self, spec: FaultSpec) -> bool:
+        if not self.sentinel_dir:
+            return False
+        return os.path.exists(os.path.join(self.sentinel_dir, spec.key))
+
+    def _mark_sentinel(self, spec: FaultSpec, step: int) -> None:
+        if not self.sentinel_dir:
+            return
+        os.makedirs(self.sentinel_dir, exist_ok=True)
+        with open(os.path.join(self.sentinel_dir, spec.key), "w") as f:
+            f.write(f"fired at step {step}\n")
+
+    def _fires(self, spec: FaultSpec, step: int) -> bool:
+        if spec.rank != self.rank():
+            return False
+        if spec.step is not None:
+            if spec.kind == "slow-rank":
+                return step >= spec.step  # persistent straggler
+            if step != spec.step:
+                return False
+        else:
+            # Seeded per-(kind, step) Bernoulli: replayable chaos. A
+            # string seed hashes via sha512 — stable across processes
+            # and Python versions (tuple seeding is deprecated and
+            # PYTHONHASHSEED-dependent).
+            rng = random.Random(f"{self.seed}:{spec.kind}:{step}")
+            if rng.random() >= spec.prob:
+                return False
+        if spec.kind != "slow-rank" and self._sentinel_blocks(spec):
+            return False
+        return True
+
+    def _announce(self, spec: FaultSpec, step: int) -> None:
+        print(f"[chaos] rank {self.rank()}: injecting {spec.kind} at "
+              f"step {step}", flush=True)
+
+    # ---- engine hooks --------------------------------------------------
+
+    def before_step(self, step: int) -> bool:
+        """Pre-step faults for the step that will produce global ``step``.
+        Returns True iff the batch must be poisoned (``nan-grad``)."""
+        poison = False
+        for spec in self.specs:
+            if not self._fires(spec, step):
+                continue
+            if spec.kind == "nan-grad":
+                self._announce(spec, step)
+                self._mark_sentinel(spec, step)
+                poison = True
+            elif spec.kind == "slow-rank":
+                time.sleep(self.slow_s)
+            elif spec.kind == "stalled-step":
+                self._announce(spec, step)
+                # Mark BEFORE sleeping: the watchdog kills us mid-sleep
+                # and the restarted run must not stall again.
+                self._mark_sentinel(spec, step)
+                time.sleep(self.stall_s)
+        return poison
+
+    def after_step(self, step: int, ckpt_dir: str | None = None) -> None:
+        """Post-step faults for completed global ``step``. Corruption
+        runs before any hard-exit so a combined drill (corrupt newest,
+        then die) leaves the corrupt checkpoint as the newest one."""
+        for spec in self.specs:
+            if spec.kind == "corrupt-ckpt" and self._fires(spec, step):
+                self._announce(spec, step)
+                self._mark_sentinel(spec, step)
+                corrupt_latest_checkpoint(ckpt_dir)
+        for spec in self.specs:
+            if spec.kind == "hard-exit" and self._fires(spec, step):
+                self._announce(spec, step)
+                self._mark_sentinel(spec, step)
+                os._exit(FAULT_EXIT_CODE)
+        # Legacy knob (TPU_DDP_FAIL_AT_STEP) rides the same hook.
+        maybe_inject_failure(step)
+
+    @staticmethod
+    def poison_images(images):
+        """A batch guaranteed to produce non-finite gradients: NaN-filled
+        floats (an integer input batch is converted — the one-retrace
+        cost is irrelevant for a test-only fault)."""
+        images = np.asarray(images)
+        if not np.issubdtype(images.dtype, np.floating):
+            images = images.astype(np.float32)
+        return np.full_like(images, np.nan)
+
+
+def corrupt_latest_checkpoint(ckpt_dir: str | None) -> str | None:
+    """Truncate the newest checkpoint's ``arrays.npz`` to half its size —
+    the on-disk shape of a write cut off by preemption. Returns the
+    mangled path (None when there is nothing to corrupt)."""
+    if not ckpt_dir:
+        return None
+    from tpu_ddp.utils.checkpoint import all_steps
+    steps = all_steps(ckpt_dir)
+    if not steps:
+        return None
+    npz = os.path.join(ckpt_dir, f"step_{steps[-1]:08d}", "arrays.npz")
+    try:
+        size = os.path.getsize(npz)
+        with open(npz, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    except OSError:
+        return None
+    return npz
+
+
+def maybe_inject_failure(step: int) -> None:
+    """Deterministic crash at a configured global step (the original
+    single-fault knob; superseded by :class:`FaultInjector` but kept
+    bit-for-bit: existing tests and docs rely on its exact semantics).
+
+    ``TPU_DDP_FAIL_AT_STEP=N``: when ``step == N``, print a marker and
+    hard-exit with :data:`FAULT_EXIT_CODE`. ``TPU_DDP_FAIL_RANK``
+    (default 0) picks the process that dies; the default is the
+    checkpoint-writing process, which crashes only AFTER its step-N save
+    completed — so a mid-epoch checkpoint at the crash step is always
+    on disk. (Killing a non-writer instead races the launcher's reap of
+    the writer against the writer's in-flight save.)
+
+    One-shot guarantee: a resumed run re-fires whenever its checkpoint
+    cadence left the restored step BELOW N (it replays step N). Set
+    ``TPU_DDP_FAIL_SENTINEL=/path`` to make the fault strictly
+    once-per-history regardless of cadence: the file is created before
+    dying and suppresses any later firing.
+    """
+    at = os.environ.get("TPU_DDP_FAIL_AT_STEP")
+    if at is None or step != int(at):
+        return
+    import jax
+    rank = int(os.environ.get("TPU_DDP_FAIL_RANK", "0"))
+    if jax.process_index() != rank:
+        return
+    sentinel = os.environ.get("TPU_DDP_FAIL_SENTINEL")
+    if sentinel:
+        if os.path.exists(sentinel):
+            return
+        with open(sentinel, "w") as f:
+            f.write(f"fired at step {step}\n")
+    print(f"[fault-injection] killing process {jax.process_index()} at "
+          f"step {step}", flush=True)
+    os._exit(FAULT_EXIT_CODE)

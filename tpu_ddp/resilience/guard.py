@@ -1,0 +1,108 @@
+"""Step guard — skip non-finite updates, raise after K consecutive.
+
+A single NaN/Inf batch (bad input row, bf16 overflow, a flipped bit on a
+preemptible host) would otherwise poison the parameters silently and
+permanently: every later step trains a corpse. The guard makes the step
+self-protecting:
+
+- **jit-side** (:func:`nonfinite_flag` + the ``where``-select in
+  ``Trainer._base_step``): the candidate update is computed as usual, a
+  scalar ``skipped`` flag is derived from the loss and global grad-norm,
+  and the new params/opt state are selected against the OLD ones — a bad
+  step is an exact no-op on the state (momentum included), at the cost
+  of one select per leaf. On a mesh the flag is agreed across replicas
+  (one f32[] psum) so every rank skips or none do — a rank-local
+  decision would bitwise-diverge the replicas, the exact failure the
+  invariant checker exists to catch.
+- **host-side** (:class:`StepGuard`): counts consecutive skips, logs a
+  ``step_skipped`` event via :class:`~tpu_ddp.utils.metrics.MetricsLogger`,
+  and raises :class:`TrainingDivergedError` after K in a row — at that
+  point the run is diverging, not glitching, and the elastic launcher
+  should roll back to the last checkpoint rather than keep skipping.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class TrainingDivergedError(RuntimeError):
+    """K consecutive steps produced non-finite loss/gradients.
+
+    Raised by :class:`StepGuard` out of ``Trainer.train_epoch``; the
+    process exits nonzero and ``launch_elastic`` restarts the cluster
+    from the last (verified) checkpoint — a rollback to before the
+    divergence rather than an endless skip loop.
+    """
+
+
+def nonfinite_flag(loss, grads, axis_name: str | None = None):
+    """jit-side: True iff this step's update must be skipped.
+
+    Checks the (local) loss and the summed squared gradient norm — an
+    overflowing-but-finite gradient squares to inf and is caught too.
+    With ``axis_name`` the flag is OR-reduced across the axis (one
+    scalar psum) so every replica takes the same branch; without it the
+    decision is local (single device, or the 'none' rung whose semantics
+    are no cross-replica communication).
+    """
+    gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in jax.tree.leaves(grads))
+    bad = jnp.logical_not(jnp.isfinite(jnp.asarray(loss, jnp.float32))
+                          & jnp.isfinite(gsq))
+    if axis_name is not None:
+        bad = lax.psum(bad.astype(jnp.float32), axis_name) > 0.0
+    return bad
+
+
+def select_update(bad, old_tree, new_tree):
+    """jit-side: per-leaf ``where`` keeping the OLD state when ``bad``.
+
+    When ``bad`` is False this is exactly the new tree (``where`` with a
+    false predicate is the identity on the chosen branch), so a healthy
+    step stays bit-identical to an unguarded one.
+    """
+    return jax.tree.map(lambda old, new: jnp.where(bad, old, new),
+                        old_tree, new_tree)
+
+
+class StepGuard:
+    """Host-side skip accounting for one training run.
+
+    ``record`` is called once per completed step with that step's
+    ``skipped`` flag (read back with the loss — no extra device sync).
+    ``max_bad_steps`` consecutive skips raise
+    :class:`TrainingDivergedError`; any clean step resets the streak.
+    """
+
+    def __init__(self, max_bad_steps: int = 3, metrics=None,
+                 log=print):
+        if max_bad_steps < 1:
+            raise ValueError(
+                f"max_bad_steps must be >= 1, got {max_bad_steps}")
+        self.max_bad_steps = max_bad_steps
+        self.metrics = metrics
+        self.log = log
+        self.consecutive = 0
+        self.total_skipped = 0
+
+    def record(self, step: int, skipped: bool, loss: float) -> None:
+        if not skipped:
+            self.consecutive = 0
+            return
+        self.consecutive += 1
+        self.total_skipped += 1
+        self.log(f"[guard] non-finite loss/grads at step {step}: update "
+                 f"skipped ({self.consecutive}/{self.max_bad_steps} "
+                 f"consecutive)")
+        if self.metrics is not None:
+            self.metrics.inc("step_skipped")
+            self.metrics.log("step_skipped", step=step, loss=loss,
+                             consecutive=self.consecutive)
+        if self.consecutive >= self.max_bad_steps:
+            raise TrainingDivergedError(
+                f"{self.consecutive} consecutive non-finite steps "
+                f"(last: step {step}, loss {loss}); training has "
+                f"diverged — roll back to the last checkpoint")

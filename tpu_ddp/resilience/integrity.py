@@ -1,0 +1,150 @@
+"""Checkpoint integrity — per-leaf digests, verification, quarantine.
+
+A checkpoint that *exists* is not a checkpoint that *restores*: a
+preempted host can leave a truncated ``arrays.npz`` behind a completed
+rename (network filesystems fsync lazily), and silent bit rot on cheap
+disks is a when, not an if. Three layers of defence:
+
+- :func:`leaf_digest` — sha256 over a leaf's raw bytes; stored per leaf
+  in ``manifest.json`` at save time (``utils/checkpoint.py``).
+- :func:`verify_checkpoint` — re-reads every leaf and compares digests
+  without needing a template pytree; raises
+  :class:`CheckpointCorruptError` naming the first bad leaf. Manifests
+  written before digests existed verify vacuously (nothing to compare).
+- :func:`quarantine_checkpoint` — renames a failed ``step_N`` dir to
+  ``step_N.corrupt`` so the restore fallback never retries it and a
+  human can post-mortem it; corrupt data is NEVER silently deleted.
+
+``Trainer.restore_checkpoint`` composes these into the fallback policy:
+newest checkpoint first, quarantine-and-retry older ones until one
+verifies (``restore_newest_verified``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed to read back or failed digest verification.
+
+    Carries the checkpoint ``path`` so fallback logic can quarantine the
+    right directory.
+    """
+
+    def __init__(self, message: str, path: str | None = None):
+        super().__init__(message)
+        self.path = path
+
+
+def leaf_digest(arr) -> str:
+    """sha256 hex over the leaf's raw bytes (C-contiguous layout).
+
+    Bytes, not values: two arrays with equal digests are bitwise equal,
+    so a flipped mantissa bit — invisible to a loose allclose — fails
+    verification.
+    """
+    a = np.ascontiguousarray(np.asarray(arr))
+    return hashlib.sha256(a.tobytes()).hexdigest()
+
+
+def verify_checkpoint(path: str) -> int:
+    """Verify every leaf of the checkpoint at ``path`` against its
+    manifest digest; returns the number of leaves verified.
+
+    Raises :class:`CheckpointCorruptError` on unreadable/truncated
+    files or any digest mismatch. A pre-digest manifest (no ``digests``
+    key) verifies vacuously and returns 0 — old checkpoints stay
+    restorable, they just carry no integrity evidence.
+    """
+    manifest_path = os.path.join(path, "manifest.json")
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable manifest {manifest_path!r}: {e}", path=path) from e
+    digests = manifest.get("digests")
+    if not digests:
+        return 0
+    npz_path = os.path.join(path, "arrays.npz")
+    checked = 0
+    try:
+        with np.load(npz_path) as npz:
+            for key, want in digests.items():
+                if key not in npz:
+                    raise CheckpointCorruptError(
+                        f"leaf {key!r} missing from {npz_path!r}",
+                        path=path)
+                got = leaf_digest(npz[key])
+                if got != want:
+                    raise CheckpointCorruptError(
+                        f"digest mismatch on leaf {key!r} of "
+                        f"{npz_path!r}: manifest {want[:12]}…, file "
+                        f"{got[:12]}… — checkpoint is corrupt",
+                        path=path)
+                checked += 1
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:  # zipfile.BadZipFile, zlib.error, OSError, …
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint arrays {npz_path!r}: "
+            f"{type(e).__name__}: {e}", path=path) from e
+    return checked
+
+
+def quarantine_checkpoint(path: str) -> str | None:
+    """Rename ``step_N`` -> ``step_N.corrupt`` (``.corrupt-2``, … if
+    taken). Returns the quarantine path, or None if another process got
+    there first (multi-host restores race benignly on a shared FS)."""
+    target = path + ".corrupt"
+    n = 1
+    while os.path.exists(target):
+        n += 1
+        target = f"{path}.corrupt-{n}"
+    try:
+        os.rename(path, target)
+    except OSError:
+        # Multi-host restores race benignly: another process already
+        # moved (or removed) the directory.
+        return None
+    return target
+
+
+def restore_newest_verified(directory: str, template,
+                            log=print) -> tuple:
+    """Restore the newest checkpoint that passes digest verification.
+
+    Walks steps newest-first; a checkpoint that fails verification or
+    fails to load is quarantined (``step_N.corrupt``) and the next-older
+    one is tried. Returns ``(state, step)`` like
+    ``utils.checkpoint.restore_checkpoint``. Raises
+    :class:`CheckpointCorruptError` when every checkpoint is corrupt and
+    ``FileNotFoundError`` when there are none at all.
+    """
+    from tpu_ddp.utils import checkpoint as ckpt
+    steps = ckpt.all_steps(directory)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {directory!r}")
+    last_error: CheckpointCorruptError | None = None
+    for step in reversed(steps):
+        path = os.path.join(directory, f"step_{step:08d}")
+        try:
+            verify_checkpoint(path)
+            # verify=False: every leaf was just hashed by
+            # verify_checkpoint — don't pay for the digests twice.
+            return ckpt.restore_checkpoint(directory, template, step,
+                                           verify=False)
+        except CheckpointCorruptError as e:
+            last_error = e
+            q = quarantine_checkpoint(path)
+            log(f"[ckpt] step {step} failed verification ({e}); "
+                f"quarantined to {q or '<already moved>'}, trying the "
+                f"previous checkpoint")
+    raise CheckpointCorruptError(
+        f"every checkpoint under {directory!r} failed verification "
+        f"(last error: {last_error})", path=directory)

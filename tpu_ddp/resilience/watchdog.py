@@ -1,0 +1,100 @@
+"""Heartbeat watchdog — detect a hung cluster, not just a dead one.
+
+A rank that *dies* is the easy case: the launcher sees its exit code and
+reaps the survivors. A rank that *hangs* (deadlocked collective, stuck
+I/O, a stalled preemptible host) is worse — every other rank blocks
+inside the next collective and the cluster sits silent until the overall
+timeout, which for a long run is hours. The reference had exactly this
+failure mode (a dead gloo rank hangs the cluster, SURVEY.md §5).
+
+Mechanism, deliberately boring: every worker touches a per-rank file
+(``TPU_DDP_HEARTBEAT_DIR/hb_rank{R}``) once per completed step — the
+engine does this in ``train_epoch``. The launcher polls the directory;
+when the NEWEST heartbeat across all ranks is older than the deadline,
+the whole cluster is declared stalled, killed, and (under
+``launch_elastic``) restarted with backoff. Files-and-mtimes survive any
+IPC weirdness: a worker wedged inside a C++ collective cannot answer an
+RPC, but its last heartbeat is still on disk telling us when it wedged.
+
+Grace period: until the FIRST heartbeat appears the watchdog stays
+silent — compile time on a cold cluster can legitimately exceed the
+stall deadline, and a cluster that never starts is the plain timeout's
+job.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+HEARTBEAT_ENV = "TPU_DDP_HEARTBEAT_DIR"
+
+# Exit code the launcher reports for a watchdog-killed (stalled) cluster
+# — distinct from FAULT_EXIT_CODE (13) and from -9 (rank killed as a
+# bystander of another rank's failure).
+STALL_EXIT_CODE = 14
+
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"hb_rank{rank}")
+
+
+def touch_heartbeat(directory: str, rank: int, step: int) -> None:
+    """One beat: write the current step to this rank's heartbeat file.
+
+    An atomic-enough single small write; the watchdog only reads mtimes,
+    the step content is for humans debugging a stall post-mortem.
+    """
+    try:
+        with open(heartbeat_path(directory, rank), "w") as f:
+            f.write(f"{step}\n")
+    except OSError:
+        pass  # a failing heartbeat must never kill a healthy step
+
+
+def heartbeat_from_env():
+    """(directory, rank) when the launcher armed the watchdog, else None.
+    Imported by the engine; jax is imported lazily so this stays cheap
+    for non-distributed runs."""
+    directory = os.environ.get(HEARTBEAT_ENV)
+    if not directory:
+        return None
+    import jax
+    return directory, jax.process_index()
+
+
+class HeartbeatMonitor:
+    """Launcher-side stall detector over a heartbeat directory.
+
+    ``stalled()`` is True iff at least one heartbeat exists (grace —
+    see module docstring) and the newest one across ALL ranks is older
+    than ``timeout`` seconds. One slow rank does not trip it; the
+    cluster as a whole going silent does — which is exactly what a hung
+    collective looks like from the host.
+    """
+
+    def __init__(self, directory: str, nproc: int, timeout: float):
+        if timeout <= 0:
+            raise ValueError(f"timeout must be > 0, got {timeout}")
+        self.directory = directory
+        self.nproc = nproc
+        self.timeout = timeout
+
+    def newest_beat(self) -> float | None:
+        """mtime of the newest heartbeat, or None before the first."""
+        newest = None
+        for rank in range(self.nproc):
+            try:
+                m = os.path.getmtime(heartbeat_path(self.directory, rank))
+            except OSError:
+                continue
+            if newest is None or m > newest:
+                newest = m
+        return newest
+
+    def stalled(self, now: float | None = None) -> bool:
+        newest = self.newest_beat()
+        if newest is None:
+            return False
+        now = time.time() if now is None else now
+        return now - newest > self.timeout

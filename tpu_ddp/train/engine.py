@@ -33,6 +33,8 @@ from tpu_ddp.ops.metrics import top1_correct
 from tpu_ddp.ops.optim import SGD
 from tpu_ddp.parallel.mesh import DATA_AXIS
 from tpu_ddp.parallel.sync import canonical_strategy, get_sync_strategy
+from tpu_ddp.resilience.guard import (StepGuard, nonfinite_flag,
+                                      select_update)
 from tpu_ddp.utils.config import TrainConfig
 from tpu_ddp.utils.metrics import MetricsLogger
 from tpu_ddp.utils.timing import IterationTimer
@@ -160,6 +162,17 @@ class Trainer:
         )
         self.is_fsdp = canonical_strategy(strategy) == "fsdp"
         self._dp = mesh.shape[DATA_AXIS] if mesh is not None else 1
+        # Step guard (resilience/guard.py). The jit-side skip flag is
+        # agreed across replicas with one scalar psum — EXCEPT under
+        # strategy 'none', whose contract is zero cross-replica
+        # communication (each replica guards its own local step, the
+        # same per-replica semantics that rung has for clipping).
+        self._guard_axis = (
+            DATA_AXIS if mesh is not None
+            and canonical_strategy(strategy) != "none" else None)
+        self.guard = (StepGuard(self.config.guard_max_bad_steps,
+                                metrics=self.metrics)
+                      if self.config.guard_nonfinite else None)
         if self.is_zero:
             if mesh is None:
                 raise ValueError("strategy 'zero' shards optimizer state "
@@ -286,7 +299,15 @@ class Trainer:
         """Load a checkpoint (latest by default) placed like
         :meth:`init_state` places fresh state. Checkpoints hold CANONICAL
         shapes; sharded strategies re-flatten for THIS trainer's dp, so
-        a checkpoint moves freely between dp sizes and strategies."""
+        a checkpoint moves freely between dp sizes and strategies.
+
+        ``step=None`` restores the newest checkpoint that passes digest
+        verification: a corrupt newest checkpoint is quarantined to
+        ``step_N.corrupt`` and the previous one is tried
+        (resilience/integrity.py) — so a host preempted mid-fsync costs
+        one checkpoint interval, not the run. An explicit ``step``
+        bypasses the fallback (you asked for THAT checkpoint; restore
+        still digest-verifies it and raises CheckpointCorruptError)."""
         from tpu_ddp.utils import checkpoint as ckpt
         params_t = self._params_template()
         if self.is_zero:
@@ -298,7 +319,13 @@ class Trainer:
         opt_t = jax.eval_shape(inner.init, params_t)
         template = {"params": params_t, "opt_state": opt_t,
                     "step": np.int64(0)}
-        restored, _ = ckpt.restore_checkpoint(directory, template, step)
+        if step is None:
+            from tpu_ddp.resilience.integrity import \
+                restore_newest_verified
+            restored, _ = restore_newest_verified(directory, template)
+        else:
+            restored, _ = ckpt.restore_checkpoint(directory, template,
+                                                  step)
         params, opt_state = restored["params"], restored["opt_state"]
         if self.is_zero:
             opt_state = self.optimizer.flatten_opt(opt_state)
@@ -354,6 +381,22 @@ class Trainer:
         local_mean = wsum / jnp.maximum(n_local, 1.0)
         return loss_for_grad, local_mean
 
+    def _guarded_apply(self, params, opt_state, loss, grads, apply_fn):
+        """Run ``apply_fn() -> (new_params, new_opt)`` under the step
+        guard: a non-finite loss/grad-norm selects the OLD state back
+        (momentum included — the bad step is an exact no-op) and raises
+        the jit-side ``skipped`` flag. A healthy step is bit-identical
+        to an unguarded one (``where`` on a false predicate is the
+        identity). With the guard disabled, just applies."""
+        if self.guard is None:
+            new_params, new_opt = apply_fn()
+            return new_params, new_opt, jnp.zeros((), jnp.float32)
+        bad = nonfinite_flag(loss, grads, self._guard_axis)
+        new_params, new_opt = apply_fn()
+        return (select_update(bad, params, new_params),
+                select_update(bad, opt_state, new_opt),
+                bad.astype(jnp.float32))
+
     def _base_step(self, params, opt_state, images, labels, weights):
         images = self._maybe_normalize(images)
 
@@ -380,8 +423,10 @@ class Trainer:
                         for g in jax.tree.leaves(grads)), DATA_AXIS)
                 grads = clip_tree(
                     grads, clip_scale_from_sq(sq, self.clip_grad_norm))
-            params, opt_state = self.zero3.apply(params, grads, opt_state)
-            return params, opt_state, loss
+            params, opt_state, skipped = self._guarded_apply(
+                params, opt_state, loss, grads,
+                lambda: self.zero3.apply(params, grads, opt_state))
+            return params, opt_state, loss, skipped
 
         def loss_fn(p):
             return self._loss_terms(self.model.apply(p, images),
@@ -394,10 +439,16 @@ class Trainer:
             else self.sync_fn(grads)
         if self.is_zero:
             # Clip (if any) happens on the wrapper's dp-scattered slices
-            # — the only place the synced gradient values exist.
-            params, opt_state = self.optimizer.apply(
-                params, grads, opt_state, clip_norm=self.clip_grad_norm)
-            return params, opt_state, loss
+            # — the only place the synced gradient values exist. The
+            # guard flag, by contrast, must come from the PRE-scatter
+            # local grads (sync_fn is identity here) psum'd across dp —
+            # a rank-local decision would diverge the replicas.
+            params, opt_state, skipped = self._guarded_apply(
+                params, opt_state, loss, grads,
+                lambda: self.optimizer.apply(
+                    params, grads, opt_state,
+                    clip_norm=self.clip_grad_norm))
+            return params, opt_state, loss, skipped
         if self.clip_grad_norm is not None:
             # Replicated rungs: grads are identical on every replica
             # after sync, so the local squared sum IS the global one.
@@ -408,20 +459,24 @@ class Trainer:
                      for g in jax.tree.leaves(grads))
             grads = clip_tree(grads,
                               clip_scale_from_sq(sq, self.clip_grad_norm))
-        params, opt_state = self.optimizer.apply(params, grads, opt_state)
-        return params, opt_state, loss
+        params, opt_state, skipped = self._guarded_apply(
+            params, opt_state, loss, grads,
+            lambda: self.optimizer.apply(params, grads, opt_state))
+        return params, opt_state, loss, skipped
 
     def _build_train_step(self) -> Callable:
         if self.mesh is None:
             return jax.jit(self._base_step, donate_argnums=(0, 1))
 
         def sharded_body(params, opt_state, images, labels, weights):
-            params, opt_state, loss = self._base_step(
+            params, opt_state, loss, skipped = self._base_step(
                 params, opt_state, images, labels, weights)
             # Per-replica scalar -> (1,) so out_spec P(dp) stacks to (dp,):
             # each node keeps printing ITS shard's running loss, as in the
             # reference (every node prints locally, part2b/main.py:134-139).
-            return params, opt_state, loss.reshape(1)
+            # The guard flag travels the same way (replicas agree by
+            # construction except under strategy 'none').
+            return params, opt_state, loss.reshape(1), skipped.reshape(1)
 
         opt_spec = self._opt_spec()
         param_spec = self._param_spec()
@@ -430,7 +485,7 @@ class Trainer:
             mesh=self.mesh,
             in_specs=(param_spec, opt_spec, P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS)),
-            out_specs=(param_spec, opt_spec, P(DATA_AXIS)),
+            out_specs=(param_spec, opt_spec, P(DATA_AXIS), P(DATA_AXIS)),
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
@@ -455,34 +510,39 @@ class Trainer:
         def scan_body(params, opt_state, xs, ys, ws):
             def step(carry, xyw):
                 p, o = carry
-                p, o, loss = self._base_step(p, o, *xyw)
-                return (p, o), loss
+                p, o, loss, skipped = self._base_step(p, o, *xyw)
+                return (p, o), (loss, skipped)
 
-            (params, opt_state), losses = lax.scan(
+            (params, opt_state), (losses, skips) = lax.scan(
                 step, (params, opt_state), (xs, ys, ws))
-            return params, opt_state, losses
+            return params, opt_state, losses, skips
 
         if self.mesh is None:
             fn = jax.jit(scan_body, donate_argnums=(0, 1))
         else:
             def sharded_body(params, opt_state, xs, ys, ws):
-                params, opt_state, losses = scan_body(
+                params, opt_state, losses, skips = scan_body(
                     params, opt_state, xs, ys, ws)
-                return params, opt_state, losses.reshape(k, 1)
+                return (params, opt_state, losses.reshape(k, 1),
+                        skips.reshape(k, 1))
 
             b = P(None, DATA_AXIS)
             mapped = jax.shard_map(
                 sharded_body, mesh=self.mesh,
                 in_specs=(self._param_spec(), self._opt_spec(), b, b, b),
-                out_specs=(self._param_spec(), self._opt_spec(), b),
+                out_specs=(self._param_spec(), self._opt_spec(), b, b),
                 check_vma=False)
             fn = jax.jit(mapped, donate_argnums=(0, 1))
 
         def run(state: TrainState, xs, ys, ws=None):
             if ws is None:
                 ws = jnp.ones(xs.shape[:2], jnp.float32)
-            params, opt_state, losses = fn(state.params, state.opt_state,
-                                           xs, ys, ws)
+            params, opt_state, losses, skips = fn(
+                state.params, state.opt_state, xs, ys, ws)
+            # Guard flags ride on the side (run keeps its public
+            # (state, losses) shape); the epoch loop reads them for
+            # host-side skip accounting.
+            self._last_skipped = skips
             return TrainState(params, opt_state, state.step + k), losses
 
         return run
@@ -524,9 +584,32 @@ class Trainer:
         """
         if weights is None:
             weights = jnp.ones((images.shape[0],), jnp.float32)
-        params, opt_state, loss = self._train_step(
+        params, opt_state, loss, skipped = self._train_step(
             state.params, state.opt_state, images, labels, weights)
+        # Stashed, not returned: train_step keeps its public (state,
+        # loss) shape. Read via last_step_skipped (or the epoch loop's
+        # guard accounting) after forcing the loss.
+        self._last_skipped = skipped
         return TrainState(params, opt_state, state.step + 1), loss
+
+    def _local_scalar(self, arr) -> float:
+        """Host float from THIS process's first addressable shard (the
+        same read pattern the loss uses; a whole-array np.asarray is
+        impossible in multi-process)."""
+        if self.mesh is not None:
+            return float(np.ravel(arr.addressable_shards[0].data)[0])
+        return float(arr)
+
+    def last_step_skipped(self) -> bool:
+        """True iff the most recent train_step's update was skipped by
+        the non-finite guard (resilience/guard.py)."""
+        arr = getattr(self, "_last_skipped", None)
+        if arr is None:
+            return False
+        flat = np.ravel(np.asarray(
+            arr.addressable_shards[0].data
+            if hasattr(arr, "addressable_shards") else arr))
+        return bool(flat[-1] > 0)
 
     # ---- data placement ------------------------------------------------
 
@@ -592,31 +675,49 @@ class Trainer:
         if start_iter:
             import itertools
             batches = itertools.islice(iter(batches), start_iter, None)
+        # Resilience hooks (resilience/): chaos fault injection from env
+        # and the per-rank heartbeat the launcher's watchdog monitors.
+        from tpu_ddp.resilience.chaos import (FaultInjector,
+                                              chaos_env_active)
+        from tpu_ddp.resilience.watchdog import (heartbeat_from_env,
+                                                 touch_heartbeat)
+        injector = FaultInjector.from_env()
+        heartbeat = heartbeat_from_env()
         # K-steps-per-dispatch path (cfg.steps_per_dispatch > 1): groups
         # of K uniform batches run as ONE jitted scan (build_multi_step).
         # Anything that needs per-step host control forces the per-step
         # path: in-loop checkpoint/invariant cadences, the fault-
-        # injection drill (it must fire at an exact step), and
+        # injection drills (they must fire at an exact step), and
         # device_prefetch (its overlap is a per-step transfer pipeline;
         # composing it with grouped dispatch is not implemented).
-        import os as _os
         if (cfg.steps_per_dispatch > 1 and not cfg.ckpt_every_iters
                 and not cfg.check_replicas_every
                 and not cfg.device_prefetch
-                and not _os.environ.get("TPU_DDP_FAIL_AT_STEP")):
+                and not chaos_env_active()):
             return self._train_epoch_multi(state, batches, timer,
-                                           window, start_iter=start_iter)
+                                           window, start_iter=start_iter,
+                                           heartbeat=heartbeat)
         # With device_prefetch > 0 upcoming batches' transfers are already
         # in flight when the step runs (tpu_ddp/data/prefetch.py); the
         # timer still brackets the same loop body as the reference
         # (part1/main.py:65-66 starts its clock after the batch fetch).
-        use_prefetch = cfg.device_prefetch > 0
+        # Active chaos disables prefetch: batch poisoning must happen
+        # host-side on an exact step, before the transfer.
+        use_prefetch = cfg.device_prefetch > 0 and not injector.active
         stream = prefetch_to_device(batches, self.put_batch,
                                     cfg.device_prefetch) \
             if use_prefetch else batches
         for it, item in enumerate(stream, start=start_iter):
             if cfg.max_iters is not None and it >= cfg.max_iters:
                 break
+            if injector.active:
+                # Pre-step faults for the step producing state.step + 1:
+                # nan-grad poisons THIS rank's shard of the batch (sync
+                # spreads the NaNs; the guard then skips on all ranks),
+                # stalled-step/slow-rank sleep here.
+                if injector.before_step(state.step + 1):
+                    item = (FaultInjector.poison_images(item[0]),) \
+                        + tuple(item[1:])
             timer.start()
             x, y, w = item if use_prefetch else self.put_batch(*item)
             state, loss = self.train_step(state, x, y, w)
@@ -634,6 +735,16 @@ class Trainer:
             else:
                 local_loss = float(loss)
             window.account(it, local_loss, state.step)
+            if self.guard is not None:
+                # Raises TrainingDivergedError after K consecutive skips
+                # — BEFORE the checkpoint cadence below, so the last
+                # checkpoint on disk predates the divergence.
+                self.guard.record(
+                    state.step,
+                    self._local_scalar(self._last_skipped) > 0,
+                    local_loss)
+            if heartbeat is not None:
+                touch_heartbeat(heartbeat[0], heartbeat[1], state.step)
             # Aux subsystems (no reference equivalent — SURVEY.md §5):
             # mid-epoch checkpoints, replica-invariant check, fault hook.
             if (ckpt_dir and cfg.ckpt_every_iters
@@ -654,12 +765,14 @@ class Trainer:
                     from tpu_ddp.utils.invariants import \
                         check_replica_consistency
                     check_replica_consistency(state.params)
-            from tpu_ddp.utils.invariants import maybe_inject_failure
-            maybe_inject_failure(state.step)
+            # Post-step faults: hard-exit / corrupt-ckpt (and the legacy
+            # TPU_DDP_FAIL_AT_STEP knob) fire AFTER the step's save, so
+            # a crash-step checkpoint is always on disk.
+            injector.after_step(state.step, ckpt_dir)
         return state, window.epoch_stats()
 
     def _train_epoch_multi(self, state, batches, timer, window,
-                           start_iter):
+                           start_iter, heartbeat=None):
         """Epoch loop with K optimizer steps per dispatch.
 
         Groups of K same-shape, slot-divisible host batches run through
@@ -668,6 +781,7 @@ class Trainer:
         Loss-print cadence and the iteration-window timer keep the
         reference's semantics via the shared ``_LossWindow`` (per-
         dispatch time attributed evenly to its K iterations)."""
+        from tpu_ddp.resilience.watchdog import touch_heartbeat
         cfg = self.config
         K = cfg.steps_per_dispatch
         multi = self.build_multi_step(K)
@@ -680,6 +794,10 @@ class Trainer:
                 return float(np.ravel(loss.addressable_shards[0].data)[0])
             return float(loss)
 
+        def beat():
+            if heartbeat is not None:
+                touch_heartbeat(heartbeat[0], heartbeat[1], state.step)
+
         it = start_iter
         buf: list = []
 
@@ -691,7 +809,14 @@ class Trainer:
                                               *self.put_batch(bx, by))
                 loss = jax.block_until_ready(loss)
                 timer.stop(it)
-                window.account(it, local_of(loss), state.step)
+                local = local_of(loss)
+                window.account(it, local, state.step)
+                if self.guard is not None:
+                    self.guard.record(
+                        state.step,
+                        self._local_scalar(self._last_skipped) > 0,
+                        local)
+                beat()
                 it += 1
             buf.clear()
 
@@ -723,12 +848,25 @@ class Trainer:
                     per_step = per_step[:, 0]
                 else:
                     per_step = np.ravel(np.asarray(losses))
+                skips = getattr(self, "_last_skipped", None)
+                if skips is not None:
+                    if self.mesh is not None:
+                        skips = np.asarray(
+                            skips.addressable_shards[0].data
+                        ).reshape(K, -1)[:, 0]
+                    else:
+                        skips = np.ravel(np.asarray(skips))
                 for j in range(K):
                     # state.step already advanced by K; attribute each
                     # iteration its own global step.
                     window.account(it, float(per_step[j]),
                                    state.step - K + j + 1)
+                    if self.guard is not None and skips is not None:
+                        self.guard.record(state.step - K + j + 1,
+                                          bool(skips[j] > 0),
+                                          float(per_step[j]))
                     it += 1
+                beat()
                 buf.clear()
             else:
                 flush_singles()  # non-uniform group: step them singly

@@ -51,6 +51,7 @@ def save_checkpoint(directory: str, state, step: int,
     Returns the final checkpoint path. Atomic: partial writes never
     become visible.
     """
+    from tpu_ddp.resilience.integrity import leaf_digest
     os.makedirs(directory, exist_ok=True)
     final = os.path.join(directory, f"step_{step:08d}")
     leaves = jax.tree_util.tree_flatten_with_path(state)[0]
@@ -65,6 +66,11 @@ def save_checkpoint(directory: str, state, step: int,
             "format_version": _FORMAT_VERSION,
             "step": step,
             "leaves": list(arrays.keys()),
+            # Per-leaf sha256 over raw bytes: restore (and the offline
+            # verifier, resilience/integrity.py) re-hash and compare, so
+            # a truncated npz or flipped bit is caught BEFORE training
+            # resumes from garbage.
+            "digests": {k: leaf_digest(v) for k, v in arrays.items()},
         }
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=1)
@@ -209,27 +215,51 @@ def latest_step(directory: str) -> int | None:
     return steps[-1] if steps else None
 
 
-def restore_checkpoint(directory: str, template, step: int | None = None):
+def restore_checkpoint(directory: str, template, step: int | None = None,
+                       verify: bool = True):
     """Restore into the structure of ``template``; returns ``(state, step)``.
 
     ``template`` supplies the pytree structure (and is typically a freshly
     built state, e.g. ``Trainer.init_state()``); restored leaves are
     returned as numpy arrays in that structure — callers re-place them on
     device (``Trainer.restore`` does). ``step=None`` picks the latest.
+
+    Every leaf is digest-verified against the manifest as it is read
+    (``verify=False`` skips — e.g. after an explicit
+    ``verify_checkpoint``); unreadable/truncated archives and digest
+    mismatches raise :class:`tpu_ddp.resilience.CheckpointCorruptError`
+    naming the checkpoint path, so callers can tell "this checkpoint is
+    damaged" apart from "this checkpoint is for a different model"
+    (which stays ``ValueError``/``KeyError``).
     """
+    from tpu_ddp.resilience.integrity import (CheckpointCorruptError,
+                                              leaf_digest)
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoints under {directory!r}")
     path = os.path.join(directory, f"step_{step:08d}")
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable manifest in checkpoint {path!r}: {e}",
+            path=path) from e
     if manifest["format_version"] != _FORMAT_VERSION:
         raise ValueError(
             f"checkpoint format {manifest['format_version']} != "
             f"{_FORMAT_VERSION}")
-    with np.load(os.path.join(path, "arrays.npz")) as npz:
+    digests = manifest.get("digests") if verify else None
+    npz_path = os.path.join(path, "arrays.npz")
+    try:
+        npz_cm = np.load(npz_path)
+    except Exception as e:  # zipfile.BadZipFile, OSError, …
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint arrays {npz_path!r}: "
+            f"{type(e).__name__}: {e}", path=path) from e
+    with npz_cm as npz:
         paths_and_leaves, treedef = \
             jax.tree_util.tree_flatten_with_path(template)
         if len(paths_and_leaves) != len(manifest["leaves"]):
@@ -244,7 +274,18 @@ def restore_checkpoint(directory: str, template, step: int | None = None):
                     f"leaf {key!r} missing from checkpoint {path!r} "
                     f"(saved: {manifest['leaves'][i]!r}) — structure "
                     f"mismatch")
-            arr = npz[key]
+            try:
+                arr = npz[key]
+            except Exception as e:  # truncated member: zlib.error, …
+                raise CheckpointCorruptError(
+                    f"leaf {key!r} of {npz_path!r} failed to read: "
+                    f"{type(e).__name__}: {e} — checkpoint is "
+                    f"truncated or corrupt", path=path) from e
+            if digests is not None and key in digests \
+                    and leaf_digest(arr) != digests[key]:
+                raise CheckpointCorruptError(
+                    f"digest mismatch on leaf {key!r} of {npz_path!r} "
+                    f"— checkpoint is corrupt", path=path)
             want = np.shape(leaf)
             if tuple(arr.shape) != tuple(want):
                 raise ValueError(

@@ -1,0 +1,64 @@
+"""JAX version compatibility shims.
+
+The framework targets the modern JAX surface (``jax.shard_map`` with the
+``check_vma`` flag, promoted out of ``jax.experimental`` in 0.6); the
+pinned environment may carry an older release where the function still
+lives at ``jax.experimental.shard_map.shard_map`` and the flag is named
+``check_rep``. Rather than sprinkling try/except around every call site,
+:func:`install` backfills ``jax.shard_map`` once, at package import
+(tpu_ddp/__init__.py) — call sites are written against the modern API
+only.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    """Backfill modern API names onto older jax modules. Idempotent."""
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, mesh, in_specs, out_specs, check_vma=True,
+                      **kwargs):
+            # check_vma is the modern name of check_rep (the value-moved-
+            # across check); semantics are unchanged for our uses.
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma,
+                              **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.distributed, "is_initialized"):
+        # Added to the public API after this release; the underlying
+        # client handle has always carried the answer.
+        from jax._src import distributed as _distributed_impl
+
+        def is_initialized() -> bool:
+            return _distributed_impl.global_state.client is not None
+
+        jax.distributed.is_initialized = is_initialized
+
+    try:
+        jax.tree_util.keystr((), simple=True, separator=".")
+    except TypeError:
+        # Older keystr() predates simple/separator (added in 0.4.36+ API
+        # churn); emulate: simple mode renders each key entry bare
+        # (dict key / sequence index / attribute name, no brackets or
+        # quotes) joined by the separator.
+        _orig_keystr = jax.tree_util.keystr
+
+        def keystr(keys, simple=False, separator=""):
+            if not simple:
+                return _orig_keystr(keys)
+
+            def one(k):
+                for attr in ("key", "idx", "name"):
+                    if hasattr(k, attr):
+                        return str(getattr(k, attr))
+                return str(k)
+
+            return separator.join(one(k) for k in keys)
+
+        jax.tree_util.keystr = keystr

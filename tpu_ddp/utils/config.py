@@ -86,6 +86,15 @@ class TrainConfig:
     # Replica-consistency check cadence in steps (0 = off); env
     # TPU_DDP_CHECK_REPLICAS_EVERY (tpu_ddp/utils/invariants.py).
     check_replicas_every: int = 0
+    # Step guard (tpu_ddp/resilience/guard.py): skip updates whose loss
+    # or global grad-norm is non-finite — the state passes through a
+    # bad batch unchanged. On by default (a healthy step is bit-identical
+    # to an unguarded one); env TPU_DDP_GUARD=0 disables.
+    guard_nonfinite: bool = True
+    # Consecutive skipped steps before train_epoch raises
+    # TrainingDivergedError (the elastic layer then rolls back to the
+    # last checkpoint); env TPU_DDP_GUARD_MAX_BAD.
+    guard_max_bad_steps: int = 3
 
     def __post_init__(self):
         if self.max_iters is None:
@@ -131,6 +140,11 @@ class TrainConfig:
         env_rc = os.environ.get("TPU_DDP_CHECK_REPLICAS_EVERY")
         if env_rc:
             self.check_replicas_every = int(env_rc)
+        self.guard_nonfinite = _env_bool("TPU_DDP_GUARD",
+                                         self.guard_nonfinite)
+        env_gb = os.environ.get("TPU_DDP_GUARD_MAX_BAD")
+        if env_gb:
+            self.guard_max_bad_steps = int(env_gb)
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.

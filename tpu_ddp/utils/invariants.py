@@ -16,21 +16,21 @@ SURVEY.md §5 "Race detection: Absent", "Failure detection: Absent").
 - :func:`check_replica_consistency` — raises ``ReplicaDivergenceError``
   naming the worst leaf when divergence exceeds ``atol``. The engine
   calls it every ``check_replicas_every`` steps when configured.
-- :func:`maybe_inject_failure` — kills the process with exit code 13
-  when the configured global step is reached (``TPU_DDP_FAIL_AT_STEP``),
-  used by the elastic-restart tests (tpu_ddp/launch.py:launch_elastic).
-  Replayed runs that resume PAST the step do not re-fire, so a
-  checkpointed run crashes exactly once.
+- :func:`maybe_inject_failure` — BACK-COMPAT SHIM. Fault injection
+  graduated into the resilience subsystem
+  (:mod:`tpu_ddp.resilience.chaos`), which generalizes the single
+  hard-exit knob into five fault kinds behind ``TPU_DDP_CHAOS_*`` env
+  config; the name (and :data:`FAULT_EXIT_CODE`) stay importable from
+  here with identical semantics.
 """
 
 from __future__ import annotations
 
-import os
-
 import jax
 import numpy as np
 
-FAULT_EXIT_CODE = 13
+from tpu_ddp.resilience.chaos import (  # noqa: F401  (back-compat)
+    FAULT_EXIT_CODE, maybe_inject_failure)
 
 
 class ReplicaDivergenceError(RuntimeError):
@@ -102,37 +102,3 @@ def check_replica_consistency(tree, atol: float = 0.0) -> dict:
             f"{worst}: {bad[worst]:.3e} (invariant (ii) of the reference "
             f"report: replicas must hold identical parameters)")
     return div
-
-
-def maybe_inject_failure(step: int) -> None:
-    """Deterministic crash at a configured global step.
-
-    ``TPU_DDP_FAIL_AT_STEP=N``: when ``step == N``, print a marker and
-    hard-exit with :data:`FAULT_EXIT_CODE`. ``TPU_DDP_FAIL_RANK``
-    (default 0) picks the process that dies; the default is the
-    checkpoint-writing process, which crashes only AFTER its step-N save
-    completed — so a mid-epoch checkpoint at the crash step is always
-    on disk. (Killing a non-writer instead races the launcher's reap of
-    the writer against the writer's in-flight save.)
-
-    One-shot guarantee: a resumed run re-fires whenever its checkpoint
-    cadence left the restored step BELOW N (it replays step N). Set
-    ``TPU_DDP_FAIL_SENTINEL=/path`` to make the fault strictly
-    once-per-history regardless of cadence: the file is created before
-    dying and suppresses any later firing.
-    """
-    at = os.environ.get("TPU_DDP_FAIL_AT_STEP")
-    if at is None or step != int(at):
-        return
-    rank = int(os.environ.get("TPU_DDP_FAIL_RANK", "0"))
-    if jax.process_index() != rank:
-        return
-    sentinel = os.environ.get("TPU_DDP_FAIL_SENTINEL")
-    if sentinel:
-        if os.path.exists(sentinel):
-            return
-        with open(sentinel, "w") as f:
-            f.write(f"fired at step {step}\n")
-    print(f"[fault-injection] killing process {jax.process_index()} at "
-          f"step {step}", flush=True)
-    os._exit(FAULT_EXIT_CODE)

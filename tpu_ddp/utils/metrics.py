@@ -28,6 +28,11 @@ class MetricsLogger:
         self.path = path
         self.rank = rank
         self._fh = None
+        # In-memory event counters (resilience accounting: skipped
+        # steps, injected faults, quarantined checkpoints). Tracked even
+        # with no sink file, so code can ask "how many?" after a run
+        # without parsing JSONL.
+        self.counters: dict[str, int] = {}
         if path:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
@@ -36,6 +41,12 @@ class MetricsLogger:
     @property
     def enabled(self) -> bool:
         return self._fh is not None
+
+    def inc(self, name: str, n: int = 1) -> int:
+        """Bump (and return) an in-memory counter; no line is written —
+        pair with :meth:`log` when the event itself matters."""
+        self.counters[name] = self.counters.get(name, 0) + n
+        return self.counters[name]
 
     def log(self, event: str, **fields) -> None:
         if self._fh is None:
