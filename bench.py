@@ -179,7 +179,8 @@ def _sample_fields(samples: list, used: int | None = None) -> dict:
 def run_bench(batch_size: int | None = None, timed_iters: int = 39,
               config: str | None = None, end_to_end_iters: int = 3,
               with_xla_flops: bool = True,
-              with_multi_step: bool = True, windows: int = 3) -> dict:
+              with_multi_step: bool = True, windows: int = 3,
+              with_dispatch_probe: bool = True) -> dict:
     import jax
 
     from tpu_ddp.models import VGG_CFG, get_model
@@ -308,6 +309,32 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
         np.asarray(loss)
         e2e.stop(it)
 
+    # Dispatch-depth probe (round 6): what the async pipeline
+    # (tpu_ddp/train/pipeline.py) buys the STREAMING train_epoch loop —
+    # steps/sec and host_gap_ms (host wall time idle inside forced
+    # ``block_until_ready``) at depth 0 (the pre-round-6 synchronous
+    # loop) vs the configured ``cfg.dispatch_depth``. Same protocol as
+    # the committed artifact (scripts/host_gap.py — shared depth_sweep
+    # helper), so the bench record and the artifact agree by
+    # construction. Headline config only, like multi_step.
+    dispatch_pipeline = None
+    if (with_dispatch_probe and config == "vgg11_cifar10"
+            and timed_iters >= 4):
+        from tpu_ddp.train.pipeline import depth_sweep
+        probe_depths = sorted({0, cfg.dispatch_depth or 2})
+        try:
+            probe, state = depth_sweep(trainer, state, host * 3,
+                                       probe_depths, reps=1)
+            at_depth = probe[str(max(probe_depths))]
+            dispatch_pipeline = {
+                "dispatch_depth": cfg.dispatch_depth,
+                "host_gap_ms": at_depth["host_gap_ms"],
+                "host_gap_ms_sync": probe["0"]["host_gap_ms"],
+                "sweep": probe,
+            }
+        except Exception as e:  # noqa: BLE001 — probe must not kill it
+            dispatch_pipeline = {"error": f"{type(e).__name__}: {e}"}
+
     # Analytic model FLOPs per forward step (tpu_ddp/utils/flops.py).
     from tpu_ddp.utils import flops as F
     if cfg.model in VGG_CFG:
@@ -352,6 +379,9 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
             **({"multi_step": multi_step} if multi_step else {}),
             **({"chained_dispatch": chained} if promoted else chained),
             "end_to_end_iter_s": round(e2e.average_s, 6),
+            "dispatch_depth": cfg.dispatch_depth,
+            **({"dispatch_pipeline": dispatch_pipeline}
+               if dispatch_pipeline else {}),
             "batch_size": batch_size,
             "timed_iters": timed_iters,
             "timing_protocol": (
@@ -561,7 +591,8 @@ def main() -> dict:
     for bs in (1024, 2048, 4096, 8192, 16384):
         r = _sub(run_bench, batch_size=bs, timed_iters=10,
                  config="vgg11_cifar10", end_to_end_iters=1,
-                 with_xla_flops=False, with_multi_step=False)
+                 with_xla_flops=False, with_multi_step=False,
+                 with_dispatch_probe=False)
         sweep[str(bs)] = (
             {"images_per_sec": r["value"], "mfu": r["extra"]["mfu"]}
             if "error" not in r else r)

@@ -16,6 +16,8 @@ drives all of its local chips as dp slots, and env knobs
 shrink a run for smoke tests; ``TPU_DDP_COMPUTE_DTYPE`` overrides the
 matmul dtype (f32 runs for drift measurement),
 ``TPU_DDP_STEPS_PER_DISPATCH`` groups K optimizer steps per dispatch,
+``TPU_DDP_DISPATCH_DEPTH`` sizes the engine's async dispatch window
+(0 = fully synchronous loop; docs/DESIGN.md §13),
 and ``TPU_DDP_SHARD_EVAL=1`` opts into the process-sharded dp-psum'd
 evaluation (CIFAR path).
 """

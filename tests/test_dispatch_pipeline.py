@@ -1,0 +1,319 @@
+"""Async dispatch pipeline (tpu_ddp/train/pipeline.py, round 6).
+
+Three layers:
+
+- :class:`DispatchPipeline` unit semantics on fake handles — FIFO
+  delivery, depth-0 synchronous degeneration, the ≤1-forced-sync-per-
+  ``depth``-steps drain discipline;
+- the engine's streaming loop under ``cfg.dispatch_depth > 0`` — log
+  parity with the synchronous loop, step-ordered accounting, the
+  delayed-divergence contract (TrainingDivergedError at most ``depth``
+  steps late), and the sync-count regression (monkeypatched
+  ``jax.block_until_ready``);
+- composition knobs — TPU_DDP_DISPATCH_DEPTH env parsing, prefetch
+  depth validation, and which fault kinds disable device prefetch
+  (only host-side batch poisoning; docs/DESIGN.md §13).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.models.vgg import VGGModel
+from tpu_ddp.train.engine import Trainer
+from tpu_ddp.train.pipeline import DispatchPipeline
+from tpu_ddp.utils.config import TrainConfig
+
+
+class FakeHandle:
+    """Stands in for a device array: pollable, blockable readiness."""
+
+    def __init__(self, ready=False):
+        self.ready = ready
+
+    def is_ready(self):
+        return self.ready
+
+    def block_until_ready(self):
+        self.ready = True
+        return self
+
+
+class TestDispatchPipelineUnit:
+    def test_negative_depth_rejected(self):
+        with pytest.raises(ValueError, match="depth must be >= 0"):
+            DispatchPipeline(-1)
+
+    def test_depth_zero_is_synchronous(self):
+        """Every submit delivers before returning — even a handle that
+        never polls ready (the forced drain blocks on it)."""
+        pipe = DispatchPipeline(0)
+        got = []
+        for i in range(3):
+            pipe.submit(FakeHandle(ready=False), lambda v, i=i: got.append(i))
+            assert got == list(range(i + 1))
+        assert len(pipe) == 0
+        assert pipe.stats()["forced_syncs"] == 3
+        assert pipe.stats()["max_in_flight"] == 1
+
+    def test_fifo_head_blocks_delivery(self):
+        """A ready handle behind an unready head must wait: delivery is
+        strictly in submission order (the harvested-results consumers —
+        loss window, guard, heartbeat — assume it)."""
+        pipe = DispatchPipeline(3)
+        h0, h1 = FakeHandle(ready=False), FakeHandle(ready=True)
+        got = []
+        pipe.submit(h0, lambda v: got.append(0))
+        pipe.submit(h1, lambda v: got.append(1))
+        assert got == []  # h1 ready, but h0 gates the queue
+        h0.ready = True
+        pipe.poll()
+        assert got == [0, 1]
+        assert pipe.stats()["forced_syncs"] == 0
+
+    def test_one_forced_sync_per_window_overflow(self):
+        """depth unready submits ride free; the (depth+1)-th triggers ONE
+        blocking drain of the whole window."""
+        pipe = DispatchPipeline(2)
+        got = []
+        for i in range(3):
+            pipe.submit(FakeHandle(ready=False),
+                        lambda v, i=i: got.append(i))
+        assert got == [0, 1, 2]
+        s = pipe.stats()
+        assert s["forced_syncs"] == 1
+        assert s["harvested"] == 3
+        assert s["max_in_flight"] == 3
+        assert s["host_gap_ms"] >= 0.0
+
+    def test_sync_submit_flushes_backlog_and_itself(self):
+        pipe = DispatchPipeline(4)
+        got = []
+        pipe.submit(FakeHandle(ready=False), lambda v: got.append(0))
+        pipe.submit(FakeHandle(ready=False), lambda v: got.append(1),
+                    sync=True)
+        assert got == [0, 1]
+        assert pipe.stats()["forced_syncs"] == 1
+
+    def test_drain_empties_and_is_noop_when_empty(self):
+        pipe = DispatchPipeline(4)
+        got = []
+        pipe.submit(FakeHandle(ready=False), lambda v: got.append(0))
+        pipe.drain()
+        assert got == [0]
+        pipe.drain()  # empty: must not count a forced sync
+        assert pipe.stats()["forced_syncs"] == 1
+
+    def test_raising_callback_propagates_keeps_rest_queued(self):
+        """A diverging step's callback raises out of the drain; handles
+        behind it stay queued (and die with the trainer — their steps
+        never reached any harvested-results consumer)."""
+        pipe = DispatchPipeline(4)
+
+        def boom(v):
+            raise RuntimeError("diverged")
+
+        pipe.submit(FakeHandle(ready=False), boom)
+        pipe.submit(FakeHandle(ready=False), lambda v: None)
+        with pytest.raises(RuntimeError, match="diverged"):
+            pipe.drain()
+        assert len(pipe) == 1
+
+
+class TestDispatchDepthConfig:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("TPU_DDP_DISPATCH_DEPTH", "5")
+        assert TrainConfig().dispatch_depth == 5
+
+    def test_negative_rejected(self):
+        with pytest.raises(ValueError, match="dispatch_depth"):
+            TrainConfig(dispatch_depth=-1)
+
+    def test_env_negative_rejected(self, monkeypatch):
+        monkeypatch.setenv("TPU_DDP_DISPATCH_DEPTH", "-2")
+        with pytest.raises(ValueError, match="dispatch_depth"):
+            TrainConfig()
+
+
+class TestPrefetchComposition:
+    def test_negative_prefetch_depth_rejected(self):
+        from tpu_ddp.data.prefetch import prefetch_to_device
+        with pytest.raises(ValueError, match="prefetch depth"):
+            list(prefetch_to_device([], lambda b: b, depth=-1))
+
+    def test_poisons_batches_only_for_nan_grad(self):
+        from tpu_ddp.resilience.chaos import FaultInjector, parse_faults
+        assert FaultInjector(parse_faults("nan-grad@3")).poisons_batches
+        for passive in ("slow-rank@3", "hard-exit@3", "corrupt-ckpt@3",
+                        "stalled-step@3"):
+            inj = FaultInjector(parse_faults(passive))
+            assert inj.active and not inj.poisons_batches, passive
+
+    @pytest.mark.parametrize("spec,expect_prefetch", [
+        ("slow-rank@1", True),   # passive: composes with prefetch
+        ("nan-grad@1", False),   # poisons a batch host-side: disables it
+    ])
+    def test_engine_disables_prefetch_only_for_poisoning(
+            self, monkeypatch, spec, expect_prefetch):
+        import tpu_ddp.train.engine as engine_mod
+        monkeypatch.setenv("TPU_DDP_CHAOS_FAULTS", spec)
+        monkeypatch.setenv("TPU_DDP_CHAOS_SLOW_S", "0.001")
+        called = []
+        real = engine_mod.prefetch_to_device
+
+        def spy(batches, put_fn, depth):
+            called.append(depth)
+            return real(batches, put_fn, depth)
+
+        monkeypatch.setattr(engine_mod, "prefetch_to_device", spy)
+        trainer = tiny_trainer(device_prefetch=2, guard_max_bad_steps=5)
+        state = trainer.init_state()
+        trainer.train_epoch(state, nan_after(3, bad_from=99)[0](),
+                            log=lambda s: None)
+        assert bool(called) is expect_prefetch
+
+
+def tiny_trainer(**kw):
+    model = VGGModel(name="tiny", cfg=(8, "M", 16, "M"),
+                     compute_dtype=jnp.float32)
+    return Trainer(model, TrainConfig(**kw), strategy="none")
+
+
+def small_batches(n, bs=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.normal(0, 0.1, size=(bs, 4, 4, 3)).astype(np.float32),
+             rng.integers(0, 10, size=bs).astype(np.int32))
+            for _ in range(n)]
+
+
+def nan_after(n, bs=16, bad_from=1):
+    """A counting generator factory: batches ``bad_from`` onward are all
+    NaN. Returns (make_gen, consumed) — ``consumed[0]`` counts how many
+    batches the epoch loop actually pulled, which bounds how far the
+    loop ran past the diverging step."""
+    consumed = [0]
+
+    def gen():
+        for i, (x, y) in enumerate(small_batches(n, bs=bs)):
+            consumed[0] += 1
+            if i >= bad_from:
+                x = np.full_like(x, np.nan)
+            yield x, y
+
+    return gen, consumed
+
+
+class TestAsyncEpoch:
+    def _filtered(self, lines):
+        # The timing report embeds measured wall-clock ns — the one
+        # line that legitimately differs between runs.
+        return [l for l in lines if "timing over iterations" not in l]
+
+    def test_log_and_loss_parity_across_depths(self):
+        """The async loop must print the same lines and account the
+        same losses as the synchronous one — just later."""
+        runs = {}
+        for depth in (0, 3):
+            trainer = tiny_trainer(log_every=2, timing_first_iter=1,
+                                   timing_last_iter=2,
+                                   dispatch_depth=depth)
+            lines = []
+            _, stats = trainer.train_epoch(trainer.init_state(),
+                                           small_batches(8),
+                                           log=lines.append)
+            runs[depth] = (self._filtered(lines), stats)
+        lines0, stats0 = runs[0]
+        lines3, stats3 = runs[3]
+        assert lines0 == lines3
+        assert stats0["last_loss"] == pytest.approx(
+            stats3["last_loss"], abs=1e-6)
+        assert stats0["iters"] == stats3["iters"] == 8
+        assert stats3["forced_syncs"] < stats0["forced_syncs"]
+
+    def test_guard_records_in_step_order(self):
+        """Harvest order == step order (FIFO pipeline): the guard sees
+        steps 1..N exactly, each once, even at depth > 0."""
+        trainer = tiny_trainer(dispatch_depth=2, timing_first_iter=1,
+                               timing_last_iter=0)
+        seen = []
+
+        class Recorder:
+            def record(self, step, skipped, loss):
+                seen.append((step, skipped))
+
+        trainer.guard = Recorder()
+        trainer.train_epoch(trainer.init_state(), small_batches(7),
+                            log=lambda s: None)
+        assert [s for s, _ in seen] == list(range(1, 8))
+        assert not any(sk for _, sk in seen)
+
+    def test_divergence_raises_at_most_depth_late(self):
+        """The delayed-divergence contract (docs/DESIGN.md §13): K
+        consecutive NaN steps raise at HARVEST, at most dispatch_depth
+        steps after the K-th bad step was dispatched — bounded here by
+        counting how many batches the loop consumed."""
+        from tpu_ddp.resilience.guard import TrainingDivergedError
+        depth, max_bad = 2, 2
+        trainer = tiny_trainer(dispatch_depth=depth,
+                               guard_max_bad_steps=max_bad,
+                               timing_first_iter=1, timing_last_iter=0)
+        make_gen, consumed = nan_after(12, bad_from=1)
+        with pytest.raises(TrainingDivergedError):
+            trainer.train_epoch(trainer.init_state(), make_gen(),
+                                log=lambda s: None)
+        # 1 clean + max_bad to trip the guard + at most `depth` extra
+        # dispatches before the tripping step is harvested (+1 for the
+        # batch pulled in the same iteration the raise surfaces).
+        assert consumed[0] <= 1 + max_bad + depth + 1, consumed[0]
+
+    def test_at_most_one_forced_sync_per_depth_steps(self, monkeypatch):
+        """Regression for the whole point of the pipeline: the streaming
+        loop may force at most one device sync per ``depth`` steps
+        (plus the timing-window iteration and the end-of-epoch drain).
+        The synchronous loop pays one PER STEP."""
+        depth, iters = 2, 9
+        trainer = tiny_trainer(dispatch_depth=depth, timing_first_iter=1,
+                               timing_last_iter=0)
+        state = trainer.init_state()
+        calls = {0: 0}
+        real = jax.block_until_ready
+
+        def counting(x):
+            calls[0] += 1
+            return real(x)
+
+        monkeypatch.setattr(jax, "block_until_ready", counting)
+        trainer.train_epoch(state, small_batches(iters),
+                            log=lambda s: None)
+        # 1 sync timing iteration (iter 0) + <= (iters-1)/depth forced
+        # drains + 1 final drain; opportunistic polling only reduces it.
+        assert calls[0] <= 1 + (iters - 1) // depth + 1, calls[0]
+        assert calls[0] < iters
+
+    def test_pipeline_stats_and_host_gap_gauge(self):
+        trainer = tiny_trainer(dispatch_depth=2, timing_first_iter=1,
+                               timing_last_iter=0)
+        _, stats = trainer.train_epoch(trainer.init_state(),
+                                       small_batches(6),
+                                       log=lambda s: None)
+        assert stats["dispatch_depth"] == 2
+        assert stats["harvested"] == 6
+        assert stats["forced_syncs"] >= 1  # timing iter 0 at least
+        assert stats["host_gap_ms"] >= 0.0
+        g = trainer.metrics.gauge_summary("host_gap_ms")
+        assert g is not None and g["count"] == 1
+        assert g["last"] == stats["host_gap_ms"]
+
+    def test_chaos_env_forces_synchronous_window(self, monkeypatch):
+        """Active chaos must run depth 0 regardless of config: faults
+        land on exact steps and divergence surfaces immediately."""
+        monkeypatch.setenv("TPU_DDP_CHAOS_FAULTS", "slow-rank@2")
+        monkeypatch.setenv("TPU_DDP_CHAOS_SLOW_S", "0.001")
+        trainer = tiny_trainer(dispatch_depth=4, timing_first_iter=1,
+                               timing_last_iter=0)
+        _, stats = trainer.train_epoch(trainer.init_state(),
+                                       small_batches(4),
+                                       log=lambda s: None)
+        assert stats["dispatch_depth"] == 0
+        assert stats["forced_syncs"] == stats["harvested"] == 4

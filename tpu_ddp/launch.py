@@ -378,11 +378,23 @@ def main(argv=None) -> int:
     p.add_argument("--heartbeat-timeout", type=float, default=None,
                    help="kill + restart a cluster whose ranks all stop "
                         "completing steps for N seconds (stall watchdog)")
+    p.add_argument("--dispatch-depth", type=int, default=None,
+                   help="train steps kept in flight per worker before a "
+                        "forced host sync (async dispatch pipeline, "
+                        "tpu_ddp/train/pipeline.py); 0 = synchronous "
+                        "loop. Sets TPU_DDP_DISPATCH_DEPTH for every "
+                        "rank (default: the workers' config default)")
     args, extra = p.parse_known_args(argv)
+    env = None
+    if args.dispatch_depth is not None:
+        if args.dispatch_depth < 0:
+            p.error(f"--dispatch-depth must be >= 0, "
+                    f"got {args.dispatch_depth}")
+        env = {"TPU_DDP_DISPATCH_DEPTH": str(args.dispatch_depth)}
     try:
         res = launch_elastic(args.part, args.nproc,
                              max_restarts=args.max_restarts,
-                             extra_args=extra,
+                             extra_args=extra, env=env,
                              min_restart_interval=args.min_restart_interval,
                              restart_window=args.restart_window,
                              heartbeat_timeout=args.heartbeat_timeout,

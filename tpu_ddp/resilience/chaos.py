@@ -156,6 +156,15 @@ class FaultInjector:
     def active(self) -> bool:
         return bool(self.specs)
 
+    @property
+    def poisons_batches(self) -> bool:
+        """True iff some configured fault must mutate a batch HOST-SIDE
+        before its transfer (``nan-grad``). Only those faults force the
+        engine to disable device prefetch; passive injectors (slow-rank,
+        hard-exit, corrupt-ckpt, stalled-step) sleep or act post-step
+        and compose with prefetched transfers."""
+        return any(s.kind == "nan-grad" for s in self.specs)
+
     # ---- firing logic --------------------------------------------------
 
     def rank(self) -> int:

@@ -71,10 +71,22 @@ def select_update(bad, old_tree, new_tree):
 class StepGuard:
     """Host-side skip accounting for one training run.
 
-    ``record`` is called once per completed step with that step's
-    ``skipped`` flag (read back with the loss — no extra device sync).
-    ``max_bad_steps`` consecutive skips raise
-    :class:`TrainingDivergedError`; any clean step resets the streak.
+    ``record`` is called once per HARVESTED step with that step's
+    ``skipped`` flag (read from the step's fused [loss, skipped] device
+    bundle — no extra device sync). ``max_bad_steps`` consecutive skips
+    raise :class:`TrainingDivergedError`; any clean step resets the
+    streak.
+
+    Delayed-divergence contract (docs/DESIGN.md §13): under the async
+    dispatch pipeline (``cfg.dispatch_depth > 0``) steps are recorded at
+    harvest time, so the raise can happen up to ``dispatch_depth`` steps
+    after the diverging step was dispatched — never later, because the
+    pipeline force-drains whenever that many results are outstanding.
+    Recording order still matches step order exactly (the pipeline
+    delivers FIFO; tested in tests/test_dispatch_pipeline.py). A step
+    BELOW the last recorded one means a new run on a reused trainer
+    (fresh state, or a rollback to an earlier checkpoint) — the streak
+    resets rather than carrying a stale count across runs.
     """
 
     def __init__(self, max_bad_steps: int = 3, metrics=None,
@@ -87,8 +99,14 @@ class StepGuard:
         self.log = log
         self.consecutive = 0
         self.total_skipped = 0
+        self.last_step: int | None = None
 
     def record(self, step: int, skipped: bool, loss: float) -> None:
+        if self.last_step is not None and step < self.last_step:
+            # Step regression = a new run (reused trainer) or a
+            # rollback; a skip streak must never survive either.
+            self.consecutive = 0
+        self.last_step = step
         if not skipped:
             self.consecutive = 0
             return

@@ -8,8 +8,15 @@ timeout, which for a long run is hours. The reference had exactly this
 failure mode (a dead gloo rank hangs the cluster, SURVEY.md §5).
 
 Mechanism, deliberately boring: every worker touches a per-rank file
-(``TPU_DDP_HEARTBEAT_DIR/hb_rank{R}``) once per completed step — the
-engine does this in ``train_epoch``. The launcher polls the directory;
+(``TPU_DDP_HEARTBEAT_DIR/hb_rank{R}``) once per HARVESTED step — the
+engine does this in ``train_epoch`` as each step's result is delivered
+by the async dispatch pipeline (train/pipeline.py). Under
+``cfg.dispatch_depth > 0`` the stamped step can therefore trail the
+last DISPATCHED step by up to ``dispatch_depth``; the beat cadence is
+unaffected (the pipeline force-drains whenever ``dispatch_depth``
+results are outstanding, so a healthy loop beats at least once per
+``dispatch_depth`` steps — far inside any sane stall deadline, and the
+watchdog only reads mtimes anyway). The launcher polls the directory;
 when the NEWEST heartbeat across all ranks is older than the deadline,
 the whole cluster is declared stalled, killed, and (under
 ``launch_elastic``) restarted with backoff. Files-and-mtimes survive any

@@ -28,6 +28,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_ddp.data.prefetch import prefetch_to_device
+from tpu_ddp.train.pipeline import DispatchPipeline
 from tpu_ddp.ops.loss import cross_entropy_loss, softmax_cross_entropy
 from tpu_ddp.ops.metrics import top1_correct
 from tpu_ddp.ops.optim import SGD
@@ -85,23 +86,31 @@ class _LossWindow:
         if it == cfg.timing_last_iter:
             self._log(self._timer.report(prefix=f"[epoch {self._epoch}] "))
 
-    def epoch_stats(self) -> dict:
+    def epoch_stats(self, pipeline: dict | None = None) -> dict:
         timer = self._timer
         # timed_iters makes a steps_per_dispatch K that swallows most of
         # the timing window VISIBLE in the metrics stream (a K-group
         # that starts before timer.first_iter is deliberately untimed —
         # keeping compile out of the window — so the average may rest on
-        # few samples; round-2 advisor finding).
+        # few samples; round-2 advisor finding). ``pipeline`` carries
+        # the dispatch window's stall accounting (train/pipeline.py)
+        # into the same epoch record.
+        pipeline = pipeline or {}
+        if "host_gap_ms" in pipeline:
+            self._metrics.observe("host_gap_ms",
+                                  pipeline["host_gap_ms"])
         self._metrics.log("epoch", epoch=self._epoch, iters=self.iters,
                           avg_iter_s=timer.average_s,
                           timed_iters=timer.count,
-                          last_loss=round(self.last_loss, 5))
+                          last_loss=round(self.last_loss, 5),
+                          **pipeline)
         return {
             "avg_iter_ns": timer.average_ns,
             "avg_iter_s": timer.average_s,
             "timed_iters": timer.count,
             "last_loss": self.last_loss,
             "iters": self.iters,
+            **pipeline,
         }
 
 
@@ -465,8 +474,20 @@ class Trainer:
         return params, opt_state, loss, skipped
 
     def _build_train_step(self) -> Callable:
+        # The step returns (params, opt_state, loss, fused) where
+        # ``fused`` stacks [loss, skipped] into ONE small f32 array —
+        # so harvesting a step's scalars costs a single device fetch
+        # (the pre-round-6 loop fetched loss and the skip flag
+        # separately, two round-trips per iteration). ``loss`` keeps
+        # its public per-replica shape for train_step's callers.
         if self.mesh is None:
-            return jax.jit(self._base_step, donate_argnums=(0, 1))
+            def base(params, opt_state, images, labels, weights):
+                params, opt_state, loss, skipped = self._base_step(
+                    params, opt_state, images, labels, weights)
+                fused = jnp.stack([loss.astype(jnp.float32), skipped])
+                return params, opt_state, loss, fused
+
+            return jax.jit(base, donate_argnums=(0, 1))
 
         def sharded_body(params, opt_state, images, labels, weights):
             params, opt_state, loss, skipped = self._base_step(
@@ -474,9 +495,12 @@ class Trainer:
             # Per-replica scalar -> (1,) so out_spec P(dp) stacks to (dp,):
             # each node keeps printing ITS shard's running loss, as in the
             # reference (every node prints locally, part2b/main.py:134-139).
-            # The guard flag travels the same way (replicas agree by
+            # The fused [loss, skipped] pair travels the same way as a
+            # (1, 2) row -> global (dp, 2) (replicas agree on the flag by
             # construction except under strategy 'none').
-            return params, opt_state, loss.reshape(1), skipped.reshape(1)
+            fused = jnp.stack([loss.astype(jnp.float32),
+                               skipped]).reshape(1, 2)
+            return params, opt_state, loss.reshape(1), fused
 
         opt_spec = self._opt_spec()
         param_spec = self._param_spec()
@@ -517,32 +541,46 @@ class Trainer:
                 step, (params, opt_state), (xs, ys, ws))
             return params, opt_state, losses, skips
 
+        # As in _build_train_step, the per-step [loss, skipped] pairs are
+        # fused into ONE device array — (k, 2) without a mesh, global
+        # (k, dp, 2) with one — so harvesting a whole K-group costs a
+        # single fetch.
         if self.mesh is None:
-            fn = jax.jit(scan_body, donate_argnums=(0, 1))
+            def body(params, opt_state, xs, ys, ws):
+                params, opt_state, losses, skips = scan_body(
+                    params, opt_state, xs, ys, ws)
+                fused = jnp.stack([losses.astype(jnp.float32), skips],
+                                  axis=-1)
+                return params, opt_state, losses, fused
+
+            fn = jax.jit(body, donate_argnums=(0, 1))
         else:
             def sharded_body(params, opt_state, xs, ys, ws):
                 params, opt_state, losses, skips = scan_body(
                     params, opt_state, xs, ys, ws)
-                return (params, opt_state, losses.reshape(k, 1),
-                        skips.reshape(k, 1))
+                fused = jnp.stack(
+                    [losses.astype(jnp.float32).reshape(k, 1),
+                     skips.reshape(k, 1)], axis=-1)  # (k, 1, 2)
+                return (params, opt_state, losses.reshape(k, 1), fused)
 
             b = P(None, DATA_AXIS)
             mapped = jax.shard_map(
                 sharded_body, mesh=self.mesh,
                 in_specs=(self._param_spec(), self._opt_spec(), b, b, b),
-                out_specs=(self._param_spec(), self._opt_spec(), b, b),
+                out_specs=(self._param_spec(), self._opt_spec(), b,
+                           P(None, DATA_AXIS)),
                 check_vma=False)
             fn = jax.jit(mapped, donate_argnums=(0, 1))
 
         def run(state: TrainState, xs, ys, ws=None):
             if ws is None:
                 ws = jnp.ones(xs.shape[:2], jnp.float32)
-            params, opt_state, losses, skips = fn(
+            params, opt_state, losses, fused = fn(
                 state.params, state.opt_state, xs, ys, ws)
-            # Guard flags ride on the side (run keeps its public
-            # (state, losses) shape); the epoch loop reads them for
-            # host-side skip accounting.
-            self._last_skipped = skips
+            # The fused bundle rides on the side (run keeps its public
+            # (state, losses) shape); the epoch loop harvests it for
+            # loss/skip accounting with one fetch.
+            self._last_fused = fused
             return TrainState(params, opt_state, state.step + k), losses
 
         return run
@@ -574,6 +612,20 @@ class Trainer:
         return (put_sharded(images_k, sh), put_sharded(labels_k, sh),
                 put_sharded(weights_k, sh))
 
+    def _dispatch_step(self, state: TrainState, images, labels, weights):
+        """Dispatch one jitted step; returns ``(state, loss, fused)``
+        without any host synchronization — everything is a device-array
+        future. ``fused`` is the ONE-fetch [loss, skipped] bundle
+        (see _build_train_step)."""
+        if weights is None:
+            weights = jnp.ones((images.shape[0],), jnp.float32)
+        params, opt_state, loss, fused = self._train_step(
+            state.params, state.opt_state, images, labels, weights)
+        # Stashed for last_step_skipped (the public train_step keeps
+        # its (state, loss) shape).
+        self._last_fused = fused
+        return TrainState(params, opt_state, state.step + 1), loss, fused
+
     def train_step(self, state: TrainState, images, labels,
                    weights=None) -> tuple:
         """One optimization step; returns (state, loss).
@@ -582,28 +634,41 @@ class Trainer:
         dp slot); without, a scalar. ``weights`` defaults to all-ones (use
         :meth:`put_batch`, which builds and shards them).
         """
-        if weights is None:
-            weights = jnp.ones((images.shape[0],), jnp.float32)
-        params, opt_state, loss, skipped = self._train_step(
-            state.params, state.opt_state, images, labels, weights)
-        # Stashed, not returned: train_step keeps its public (state,
-        # loss) shape. Read via last_step_skipped (or the epoch loop's
-        # guard accounting) after forcing the loss.
-        self._last_skipped = skipped
-        return TrainState(params, opt_state, state.step + 1), loss
+        state, loss, _ = self._dispatch_step(state, images, labels,
+                                             weights)
+        return state, loss
 
-    def _local_scalar(self, arr) -> float:
-        """Host float from THIS process's first addressable shard (the
-        same read pattern the loss uses; a whole-array np.asarray is
-        impossible in multi-process)."""
+    def train_step_async(self, state: TrainState, images, labels,
+                         weights=None) -> tuple:
+        """Like :meth:`train_step` but returns ``(state, fused)`` where
+        ``fused`` is the step's [loss, skipped] device bundle — the
+        handle the async epoch loop pushes onto its
+        :class:`~tpu_ddp.train.pipeline.DispatchPipeline` and harvests
+        with ONE device fetch (:meth:`_materialize_fused`)."""
+        state, _, fused = self._dispatch_step(state, images, labels,
+                                              weights)
+        return state, fused
+
+    def _materialize_fused(self, fused) -> tuple[float, bool]:
+        """(local_loss, skipped) from a single-step fused bundle — ONE
+        host fetch. With a mesh the global array is (dp, 2); this
+        process's first addressable row is [its shard's loss, the
+        psum-agreed skip flag] — the same local-shard read pattern the
+        old loop used for the loss alone."""
         if self.mesh is not None:
-            return float(np.ravel(arr.addressable_shards[0].data)[0])
-        return float(arr)
+            row = np.ravel(np.asarray(fused.addressable_shards[0].data))
+        else:
+            row = np.ravel(np.asarray(fused))
+        return float(row[0]), bool(row[1] > 0)
 
     def last_step_skipped(self) -> bool:
         """True iff the most recent train_step's update was skipped by
-        the non-finite guard (resilience/guard.py)."""
-        arr = getattr(self, "_last_skipped", None)
+        the non-finite guard (resilience/guard.py). Reads the fused
+        [loss, skipped] bundle — ``skipped`` is the LAST element of the
+        flattened local view for every bundle shape: (2,) single-step
+        without a mesh, local (local_dp, 2) with one, (k, local_dp, 2)
+        for a K-group (where the last row is the group's final step)."""
+        arr = getattr(self, "_last_fused", None)
         if arr is None:
             return False
         flat = np.ravel(np.asarray(
@@ -701,57 +766,52 @@ class Trainer:
         # in flight when the step runs (tpu_ddp/data/prefetch.py); the
         # timer still brackets the same loop body as the reference
         # (part1/main.py:65-66 starts its clock after the batch fetch).
-        # Active chaos disables prefetch: batch poisoning must happen
-        # host-side on an exact step, before the transfer.
-        use_prefetch = cfg.device_prefetch > 0 and not injector.active
+        # Prefetch is disabled only for faults that must poison a batch
+        # HOST-SIDE on an exact step, before its transfer (nan-grad);
+        # passive injectors (slow-rank, hard-exit, ...) compose with it.
+        use_prefetch = (cfg.device_prefetch > 0
+                        and not injector.poisons_batches)
         stream = prefetch_to_device(batches, self.put_batch,
                                     cfg.device_prefetch) \
             if use_prefetch else batches
-        for it, item in enumerate(stream, start=start_iter):
-            if cfg.max_iters is not None and it >= cfg.max_iters:
-                break
-            if injector.active:
-                # Pre-step faults for the step producing state.step + 1:
-                # nan-grad poisons THIS rank's shard of the batch (sync
-                # spreads the NaNs; the guard then skips on all ranks),
-                # stalled-step/slow-rank sleep here.
-                if injector.before_step(state.step + 1):
-                    item = (FaultInjector.poison_images(item[0]),) \
-                        + tuple(item[1:])
-            timer.start()
-            x, y, w = item if use_prefetch else self.put_batch(*item)
-            state, loss = self.train_step(state, x, y, w)
-            # Force completion before stopping the clock — the JAX-correct
-            # analogue of the reference's synchronous CPU timing
-            # (part1/main.py:86-91).
-            loss = jax.block_until_ready(loss)
-            timer.stop(it)
-            if self.mesh is not None:
-                # THIS node's shard loss (first dp slot owned by this
-                # process), matching the reference where every node prints
-                # its local running loss (part2b/main.py:134-139).
-                local_loss = float(
-                    np.ravel(loss.addressable_shards[0].data)[0])
-            else:
-                local_loss = float(loss)
-            window.account(it, local_loss, state.step)
+        # Async dispatch window (train/pipeline.py): up to cfg.
+        # dispatch_depth steps stay in flight; losses, guard flags,
+        # heartbeats and the checkpoint/replica cadences are all driven
+        # from HARVESTED (in-order) results via on_harvest below — no
+        # aux subsystem forces a device sync. Active chaos forces the
+        # synchronous window: faults must land on exact steps, and a
+        # poisoned step's divergence must surface before the next
+        # dispatch (docs/DESIGN.md §13).
+        depth = 0 if chaos_env_active() else cfg.dispatch_depth
+        pipe = DispatchPipeline(depth)
+
+        def on_harvest(harv_it, harv_step, result):
+            local_loss, skipped = result
+            window.account(harv_it, local_loss, harv_step)
             if self.guard is not None:
                 # Raises TrainingDivergedError after K consecutive skips
                 # — BEFORE the checkpoint cadence below, so the last
-                # checkpoint on disk predates the divergence.
-                self.guard.record(
-                    state.step,
-                    self._local_scalar(self._last_skipped) > 0,
-                    local_loss)
+                # checkpoint on disk predates the divergence being
+                # acted on. Under async dispatch the raise happens at
+                # HARVEST, i.e. at most `depth` steps after the bad
+                # step ran (the delayed-divergence contract).
+                self.guard.record(harv_step, skipped, local_loss)
             if heartbeat is not None:
-                touch_heartbeat(heartbeat[0], heartbeat[1], state.step)
+                # The beat carries the last HARVESTED step: a healthy
+                # async window still beats at least once per `depth`
+                # steps, far inside any stall deadline.
+                touch_heartbeat(heartbeat[0], heartbeat[1], harv_step)
             # Aux subsystems (no reference equivalent — SURVEY.md §5):
             # mid-epoch checkpoints, replica-invariant check, fault hook.
+            # Cadences test the harvested step; the state they act on is
+            # the CURRENT one (up to `depth` steps ahead — safe: a
+            # skipped step is an exact no-op on the state, and the
+            # checkpoint is stamped with its own step).
             if (ckpt_dir and cfg.ckpt_every_iters
-                    and state.step % cfg.ckpt_every_iters == 0):
+                    and harv_step % cfg.ckpt_every_iters == 0):
                 self.save_checkpoint(ckpt_dir, state)
             if (cfg.check_replicas_every and self.mesh is not None
-                    and state.step % cfg.check_replicas_every == 0):
+                    and harv_step % cfg.check_replicas_every == 0):
                 if self.is_fsdp:
                     # FSDP has NO replicated parameter leaves — there is
                     # no redundancy to cross-check, and silently passing
@@ -767,9 +827,42 @@ class Trainer:
                     check_replica_consistency(state.params)
             # Post-step faults: hard-exit / corrupt-ckpt (and the legacy
             # TPU_DDP_FAIL_AT_STEP knob) fire AFTER the step's save, so
-            # a crash-step checkpoint is always on disk.
-            injector.after_step(state.step, ckpt_dir)
-        return state, window.epoch_stats()
+            # a crash-step checkpoint is always on disk. (Chaos always
+            # runs at depth 0, so harv_step is the just-completed step.)
+            injector.after_step(harv_step, ckpt_dir)
+
+        for it, item in enumerate(stream, start=start_iter):
+            if cfg.max_iters is not None and it >= cfg.max_iters:
+                break
+            if injector.active:
+                # Pre-step faults for the step producing state.step + 1:
+                # nan-grad poisons THIS rank's shard of the batch (sync
+                # spreads the NaNs; the guard then skips on all ranks),
+                # stalled-step/slow-rank sleep here.
+                if injector.before_step(state.step + 1):
+                    item = (FaultInjector.poison_images(item[0]),) \
+                        + tuple(item[1:])
+            # The reference's timing protocol is per-iteration
+            # synchronous (clock stops after block_until_ready,
+            # part1/main.py:86-91); iterations inside the timing window
+            # therefore dispatch-and-wait even at depth > 0.
+            sync_iter = depth == 0 or it <= cfg.timing_last_iter
+            timer.start()
+            x, y, w = item if use_prefetch else self.put_batch(*item)
+            state, fused = self.train_step_async(state, x, y, w)
+            if sync_iter:
+                # Force completion before stopping the clock — the
+                # JAX-correct analogue of the reference's synchronous
+                # CPU timing.
+                jax.block_until_ready(fused)
+            timer.stop(it)
+            pipe.submit(
+                fused,
+                lambda f, i=it, s=state.step: on_harvest(
+                    i, s, self._materialize_fused(f)),
+                sync=sync_iter)
+        pipe.drain()
+        return state, window.epoch_stats(pipeline=pipe.stats())
 
     def _train_epoch_multi(self, state, batches, timer, window,
                            start_iter, heartbeat=None):
@@ -777,10 +870,17 @@ class Trainer:
 
         Groups of K same-shape, slot-divisible host batches run through
         :meth:`build_multi_step`'s scanned call (bit-equal to K single
-        steps — tested); ragged tails fall back to :meth:`train_step`.
+        steps — tested); ragged tails fall back to the per-step path.
         Loss-print cadence and the iteration-window timer keep the
         reference's semantics via the shared ``_LossWindow`` (per-
-        dispatch time attributed evenly to its K iterations)."""
+        dispatch time attributed evenly to its K iterations).
+
+        The async dispatch window composes: up to ``cfg.dispatch_depth
+        // K`` GROUPS stay in flight (each group is K steps, so the
+        harvest lag stays ≤ dispatch_depth steps; a depth below K means
+        synchronous dispatch). Each group's losses + skip flags arrive
+        as ONE fused (K, [dp,] 2) device array — a single fetch per
+        dispatch."""
         from tpu_ddp.resilience.watchdog import touch_heartbeat
         cfg = self.config
         K = cfg.steps_per_dispatch
@@ -788,15 +888,39 @@ class Trainer:
         n_slots = (self.mesh.shape[DATA_AXIS] if self.mesh is not None
                    else 1)
         local_slots = max(n_slots // max(jax.process_count(), 1), 1)
+        depth_groups = cfg.dispatch_depth // K
+        pipe = DispatchPipeline(depth_groups)
 
-        def local_of(loss):
-            if self.mesh is not None:
-                return float(np.ravel(loss.addressable_shards[0].data)[0])
-            return float(loss)
-
-        def beat():
+        def beat(step):
             if heartbeat is not None:
-                touch_heartbeat(heartbeat[0], heartbeat[1], state.step)
+                touch_heartbeat(heartbeat[0], heartbeat[1], step)
+
+        def harvest_single(harv_it, harv_step, result):
+            local, skipped = result
+            window.account(harv_it, local, harv_step)
+            if self.guard is not None:
+                self.guard.record(harv_step, skipped, local)
+            beat(harv_step)
+
+        def materialize_group(fused):
+            """(K, 2) host rows of [loss, skip] — this process's first
+            replica under a mesh ((k, local_dp, 2) local shard)."""
+            if self.mesh is not None:
+                return np.asarray(
+                    fused.addressable_shards[0].data)[:, 0, :]
+            return np.asarray(fused)
+
+        def harvest_group(first_it, last_step, rows):
+            for j in range(K):
+                # The group's state advanced by K; attribute each
+                # iteration its own global step.
+                window.account(first_it + j, float(rows[j, 0]),
+                               last_step - K + j + 1)
+                if self.guard is not None:
+                    self.guard.record(last_step - K + j + 1,
+                                      bool(rows[j, 1] > 0),
+                                      float(rows[j, 0]))
+            beat(last_step)
 
         it = start_iter
         buf: list = []
@@ -804,19 +928,18 @@ class Trainer:
         def flush_singles():
             nonlocal state, it
             for bx, by in buf:
+                sync_iter = depth_groups == 0 or it <= timer.last_iter
                 timer.start()
-                state, loss = self.train_step(state,
-                                              *self.put_batch(bx, by))
-                loss = jax.block_until_ready(loss)
+                state, fused = self.train_step_async(
+                    state, *self.put_batch(bx, by))
+                if sync_iter:
+                    jax.block_until_ready(fused)
                 timer.stop(it)
-                local = local_of(loss)
-                window.account(it, local, state.step)
-                if self.guard is not None:
-                    self.guard.record(
-                        state.step,
-                        self._local_scalar(self._last_skipped) > 0,
-                        local)
-                beat()
+                pipe.submit(
+                    fused,
+                    lambda f, i=it, s=state.step: harvest_single(
+                        i, s, self._materialize_fused(f)),
+                    sync=sync_iter)
                 it += 1
             buf.clear()
 
@@ -833,45 +956,32 @@ class Trainer:
                 # compile; spreading it over its K iterations would leak
                 # warm-up into the window the reference's protocol
                 # excludes (iteration 0 discarded, part1/main.py:86-91).
+                # Groups inside the timing window stay synchronous, as
+                # in the streaming loop.
                 timed = it >= timer.first_iter
+                sync_group = depth_groups == 0 or it <= timer.last_iter
                 if timed:
                     timer.start()
                 xs = np.stack([b[0] for b in buf])
                 ys = np.stack([b[1] for b in buf])
-                state, losses = multi(state, *self.put_batches(xs, ys))
-                losses = jax.block_until_ready(losses)
+                state, _ = multi(state, *self.put_batches(xs, ys))
+                fused = self._last_fused
+                if sync_group:
+                    jax.block_until_ready(fused)
                 if timed:
                     timer.stop_many(it, K)
-                if self.mesh is not None:
-                    per_step = np.asarray(
-                        losses.addressable_shards[0].data).reshape(K, -1)
-                    per_step = per_step[:, 0]
-                else:
-                    per_step = np.ravel(np.asarray(losses))
-                skips = getattr(self, "_last_skipped", None)
-                if skips is not None:
-                    if self.mesh is not None:
-                        skips = np.asarray(
-                            skips.addressable_shards[0].data
-                        ).reshape(K, -1)[:, 0]
-                    else:
-                        skips = np.ravel(np.asarray(skips))
-                for j in range(K):
-                    # state.step already advanced by K; attribute each
-                    # iteration its own global step.
-                    window.account(it, float(per_step[j]),
-                                   state.step - K + j + 1)
-                    if self.guard is not None and skips is not None:
-                        self.guard.record(state.step - K + j + 1,
-                                          bool(skips[j] > 0),
-                                          float(per_step[j]))
-                    it += 1
-                beat()
+                pipe.submit(
+                    fused,
+                    lambda f, i=it, s=state.step: harvest_group(
+                        i, s, materialize_group(f)),
+                    sync=sync_group)
+                it += K
                 buf.clear()
             else:
                 flush_singles()  # non-uniform group: step them singly
         flush_singles()  # tail shorter than K
-        return state, window.epoch_stats()
+        pipe.drain()
+        return state, window.epoch_stats(pipeline=pipe.stats())
 
     # ---- eval (reference test_model, part1/main.py:96-111) -------------
 
@@ -952,6 +1062,48 @@ class Trainer:
         if use_sharded and not hasattr(self, "_sharded_eval"):
             self._sharded_eval = self._build_sharded_eval()
         eval_params = self._materialize_params(state.params)
+
+        def first_local(x):
+            # Outputs are dp-sharded global arrays whose shards all
+            # hold the same psum'd value; read the LOCAL shard (a
+            # whole-array np.asarray is impossible in multi-process,
+            # where some shards live on other processes).
+            return float(np.ravel(x.addressable_shards[0].data)[0])
+
+        # Deferred materialization (round 6, same discipline as the
+        # train pipeline): with dispatch_depth > 0 the per-batch scalar
+        # fetches are queued and resolved behind a bounded window, so
+        # eval batches dispatch back-to-back instead of paying one host
+        # round-trip each. The accumulated metrics are identical — only
+        # when the fetch happens moves. dispatch_depth=0 keeps the
+        # synchronous per-batch reads.
+        lazy = self.config.dispatch_depth > 0
+        pending: list = []
+        max_pending = max(8, 4 * self.config.dispatch_depth)
+
+        def resolve(rec):
+            nonlocal total_loss, correct, seen, n_batches
+            if rec[0] == "sharded":
+                _, loss_sum, corr_h, wsum = rec
+                n = first_local(wsum)
+                total_loss += first_local(loss_sum) / max(n, 1.0)
+                correct += int(round(first_local(corr_h)))
+                seen += int(round(n))
+            else:
+                _, loss_h, corr_h, n = rec
+                total_loss += float(loss_h)
+                correct += int(corr_h)
+                seen += n
+            n_batches += 1
+
+        def push(rec):
+            if not lazy:
+                resolve(rec)
+                return
+            pending.append(rec)
+            if len(pending) > max_pending:
+                resolve(pending.pop(0))
+
         for batch in batches:
             images, labels = batch[0], batch[1]
             batch_w = batch[2] if len(batch) > 2 else None
@@ -983,18 +1135,7 @@ class Trainer:
                 xb, yb, wb = self.put_batch(images, labels, batch_w)
                 loss_sum, corr, wsum = self._sharded_eval(eval_params,
                                                           xb, yb, wb)
-
-                # Outputs are dp-sharded global arrays whose shards all
-                # hold the same psum'd value; read the LOCAL shard (a
-                # whole-array np.asarray is impossible in multi-process,
-                # where some shards live on other processes).
-                def first_local(x):
-                    return float(np.ravel(x.addressable_shards[0].data)[0])
-                n = first_local(wsum)
-                total_loss += first_local(loss_sum) / max(n, 1.0)
-                correct += int(round(first_local(corr)))
-                seen += int(round(n))
-                n_batches += 1
+                push(("sharded", loss_sum, corr, wsum))
                 continue
             if self.mesh is not None:
                 images = jax.device_put(images, self._repl_sharding)
@@ -1002,10 +1143,9 @@ class Trainer:
             else:
                 images, labels = jnp.asarray(images), jnp.asarray(labels)
             loss, corr = self._eval_step(eval_params, images, labels)
-            total_loss += float(loss)
-            correct += int(corr)
-            seen += int(labels.shape[0])
-            n_batches += 1
+            push(("repl", loss, corr, int(labels.shape[0])))
+        for rec in pending:
+            resolve(rec)
         avg_loss = total_loss / max(n_batches, 1)
         accuracy = correct / max(seen, 1)
         log(f"Test set: average loss {avg_loss:.4f}, "

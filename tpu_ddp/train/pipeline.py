@@ -1,0 +1,200 @@
+"""Asynchronous step-dispatch pipeline — keep the device queue full.
+
+JAX dispatch is asynchronous: a jitted train step returns device-array
+futures immediately and the computation runs behind them. The naive loop
+(reference part1/main.py:65-84 and our pre-round-6 engine) throws that
+away by forcing every step's loss to host before dispatching the next —
+``block_until_ready`` + ``float(loss)`` once per iteration drains the
+device queue to empty, so dispatch, metrics, heartbeats and checkpoint
+bookkeeping all sit on the critical path. Over a tunneled backend each
+forced readback is a full link round-trip (~70 ms measured, bench.py
+docstring); even on-host it serializes Python bookkeeping with device
+compute.
+
+:class:`DispatchPipeline` is the engine-side fix: a bounded FIFO window
+of in-flight result handles. The loop dispatches up to ``depth`` steps
+back-to-back and only materializes a result when its handle is already
+ready (``jax.Array.is_ready`` — a non-blocking poll) or the window is
+full. When the window IS full, ONE ``jax.block_until_ready`` over the
+whole window drains it — so the loop pays at most one forced
+synchronization per ``depth`` steps (regression-tested by monkeypatching
+``jax.block_until_ready`` in tests/test_dispatch_pipeline.py).
+
+Delivery is strictly in submission order, so every consumer driven from
+harvested results (``_LossWindow.account``, ``StepGuard.record``,
+heartbeats, checkpoint cadence) observes the same sequence as the
+synchronous loop — just up to ``depth`` steps later. ``depth=0``
+degenerates to the synchronous semantics exactly: every submit delivers
+before returning (the chaos drills and the reference's timing protocol
+run this way; see docs/DESIGN.md §13 for the contract).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Callable
+
+import jax
+
+
+def _handle_ready(value) -> bool:
+    """Non-blocking readiness poll over a pytree of device arrays.
+
+    Leaves without ``is_ready`` (host numpy, python scalars) count as
+    ready. If ``jax.Array`` ever loses ``is_ready``, everything reports
+    not-ready and the pipeline still works — it just always waits for a
+    full window before the (single, batched) forced sync.
+    """
+    for leaf in jax.tree.leaves(value):
+        fn = getattr(leaf, "is_ready", None)
+        if fn is not None and not fn():
+            return False
+    return True
+
+
+class DispatchPipeline:
+    """Bounded in-order window of in-flight step results.
+
+    ``submit(value, on_ready)`` enqueues one dispatched step's result
+    handle together with the callback that materializes and accounts it.
+    Callbacks fire in submission order:
+
+    - opportunistically, whenever the oldest handle polls ready
+      (zero forced syncs — the common case once compute is the
+      bottleneck);
+    - in a batch, when a submit would leave more than ``depth``
+      undelivered handles: one ``jax.block_until_ready`` over the WHOLE
+      window, then every callback — ≤1 forced sync per ``depth`` steps;
+    - immediately, for ``submit(..., sync=True)`` (the timing window and
+      chaos-exact-step iterations) and for :meth:`drain` at epoch end.
+
+    Host-side stall accounting: ``host_gap_ms`` accumulates wall time
+    spent inside forced ``block_until_ready`` calls — the part of the
+    epoch where the host had nothing to do but wait on the device. The
+    synchronous loop's gap is the whole per-step device latency; deeper
+    windows shrink it toward zero (scripts/host_gap.py measures this).
+    """
+
+    def __init__(self, depth: int):
+        if depth < 0:
+            raise ValueError(f"dispatch depth must be >= 0, got {depth}")
+        self.depth = depth
+        self._queue: collections.deque = collections.deque()
+        # Stats (reported via _LossWindow.epoch_stats / bench extra).
+        self.forced_syncs = 0
+        self.host_gap_ms = 0.0
+        self.harvested = 0
+        self.max_in_flight = 0
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    def submit(self, value: Any, on_ready: Callable[[Any], None],
+               sync: bool = False) -> None:
+        """Enqueue one result handle; may deliver any number of queued
+        results (oldest first). ``sync=True`` delivers everything —
+        including ``value`` — before returning."""
+        self._queue.append((value, on_ready))
+        if len(self._queue) > self.max_in_flight:
+            self.max_in_flight = len(self._queue)
+        if sync:
+            self._force_drain()
+            return
+        self._poll_ready()
+        if len(self._queue) > self.depth:
+            self._force_drain()
+
+    def poll(self) -> None:
+        """Deliver any already-finished prefix of the window (no sync)."""
+        self._poll_ready()
+
+    def drain(self) -> None:
+        """Deliver everything still in flight (end of epoch)."""
+        if self._queue:
+            self._force_drain()
+
+    def stats(self) -> dict:
+        return {
+            "dispatch_depth": self.depth,
+            "forced_syncs": self.forced_syncs,
+            "host_gap_ms": round(self.host_gap_ms, 3),
+            "harvested": self.harvested,
+            "max_in_flight": self.max_in_flight,
+        }
+
+    # ---- internals -----------------------------------------------------
+
+    def _poll_ready(self) -> None:
+        while self._queue and _handle_ready(self._queue[0][0]):
+            self._pop_deliver()
+
+    def _force_drain(self) -> None:
+        self.forced_syncs += 1
+        t0 = time.perf_counter()
+        # ONE blocking call for the whole window: the per-call overhead
+        # (and, over a tunnel, the round-trip) is paid once, not per
+        # step. Delivery below then touches only ready arrays.
+        jax.block_until_ready([v for v, _ in self._queue])
+        self.host_gap_ms += (time.perf_counter() - t0) * 1e3
+        while self._queue:
+            self._pop_deliver()
+
+    def _pop_deliver(self) -> None:
+        value, on_ready = self._queue.popleft()
+        self.harvested += 1
+        # A raising callback (TrainingDivergedError) propagates to the
+        # epoch loop; later handles stay queued and are simply dropped
+        # with the trainer — their steps never happened as far as the
+        # harvested-results consumers are concerned.
+        on_ready(value)
+
+
+def depth_sweep(trainer, state, host_batches, depths,
+                reps: int = 1, epoch: int = 0) -> tuple[dict, Any]:
+    """Measure streaming-loop throughput and host-gap per dispatch depth.
+
+    Runs ``Trainer.train_epoch`` over ``host_batches`` (a list of
+    ``(images, labels)`` host tuples) once per depth in ``depths``
+    (``reps`` times, keeping the best wall time — CI hosts are noisy),
+    with the reference timing window disabled so every iteration past
+    the first is eligible for async dispatch. The jitted step is shared
+    across depths (depth is a host-loop property, not a compile-time
+    one), so the sweep measures dispatch discipline, nothing else.
+
+    Returns ``(results, state)`` where ``results[str(depth)]`` holds
+    ``steps_per_sec`` / ``host_gap_ms`` / ``forced_syncs`` / ``wall_s``.
+    Shared by scripts/host_gap.py and bench.py so the committed artifact
+    and the benchmark record the same protocol.
+    """
+    cfg = trainer.config
+    saved = (cfg.dispatch_depth, cfg.timing_first_iter,
+             cfg.timing_last_iter)
+    results: dict = {}
+    try:
+        # Only iteration 0 stays synchronous (warm-up barrier, the
+        # reference's discarded iteration 0).
+        cfg.timing_first_iter, cfg.timing_last_iter = 1, 0
+        for d in depths:
+            cfg.dispatch_depth = int(d)
+            best = None
+            for _ in range(max(1, reps)):
+                t0 = time.perf_counter()
+                state, stats = trainer.train_epoch(
+                    state, list(host_batches), epoch=epoch,
+                    log=lambda s: None)
+                wall = time.perf_counter() - t0
+                cell = {
+                    "steps_per_sec": round(stats["iters"] / wall, 3),
+                    "host_gap_ms": stats.get("host_gap_ms", 0.0),
+                    "forced_syncs": stats.get("forced_syncs", 0),
+                    "wall_s": round(wall, 4),
+                }
+                if best is None or cell["steps_per_sec"] > \
+                        best["steps_per_sec"]:
+                    best = cell
+            results[str(int(d))] = best
+    finally:
+        (cfg.dispatch_depth, cfg.timing_first_iter,
+         cfg.timing_last_iter) = saved
+    return results, state

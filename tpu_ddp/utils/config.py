@@ -75,6 +75,14 @@ class TrainConfig:
     # Ragged/tail batches and in-loop checkpoint/invariant cadences fall
     # back to the per-step path. Env: TPU_DDP_STEPS_PER_DISPATCH.
     steps_per_dispatch: int = 1
+    # Async dispatch window (tpu_ddp/train/pipeline.py): the epoch loop
+    # keeps up to this many train steps in flight and harvests results
+    # lazily — losses, guard flags, heartbeats and checkpoint cadences
+    # are driven from HARVESTED steps, so divergence can surface up to
+    # dispatch_depth steps late (docs/DESIGN.md §13). 0 = the reference's
+    # fully synchronous loop (forced automatically while chaos injection
+    # is active and inside the timing window). Env: TPU_DDP_DISPATCH_DEPTH.
+    dispatch_depth: int = 2
 
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
@@ -114,6 +122,13 @@ class TrainConfig:
         env_spd = os.environ.get("TPU_DDP_STEPS_PER_DISPATCH")
         if env_spd:
             self.steps_per_dispatch = int(env_spd)
+        env_dd = os.environ.get("TPU_DDP_DISPATCH_DEPTH")
+        if env_dd:
+            self.dispatch_depth = int(env_dd)
+        if self.dispatch_depth < 0:
+            raise ValueError(
+                f"dispatch_depth must be >= 0, got {self.dispatch_depth} "
+                "(0 = synchronous loop)")
         # f32 end-to-end runs turn the bf16-rounding drift story into a
         # measurement (run_experiments --dtype float32): bit-equivalent
         # programs must then agree to f32 reduction-order tolerance.

@@ -33,6 +33,10 @@ class MetricsLogger:
         # with no sink file, so code can ask "how many?" after a run
         # without parsing JSONL.
         self.counters: dict[str, int] = {}
+        # In-memory gauge accumulators (observe()): dispatch-pipeline
+        # stall time (host_gap_ms) and friends — count/total/max per
+        # name, queryable after a run without parsing JSONL.
+        self.gauges: dict[str, dict] = {}
         if path:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
@@ -47,6 +51,26 @@ class MetricsLogger:
         pair with :meth:`log` when the event itself matters."""
         self.counters[name] = self.counters.get(name, 0) + n
         return self.counters[name]
+
+    def observe(self, name: str, value: float) -> None:
+        """Accumulate one gauge sample in memory (no line written —
+        pair with :meth:`log` when the sample itself matters). Used by
+        the train engine for per-epoch ``host_gap_ms`` (time the host
+        spent stalled in forced device syncs, train/pipeline.py)."""
+        g = self.gauges.setdefault(
+            name, {"count": 0, "total": 0.0, "max": 0.0, "last": 0.0})
+        v = float(value)
+        g["count"] += 1
+        g["total"] += v
+        g["max"] = max(g["max"], v)
+        g["last"] = v
+
+    def gauge_summary(self, name: str) -> dict | None:
+        """count/total/max/last/mean for one observed gauge, or None."""
+        g = self.gauges.get(name)
+        if g is None:
+            return None
+        return {**g, "mean": g["total"] / max(g["count"], 1)}
 
     def log(self, event: str, **fields) -> None:
         if self._fh is None:
