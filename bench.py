@@ -325,9 +325,16 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
         try:
             probe, state = depth_sweep(trainer, state, host * 3,
                                        probe_depths, reps=1)
-            at_depth = probe[str(max(probe_depths))]
+            # The headline cell is the deepest depth probed, which is
+            # NOT cfg.dispatch_depth when the run is configured
+            # synchronous (depth 0 still probes {0, 2} so the record
+            # shows what the pipeline would buy) — probed_depth makes
+            # the attribution explicit.
+            probed = max(probe_depths)
+            at_depth = probe[str(probed)]
             dispatch_pipeline = {
                 "dispatch_depth": cfg.dispatch_depth,
+                "probed_depth": probed,
                 "host_gap_ms": at_depth["host_gap_ms"],
                 "host_gap_ms_sync": probe["0"]["host_gap_ms"],
                 "sweep": probe,

@@ -88,13 +88,33 @@ class TestDispatchPipelineUnit:
         assert s["host_gap_ms"] >= 0.0
 
     def test_sync_submit_flushes_backlog_and_itself(self):
+        """sync=True delivers the backlog and the new handle, but is
+        charged to sync_deliveries, NOT the async window's forced-sync
+        or host-gap accounting: the caller only uses that path after
+        blocking on the handle itself (the timing protocol), so the
+        drain is free."""
         pipe = DispatchPipeline(4)
         got = []
         pipe.submit(FakeHandle(ready=False), lambda v: got.append(0))
         pipe.submit(FakeHandle(ready=False), lambda v: got.append(1),
                     sync=True)
         assert got == [0, 1]
+        s = pipe.stats()
+        assert s["sync_deliveries"] == 1
+        assert s["forced_syncs"] == 0
+        assert s["host_gap_ms"] == 0.0
+
+    def test_sync_submit_at_depth_zero_counts_forced(self):
+        """Depth 0 is the synchronous baseline: even sync=True submits
+        (the engine's depth-0 path) keep the per-step forced-sync
+        accounting the depth sweep measures against."""
+        pipe = DispatchPipeline(0)
+        got = []
+        pipe.submit(FakeHandle(ready=False), lambda v: got.append(0),
+                    sync=True)
+        assert got == [0]
         assert pipe.stats()["forced_syncs"] == 1
+        assert pipe.stats()["sync_deliveries"] == 0
 
     def test_drain_empties_and_is_noop_when_empty(self):
         pipe = DispatchPipeline(4)
@@ -299,11 +319,43 @@ class TestAsyncEpoch:
                                        log=lambda s: None)
         assert stats["dispatch_depth"] == 2
         assert stats["harvested"] == 6
-        assert stats["forced_syncs"] >= 1  # timing iter 0 at least
+        # Timing iter 0 pre-blocks and lands in sync_deliveries;
+        # forced_syncs counts only window-caused drains (may be 0 when
+        # every handle polls ready before the window fills).
+        assert stats["sync_deliveries"] == 1
+        assert stats["forced_syncs"] >= 0
         assert stats["host_gap_ms"] >= 0.0
         g = trainer.metrics.gauge_summary("host_gap_ms")
         assert g is not None and g["count"] == 1
         assert g["last"] == stats["host_gap_ms"]
+
+    def test_multiprocess_cadence_forces_sync_window(self, monkeypatch,
+                                                     tmp_path):
+        """The in-loop checkpoint/replica cadences enqueue CROSS-HOST
+        collectives (state gather / digest allgather) from on_harvest,
+        and harvest timing is per-process — so a multi-process run with
+        such a cadence configured must fall back to the synchronous
+        window (depth 0) to keep collective order and the snapshotted
+        state step identical on every process (docs/DESIGN.md §13)."""
+        trainer = tiny_trainer(dispatch_depth=4, ckpt_every_iters=100,
+                               timing_first_iter=1, timing_last_iter=0)
+        state = trainer.init_state()
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        _, stats = trainer.train_epoch(state, small_batches(4),
+                                       ckpt_dir=str(tmp_path),
+                                       log=lambda s: None)
+        assert stats["dispatch_depth"] == 0
+        # Single process the same cadence keeps the async window — the
+        # ahead-of-harvest state is safe there (skipped steps are
+        # no-ops; checkpoints are stamped with their own step).
+        monkeypatch.setattr(jax, "process_count", lambda: 1)
+        trainer2 = tiny_trainer(dispatch_depth=4, ckpt_every_iters=100,
+                                timing_first_iter=1, timing_last_iter=0)
+        _, stats2 = trainer2.train_epoch(trainer2.init_state(),
+                                         small_batches(4),
+                                         ckpt_dir=str(tmp_path / "sp"),
+                                         log=lambda s: None)
+        assert stats2["dispatch_depth"] == 4
 
     def test_chaos_env_forces_synchronous_window(self, monkeypatch):
         """Active chaos must run depth 0 regardless of config: faults
@@ -316,4 +368,7 @@ class TestAsyncEpoch:
                                        small_batches(4),
                                        log=lambda s: None)
         assert stats["dispatch_depth"] == 0
+        # Depth 0 keeps the synchronous baseline's accounting: every
+        # delivery is a forced sync, none are booked as sync_deliveries.
         assert stats["forced_syncs"] == stats["harvested"] == 4
+        assert stats["sync_deliveries"] == 0

@@ -782,7 +782,26 @@ class Trainer:
         # synchronous window: faults must land on exact steps, and a
         # poisoned step's divergence must surface before the next
         # dispatch (docs/DESIGN.md §13).
-        depth = 0 if chaos_env_active() else cfg.dispatch_depth
+        #
+        # Multi-process runs force it too when an in-loop cadence bears
+        # cross-host collectives: save_checkpoint gathers sharded state
+        # (ZeRO/FSDP) and check_replica_consistency process_allgathers
+        # digests, and both snapshot the CURRENT `state`. Harvest timing
+        # is per-process (is_ready polling), so at depth > 0 process A
+        # could run a step-N cadence between dispatching steps N+1 and
+        # N+2 while process B runs it after N+2 — the collectives
+        # enqueue in different orders relative to the train steps'
+        # psums (deadlock risk) and contribute different-step states
+        # (mixed-version checkpoints, spurious ReplicaDivergenceError).
+        # Depth 0 pins every cadence to the same loop position with the
+        # same-step state on all processes — the same reasoning that
+        # routes these cadences off the grouped-K path above.
+        collective_cadence = bool(
+            (ckpt_dir and cfg.ckpt_every_iters)
+            or (cfg.check_replicas_every and self.mesh is not None))
+        depth = (0 if chaos_env_active()
+                 or (collective_cadence and jax.process_count() > 1)
+                 else cfg.dispatch_depth)
         pipe = DispatchPipeline(depth)
 
         def on_harvest(harv_it, harv_step, result):
@@ -804,9 +823,12 @@ class Trainer:
             # Aux subsystems (no reference equivalent — SURVEY.md §5):
             # mid-epoch checkpoints, replica-invariant check, fault hook.
             # Cadences test the harvested step; the state they act on is
-            # the CURRENT one (up to `depth` steps ahead — safe: a
-            # skipped step is an exact no-op on the state, and the
-            # checkpoint is stamped with its own step).
+            # the CURRENT one — up to `depth` steps ahead, which is safe
+            # SINGLE-process (a skipped step is an exact no-op on the
+            # state, and the checkpoint is stamped with its own step).
+            # Multi-process, these cadences are cross-host collectives,
+            # so the depth guard above already forced depth 0 and the
+            # state here is exactly harv_step's on every process.
             if (ckpt_dir and cfg.ckpt_every_iters
                     and harv_step % cfg.ckpt_every_iters == 0):
                 self.save_checkpoint(ckpt_dir, state)
@@ -845,7 +867,12 @@ class Trainer:
             # The reference's timing protocol is per-iteration
             # synchronous (clock stops after block_until_ready,
             # part1/main.py:86-91); iterations inside the timing window
-            # therefore dispatch-and-wait even at depth > 0.
+            # therefore dispatch-and-wait even at depth > 0 — and at
+            # depth > 0 the pipeline books their (pre-blocked, ~free)
+            # deliveries under sync_deliveries, not the async window's
+            # forced_syncs/host_gap_ms. Depth 0 submits sync throughout
+            # and keeps its per-step forced-sync accounting: that IS
+            # the synchronous baseline the depth sweep measures.
             sync_iter = depth == 0 or it <= cfg.timing_last_iter
             timer.start()
             x, y, w = item if use_prefetch else self.put_batch(*item)
