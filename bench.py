@@ -387,6 +387,10 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
             **({"chained_dispatch": chained} if promoted else chained),
             "end_to_end_iter_s": round(e2e.average_s, 6),
             "dispatch_depth": cfg.dispatch_depth,
+            # Active gradient wire format (parallel/compress.py) — the
+            # record must say which compressor produced its numbers,
+            # same contract as the dispatch_pipeline probe below.
+            "grad_compress": trainer.compressor.describe(),
             **({"dispatch_pipeline": dispatch_pipeline}
                if dispatch_pipeline else {}),
             "batch_size": batch_size,

@@ -11,17 +11,19 @@ ZeRO, FSDP's per-leaf gather/scatter pairs.
 
 For every rung of the ladder (part1..part5) the jitted train step is
 compiled for an 8-device virtual CPU mesh at the reference's global
-batch, the HLO is scanned for collective ops, and each op's payload
-size is recorded along with its ring-algorithm wire cost per device:
+batch, the HLO is scanned for collective ops (the scanner lives in
+``tpu_ddp/utils/hlo_comm.py``; this script re-exports it), and each
+op's payload size is recorded along with its ring-algorithm wire cost
+per device.
 
-- all-reduce:          2 * (N-1)/N * payload   (reduce-scatter + gather)
-- reduce-scatter:          (N-1)/N * input payload
-- all-gather:              (N-1)/N * output payload
-- all-to-all:              (N-1)/N * payload
-- collective-permute:                payload   (one neighbor hop)
+Each syncing rung is additionally compiled with the bf16 and int8
+gradient wire formats (``TrainConfig.grad_compress``,
+tpu_ddp/parallel/compress.py) and the compressed-vs-fp32 bytes/step
+ratio recorded — the dtype breakdown doubles as the HLO-level proof
+that the collective really executes at the reduced dtype.
 
 Writes ``experiments/comm_volume.json`` and prints a markdown table
-(pasted into EXPERIMENTS.md §5).
+(pasted into EXPERIMENTS.md §10).
 
 Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
            python scripts/comm_volume.py
@@ -31,77 +33,28 @@ from __future__ import annotations
 
 import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
-_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
-                "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4,
-                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+# Re-exported for tests/test_comm_volume.py, which pins the parser's
+# op/shape/byte accounting through THIS module's names.
+from tpu_ddp.utils.hlo_comm import (  # noqa: E402
+    COLLECTIVES as _COLLECTIVES,
+    DTYPE_BYTES as _DTYPE_BYTES,
+    collective_volume,
+    shape_bytes as _shape_bytes,
+)
 
-_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather",
-                "all-to-all", "collective-permute")
+__all__ = ["_COLLECTIVES", "_DTYPE_BYTES", "_shape_bytes",
+           "collective_volume", "main"]
 
-# One HLO instruction: "%name = <shape> op-name(...)" where <shape> is
-# "f32[a,b]{layout}" or a tuple "(f32[a]{0}, f32[b]{0})".
-_INSTR = re.compile(
-    r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^ ]*)\s+"
-    r"(" + "|".join(_COLLECTIVES) + r")(?:-start)?\(")
-
-_SHAPE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
-
-
-def _shape_bytes(shape_str: str) -> int:
-    total = 0
-    for dtype, dims in _SHAPE.findall(shape_str):
-        if dtype not in _DTYPE_BYTES:
-            continue  # e.g. token[] / opaque
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES[dtype]
-    return total
+COMPRESSORS = ("bf16", "int8")
 
 
-def collective_volume(hlo_text: str, n_devices: int) -> dict:
-    """Scan compiled HLO for collective ops; payload + ring wire bytes.
-
-    Uses each op's RESULT shape as the payload (for all-reduce and
-    collective-permute result == operand; for reduce-scatter the input
-    is result * N; for all-gather the result already is the gathered
-    size — the ring formulas below account for each case).
-    """
-    ops: dict = {k: {"count": 0, "payload_bytes": 0} for k in _COLLECTIVES}
-    for m in _INSTR.finditer(hlo_text):
-        shape_str, op = m.group(1), m.group(2)
-        b = _shape_bytes(shape_str)
-        ops[op]["count"] += 1
-        ops[op]["payload_bytes"] += b
-    frac = (n_devices - 1) / n_devices
-    wire = 0.0
-    for op, rec in ops.items():
-        if op == "all-reduce":
-            rec["wire_bytes_per_device"] = 2 * frac * rec["payload_bytes"]
-        elif op == "reduce-scatter":
-            # result is the 1/N shard; input payload = result * N.
-            rec["wire_bytes_per_device"] = (frac * rec["payload_bytes"]
-                                            * n_devices)
-        elif op == "all-gather":
-            rec["wire_bytes_per_device"] = frac * rec["payload_bytes"]
-        elif op == "all-to-all":
-            rec["wire_bytes_per_device"] = frac * rec["payload_bytes"]
-        else:  # collective-permute: one neighbor hop
-            rec["wire_bytes_per_device"] = float(rec["payload_bytes"])
-        wire += rec["wire_bytes_per_device"]
-    ops = {k: v for k, v in ops.items() if v["count"]}
-    return {"ops": ops, "total_wire_bytes_per_device": wire,
-            "total_collectives": sum(v["count"] for v in ops.values())}
-
-
-def _rung_hlo(strategy: str, n_devices: int) -> tuple[str, int]:
+def _rung_hlo(strategy: str, n_devices: int,
+              grad_compress: str = "none") -> tuple[str, int]:
     """Compile one ladder rung's train step; (hlo_text, param_bytes)."""
     import numpy as np
 
@@ -111,9 +64,10 @@ def _rung_hlo(strategy: str, n_devices: int) -> tuple[str, int]:
     from tpu_ddp.parallel.mesh import make_mesh
     from tpu_ddp.train.engine import Trainer
     from tpu_ddp.utils.config import TrainConfig
+    from tpu_ddp.utils.hlo_comm import train_step_hlo
 
     mesh = make_mesh(jax.devices()[:n_devices])
-    cfg = TrainConfig()
+    cfg = TrainConfig(grad_compress=grad_compress)
     model = get_model(cfg.model, num_classes=cfg.num_classes)
     trainer = Trainer(model, cfg, strategy=strategy, mesh=mesh)
     state = trainer.init_state()
@@ -123,9 +77,7 @@ def _rung_hlo(strategy: str, n_devices: int) -> tuple[str, int]:
     y = rng.integers(0, cfg.num_classes,
                      size=cfg.global_batch_size).astype(np.int32)
     xb, yb, wb = trainer.put_batch(x, y)
-    lowered = trainer._train_step.lower(state.params, state.opt_state,
-                                        xb, yb, wb)
-    hlo = lowered.compile().as_text()
+    hlo = train_step_hlo(trainer, state, xb, yb, wb)
     param_bytes = sum(
         leaf.size * leaf.dtype.itemsize
         for leaf in jax.tree.leaves(state.params))
@@ -141,14 +93,35 @@ def main(n_devices: int = 8) -> dict:
         vol = collective_volume(hlo, n_devices)
         vol["strategy"] = strategy
         vol["param_bytes"] = param_bytes
-        results[part] = vol
         print(f"[comm_volume] {part} ({strategy}): "
               f"{vol['total_collectives']} collectives, "
               f"{vol['total_wire_bytes_per_device'] / 1e6:.2f} MB/device",
               file=sys.stderr)
+        # Compressed wire formats: a rung that never syncs has nothing
+        # to compress (part1's Trainer would warn and degrade to none).
+        if strategy != "none":
+            compressed = {}
+            base = vol["total_wire_bytes_per_device"]
+            for spec in COMPRESSORS:
+                chlo, _ = _rung_hlo(strategy, n_devices,
+                                    grad_compress=spec)
+                cvol = collective_volume(chlo, n_devices)
+                cvol["reduction_vs_fp32"] = (
+                    base / cvol["total_wire_bytes_per_device"]
+                    if cvol["total_wire_bytes_per_device"] else None)
+                compressed[spec] = cvol
+                print(f"[comm_volume]   + {spec}: "
+                      f"{cvol['total_wire_bytes_per_device'] / 1e6:.2f} "
+                      f"MB/device "
+                      f"({cvol['reduction_vs_fp32']:.2f}x less)",
+                      file=sys.stderr)
+            vol["compressed"] = compressed
+        results[part] = vol
     out = {"n_devices": n_devices, "model": "VGG11/CIFAR-10",
            "note": "collectives per optimizer step from compiled HLO; "
-                   "wire bytes use the ring-algorithm cost model",
+                   "wire bytes use the ring-algorithm cost model; "
+                   "'compressed' rows re-compile the rung with "
+                   "grad_compress=bf16/int8 wire formats",
            "rungs": results}
     os.makedirs(os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "experiments"), exist_ok=True)
@@ -159,13 +132,24 @@ def main(n_devices: int = 8) -> dict:
     print(f"[comm_volume] wrote {path}", file=sys.stderr)
 
     # Markdown table for EXPERIMENTS.md.
-    print("| part | strategy | collectives | ops | wire MB/device |")
-    print("|---|---|---|---|---|")
+    print("| part | strategy | collectives | ops | wire MB/device | "
+          "bf16 MB (x) | int8 MB (x) |")
+    print("|---|---|---|---|---|---|---|")
     for part, vol in results.items():
         ops = ", ".join(f"{k} x{v['count']}" for k, v in vol["ops"].items())
+        comp_cells = []
+        for spec in COMPRESSORS:
+            c = vol.get("compressed", {}).get(spec)
+            if c is None:
+                comp_cells.append("-")
+            else:
+                comp_cells.append(
+                    f"{c['total_wire_bytes_per_device'] / 1e6:.2f} "
+                    f"({c['reduction_vs_fp32']:.2f}x)")
         print(f"| {part} | {vol['strategy']} | "
               f"{vol['total_collectives']} | {ops or '-'} | "
-              f"{vol['total_wire_bytes_per_device'] / 1e6:.2f} |")
+              f"{vol['total_wire_bytes_per_device'] / 1e6:.2f} | "
+              f"{comp_cells[0]} | {comp_cells[1]} |")
     return out
 
 

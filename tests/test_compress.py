@@ -1,0 +1,507 @@
+"""Compressed gradient collectives (tpu_ddp/parallel/compress.py).
+
+What the ladder's compression layer must guarantee, each pinned here:
+
+- the int8 quantizer's per-element error is bounded by one per-block
+  step and stochastic rounding is unbiased;
+- error feedback makes the lossy wire's bias telescope away (toy
+  quadratic: int8+EF lands on the fp32 optimum, int8-noef hovers at a
+  noise floor above it);
+- every rung of the ladder stays on the fp32 trajectory within the
+  documented tolerance when compressed (strategy-equivalence sweep);
+- the stateful carry behaves: checkpointed + restored bit-exact,
+  reset (with a warning) on any layout mismatch, rolled back by a
+  StepGuard skip, and the K-step scan is bit-equal to K single steps;
+- the compiled step really moves gradients at the reduced dtype —
+  scanned out of the HLO (utils/hlo_comm.py), because XLA float
+  normalization can silently widen a bf16 collective back to f32.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_ddp.parallel.compress import (SPECS, GradCompressor,
+                                       get_compressor)
+from tpu_ddp.parallel.mesh import DATA_AXIS, make_mesh
+from tpu_ddp.train.engine import Trainer
+from tpu_ddp.utils.config import TrainConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class TinyNoBN:
+    """Per-example-decoupled conv model (same rationale as
+    test_sync.TinyNoBN: BN's batch statistics would make distributed
+    forwards differ from the single-device pass for reasons unrelated
+    to the gradient wire)."""
+
+    def init(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        return {
+            "conv": 0.3 * jax.random.normal(k1, (3, 3, 3, 8)),
+            "bias": jnp.zeros((8,)),
+            "head": 0.3 * jax.random.normal(k2, (2 * 2 * 8, 10)),
+            "head_b": 0.01 * jax.random.normal(k3, (10,)),
+        }
+
+    def apply(self, params, x):
+        y = lax.conv_general_dilated(
+            x, params["conv"], (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = jnp.maximum(y + params["bias"], 0)
+        y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 2, 2, 1),
+                              (1, 2, 2, 1), "VALID")
+        return y.reshape(y.shape[0], -1) @ params["head"] + params["head_b"]
+
+
+def _batch(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4, 4, 3)).astype(np.float32)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    return x, y
+
+
+def _trainer(devices, strategy, spec, dp=4):
+    mesh = make_mesh(devices[:dp])
+    return Trainer(TinyNoBN(), TrainConfig(grad_compress=spec),
+                   strategy=strategy, mesh=mesh)
+
+
+def _flat(tree):
+    return np.concatenate([np.ravel(np.asarray(jax.device_get(l)))
+                           for l in jax.tree.leaves(tree)])
+
+
+def _pflat(tr, state):
+    """Flat param vector comparable ACROSS strategies: FSDP keeps
+    1/dp-padded flat leaves at rest, so unshard before flattening."""
+    params = jax.device_get(state.params)
+    zero3 = getattr(tr, "zero3", None)
+    if zero3 is not None:
+        params = zero3.unshard_host(params)
+    return _flat(params)
+
+
+def _run_steps(tr, n_steps=3):
+    state = tr.init_state()
+    losses = []
+    for i in range(n_steps):
+        xb, yb, wb = tr.put_batch(*_batch(seed=i))
+        state, loss = tr.train_step(state, xb, yb, wb)
+        losses.append(float(np.ravel(np.asarray(loss))[0]))
+    return state, losses
+
+
+# ---------------------------------------------------------------------
+# quantizer
+# ---------------------------------------------------------------------
+
+class TestQuantizer:
+    def test_roundtrip_error_bounded_by_block_scale(self):
+        """|deq(q(x)) - x| <= one quantization step (= the block's
+        scale), element-wise — stochastic rounding moves at most one
+        level, and amax/127 scaling means no value clips."""
+        comp = get_compressor("int8", block_size=64)
+        rng = np.random.default_rng(0)
+        # Mixed-magnitude blocks: per-BLOCK scales must keep small
+        # blocks' errors small even next to a huge one.
+        x = jnp.asarray(
+            rng.normal(size=(4, 256)) * np.array([1e-3, 1.0, 50.0, 1e4]
+                                                 )[:, None],
+            jnp.float32)
+        q, scale = comp._quant(x, jax.random.key(1))
+        assert q.dtype == jnp.int8 and q.shape == x.shape
+        assert scale.shape == (4, 4)  # 256 / 64 blocks per row
+        err = np.abs(np.asarray(comp._dequant(q, scale) - x))
+        step = np.repeat(np.asarray(scale), 64, axis=-1)
+        assert np.all(err <= step * (1 + 1e-6))
+
+    def test_stochastic_rounding_is_unbiased(self):
+        """Averaged over keys, deq(q(x)) -> x (floor(x/s + u) with
+        u ~ U[0,1) is unbiased per element)."""
+        comp = get_compressor("int8", block_size=128)
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(size=(128,)), jnp.float32)
+
+        def deq_once(k):
+            q, s = comp._quant(x, k)
+            return comp._dequant(q, s)
+
+        keys = jax.random.split(jax.random.key(7), 512)
+        mean = np.asarray(jnp.mean(jax.vmap(deq_once)(keys), axis=0))
+        _, s = comp._quant(x, keys[0])
+        # Bias per element ~ step / sqrt(512) ≈ 0.044 steps; allow 4x.
+        assert np.max(np.abs(mean - np.asarray(x))) < float(s[0]) * 0.2
+
+    def test_zeros_quantize_exactly(self):
+        """A zero block round-trips to exactly zero for ANY key — the
+        property that makes chunk padding invisible to means and to the
+        error-feedback residual."""
+        comp = get_compressor("int8")
+        x = jnp.zeros((512,), jnp.float32)
+        q, s = comp._quant(x, jax.random.key(123))
+        assert not np.any(np.asarray(q))
+        assert not np.any(np.asarray(comp._dequant(q, s)))
+
+    def test_bf16_wire_bitcast_roundtrip(self):
+        x = jnp.asarray([1.0, -2.5, 3.0e-8, 65504.0], jnp.float32)
+        w = GradCompressor._to_wire_bf16(x)
+        assert w.dtype == jnp.uint16
+        back = GradCompressor._from_wire_bf16(w)
+        np.testing.assert_array_equal(
+            np.asarray(back), np.asarray(x.astype(jnp.bfloat16)
+                                         .astype(jnp.float32)))
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="unknown grad_compress"):
+            get_compressor("fp8")
+        with pytest.raises(ValueError, match="block_size"):
+            get_compressor("int8", block_size=0)
+        assert get_compressor(None).spec == "none"
+        for spec in SPECS:
+            c = get_compressor(spec)
+            assert c.describe()["spec"] == spec
+
+    def test_config_validation(self, monkeypatch):
+        with pytest.raises(ValueError, match="TPU_DDP_GRAD_COMPRESS"):
+            TrainConfig(grad_compress="fp8")
+        monkeypatch.setenv("TPU_DDP_GRAD_COMPRESS", "bf16")
+        assert TrainConfig().grad_compress == "bf16"
+
+
+# ---------------------------------------------------------------------
+# error feedback on a toy quadratic
+# ---------------------------------------------------------------------
+
+class TestErrorFeedback:
+    """min_w mean_i 0.5||w - t_i||^2 over dp devices, grad_i = w - t_i.
+    Plain GD with the exact mean gradient converges to mean(t);
+    int8+EF must track it, int8-noef hovers at the quantization noise
+    floor above it — the drift the residual exists to remove."""
+
+    # Small LR + many steps: stochastic rounding is unbiased, so noef's
+    # handicap is VARIANCE, not bias — the noef noise floor scales
+    # ~sqrt(lr) while EF's noise-shaping floor scales ~lr, and the gap
+    # between them only opens as lr shrinks (measured: 1.2x at lr=0.4,
+    # 5.1x at lr=0.02).
+    D, LR, STEPS = 512, 0.02, 800
+
+    def _targets(self, n):
+        rng = np.random.default_rng(11)
+        # Heavy-tailed per-device offsets keep per-block amax (and so
+        # the quantization step) large relative to the shrinking
+        # gradient near the optimum — the regime where EF matters.
+        return jnp.asarray(rng.normal(size=(n, self.D)) *
+                           rng.choice([0.05, 1.0, 30.0],
+                                      size=(n, self.D)), jnp.float32)
+
+    def _descend(self, devices, spec, n=8):
+        mesh = make_mesh(devices[:n])
+        comp = get_compressor(spec, block_size=64)
+        t = self._targets(n)
+        template = {"w": jax.ShapeDtypeStruct((self.D,), jnp.float32)}
+        cstate = comp.init_state(template, n, seed=0)
+        cspecs = comp.state_specs(cstate)
+        if cstate is not None:
+            from jax.sharding import NamedSharding
+            cstate = jax.device_put(cstate, jax.tree.map(
+                lambda s: NamedSharding(mesh, s), cspecs,
+                is_leaf=lambda x: isinstance(x, P)))
+
+        def step(w, c, ti):
+            g = {"w": w["w"] - ti.reshape(-1)}
+            if spec == "none":
+                g = lax.pmean(g, DATA_AXIS)
+                new_c = c
+            else:
+                g, new_c = comp.sync_replicated("fused", g, c,
+                                                DATA_AXIS, n)
+            return {"w": w["w"] - self.LR * g["w"]}, new_c
+
+        def descend(w, c, ti):
+            # All STEPS inside ONE dispatch. Besides being fast, this
+            # is load-bearing on the 1-core CPU backend: a Python loop
+            # of un-harvested dispatches piles up concurrent
+            # executions whose in-process all_to_all rendezvous can
+            # starve each other and deadlock (8 device threads per
+            # execution, one core). One execution cannot race itself.
+            return lax.fori_loop(
+                0, self.STEPS, lambda _, wc: step(*wc, ti), (w, c))
+
+        in_specs = (P(), cspecs if cstate is not None else P(),
+                    P(DATA_AXIS))
+        out_specs = (P(), cspecs if cstate is not None else P())
+        fn = jax.jit(jax.shard_map(descend, mesh=mesh,
+                                   in_specs=in_specs,
+                                   out_specs=out_specs, check_vma=False))
+        from jax.sharding import NamedSharding
+        w = jax.device_put({"w": jnp.zeros((self.D,), jnp.float32)},
+                           NamedSharding(mesh, P()))
+        td = jax.device_put(t, NamedSharding(mesh, P(DATA_AXIS)))
+        c = cstate if cstate is not None else jnp.zeros((), jnp.float32)
+        w, c = fn(w, c, td)
+        return np.asarray(jax.device_get(w["w"])), np.asarray(
+            jnp.mean(t, axis=0))
+
+    def test_ef_converges_noef_drifts(self, devices):
+        w_fp32, opt = self._descend(devices, "none")
+        w_ef, _ = self._descend(devices, "int8")
+        w_noef, _ = self._descend(devices, "int8-noef")
+        err_fp32 = np.linalg.norm(w_fp32 - opt)
+        err_ef = np.linalg.norm(w_ef - opt)
+        err_noef = np.linalg.norm(w_noef - opt)
+        # fp32 GD contracts (1-lr)^steps -> essentially exact.
+        assert err_fp32 < 1e-3
+        # EF must land within a whisker of the fp32 trajectory...
+        assert np.linalg.norm(w_ef - w_fp32) < 0.05 * np.linalg.norm(opt)
+        # ...while the ablation stalls at a visibly higher noise floor
+        # (measured 5.1x at this lr; deterministic seeds).
+        assert err_noef > 3 * max(err_ef, 1e-6)
+
+
+# ---------------------------------------------------------------------
+# strategy equivalence under compression
+# ---------------------------------------------------------------------
+
+ALL_RUNGS = ("gather_scatter", "all_reduce", "fused", "zero", "fsdp")
+
+
+class TestStrategyEquivalence:
+    """Every compressed rung must stay on the fp32 fused trajectory
+    within the documented tolerance (compress.py module docstring):
+    bf16 keeps ~8 mantissa bits, int8 adds blockwise quantization noise
+    that error feedback re-injects rather than compounds."""
+
+    _base = {}
+
+    def _baseline(self, devices):
+        if "p" not in self._base:
+            state, losses = _run_steps(
+                _trainer(devices, "fused", "none"))
+            self._base["p"] = _flat(state.params)
+            self._base["l"] = losses
+        return self._base["p"], self._base["l"]
+
+    @pytest.mark.parametrize("strategy", ["fused", "zero"])
+    def test_bf16_core_rungs(self, devices, strategy):
+        # fused/zero cover the two bf16 code paths (sync_replicated /
+        # scatter_mean); the remaining rungs ride the slow tier below.
+        p0, _ = self._baseline(devices)
+        tr = _trainer(devices, strategy, "bf16")
+        state, losses = _run_steps(tr)
+        assert np.all(np.isfinite(losses))
+        assert np.max(np.abs(_pflat(tr, state) - p0)) < 5e-3
+
+    @pytest.mark.slow  # 3 more trainer compiles
+    @pytest.mark.parametrize("strategy", ["gather_scatter", "all_reduce",
+                                          "fsdp"])
+    def test_bf16_remaining_rungs(self, devices, strategy):
+        p0, _ = self._baseline(devices)
+        tr = _trainer(devices, strategy, "bf16")
+        state, losses = _run_steps(tr)
+        assert np.all(np.isfinite(losses))
+        assert np.max(np.abs(_pflat(tr, state) - p0)) < 5e-3
+
+    @pytest.mark.parametrize("strategy", ["fused", "zero"])
+    def test_int8_stays_on_trajectory(self, devices, strategy):
+        p0, _ = self._baseline(devices)
+        tr = _trainer(devices, strategy, "int8")
+        state, losses = _run_steps(tr)
+        assert np.all(np.isfinite(losses))
+        assert np.max(np.abs(_pflat(tr, state) - p0)) < 2e-2
+
+    @pytest.mark.slow  # 6 more trainer compiles; fused/zero cover the
+    # two code paths (sync_replicated / scatter_mean) in the default tier
+    @pytest.mark.parametrize("strategy", ["gather_scatter", "all_reduce",
+                                          "fsdp"])
+    @pytest.mark.parametrize("spec", ["int8", "int8-noef"])
+    def test_int8_remaining_rungs(self, devices, strategy, spec):
+        p0, _ = self._baseline(devices)
+        tr = _trainer(devices, strategy, spec)
+        state, losses = _run_steps(tr)
+        assert np.all(np.isfinite(losses))
+        assert np.max(np.abs(_pflat(tr, state) - p0)) < 5e-2
+
+    def test_degrades_to_none_without_sync(self, devices):
+        """Under strategy 'none' there is no collective to compress:
+        the trainer must warn and run uncompressed, not silently change
+        the rung's semantics."""
+        mesh = make_mesh(devices[:4])
+        with pytest.warns(UserWarning, match="compression disabled"):
+            tr = Trainer(TinyNoBN(), TrainConfig(grad_compress="int8"),
+                         strategy="none", mesh=mesh)
+        assert tr.compressor.spec == "none"
+        state = tr.init_state()
+        assert state.comp_state is None
+        state, loss = tr.train_step(state, *tr.put_batch(*_batch()))
+        assert np.all(np.isfinite(np.asarray(loss)))
+
+
+# ---------------------------------------------------------------------
+# the stateful carry: scan, checkpoint, guard
+# ---------------------------------------------------------------------
+
+class TestCarry:
+    def test_multi_step_bit_equals_single_steps(self, devices):
+        """build_multi_step's scanned K steps must be bit-equal to K
+        train_step calls — including the residual and seed carry."""
+        tr = _trainer(devices, "fused", "int8")
+        state = tr.init_state()
+        for i in range(2):
+            state, _ = tr.train_step(state,
+                                     *tr.put_batch(*_batch(seed=i)))
+            # Serialize: concurrent in-flight all_to_all executions can
+            # deadlock the 1-core CPU backend's rendezvous.
+            jax.block_until_ready(state.params)
+
+        tr2 = _trainer(devices, "fused", "int8")
+        s2 = tr2.init_state()
+        xs, ys = zip(*[_batch(seed=i) for i in range(2)])
+        fn = tr2.build_multi_step(2)
+        s2, _ = fn(s2, *tr2.put_batches(np.stack(xs), np.stack(ys)))
+
+        np.testing.assert_array_equal(_flat(state.params),
+                                      _flat(s2.params))
+        np.testing.assert_array_equal(
+            _flat(state.comp_state["residual"]),
+            _flat(s2.comp_state["residual"]))
+        assert (int(jax.device_get(state.comp_state["seed"]))
+                == int(jax.device_get(s2.comp_state["seed"])))
+
+    def test_checkpoint_roundtrip_restores_residual(self, devices,
+                                                    tmp_path):
+        tr = _trainer(devices, "fused", "int8")
+        state, _ = _run_steps(tr, n_steps=3)
+        assert np.any(_flat(state.comp_state["residual"]))  # non-trivial
+        tr.save_checkpoint(str(tmp_path), state)
+        restored = tr.restore_checkpoint(str(tmp_path))
+        assert restored.step == state.step
+        np.testing.assert_array_equal(_flat(state.params),
+                                      _flat(restored.params))
+        np.testing.assert_array_equal(
+            _flat(state.comp_state["residual"]),
+            _flat(restored.comp_state["residual"]))
+        assert (int(jax.device_get(restored.comp_state["seed"]))
+                == int(jax.device_get(state.comp_state["seed"])))
+        # and the run continues.
+        restored, loss = tr.train_step(restored,
+                                       *tr.put_batch(*_batch(seed=9)))
+        assert np.all(np.isfinite(np.asarray(loss)))
+
+    def test_compressed_checkpoint_into_plain_trainer(self, devices,
+                                                      tmp_path):
+        """A compression-less trainer DROPS a checkpoint's comp_state
+        leaves instead of refusing the file."""
+        tr = _trainer(devices, "fused", "int8")
+        state, _ = _run_steps(tr, n_steps=2)
+        tr.save_checkpoint(str(tmp_path), state)
+        plain = _trainer(devices, "fused", "none")
+        restored = plain.restore_checkpoint(str(tmp_path))
+        assert restored.comp_state is None
+        np.testing.assert_array_equal(_flat(state.params),
+                                      _flat(restored.params))
+
+    def test_plain_checkpoint_resets_residual(self, devices, tmp_path):
+        """Restoring a pre-compression checkpoint into an int8 trainer
+        warns and resets the carry — the residual is an optimization
+        accelerator, never a correctness requirement."""
+        plain = _trainer(devices, "fused", "none")
+        state, _ = _run_steps(plain, n_steps=1)
+        plain.save_checkpoint(str(tmp_path), state)
+        tr = _trainer(devices, "fused", "int8")
+        with pytest.warns(UserWarning, match="comp_state"):
+            restored = tr.restore_checkpoint(str(tmp_path))
+        assert not np.any(_flat(restored.comp_state["residual"]))
+        np.testing.assert_array_equal(_flat(state.params),
+                                      _flat(restored.params))
+
+    @pytest.mark.slow  # two extra trainer compiles (dp=4 and dp=8)
+    def test_dp_mismatch_resets_residual(self, devices, tmp_path):
+        """The residual is (dp, *shape): a checkpoint from another dp
+        size cannot be reinterpreted and must reset, not crash."""
+        tr4 = _trainer(devices, "fused", "int8", dp=4)
+        state, _ = _run_steps(tr4, n_steps=2)
+        tr4.save_checkpoint(str(tmp_path), state)
+        tr8 = _trainer(devices, "fused", "int8", dp=8)
+        with pytest.warns(UserWarning, match="comp_state"):
+            restored = tr8.restore_checkpoint(str(tmp_path))
+        assert not np.any(_flat(restored.comp_state["residual"]))
+        np.testing.assert_array_equal(_flat(state.params),
+                                      _flat(restored.params))
+
+    def test_guard_skip_rolls_back_carry(self, devices):
+        """A StepGuard-skipped step must not consume the carry: the
+        residual would absorb a gradient that was never applied and the
+        stochastic-rounding seed would advance."""
+        tr = _trainer(devices, "fused", "int8")
+        state = tr.init_state()
+        state, _ = tr.train_step(state, *tr.put_batch(*_batch()))
+        p0 = _flat(state.params)
+        r0 = _flat(state.comp_state["residual"])
+        s0 = int(jax.device_get(state.comp_state["seed"]))
+        x, y = _batch(seed=5)
+        x[0, 0, 0, 0] = np.nan
+        state, _ = tr.train_step(state, *tr.put_batch(x, y))
+        assert tr.last_step_skipped()
+        np.testing.assert_array_equal(p0, _flat(state.params))
+        np.testing.assert_array_equal(r0,
+                                      _flat(state.comp_state["residual"]))
+        assert int(jax.device_get(state.comp_state["seed"])) == s0
+
+
+# ---------------------------------------------------------------------
+# the HLO invariant: the wire really is s8/u16
+# ---------------------------------------------------------------------
+
+class TestReducedDtypeHLO:
+    """Compiled-HLO proof (utils/hlo_comm.py scanner) on the 8-device
+    mesh: a compressed step's collective payload lives at the wire
+    dtype, with f32 collective traffic bounded by per-block scales and
+    the step's scalar psums. This is what the bitcast-to-integer wire
+    exists for — XLA float normalization would otherwise legalize a
+    bf16 all-reduce back to f32 and silently undo the compression."""
+
+    GRAD_BYTES = 554 * 4  # TinyNoBN param count x fp32
+
+    def _dtypes(self, devices, strategy, spec):
+        from tpu_ddp.utils.hlo_comm import (collective_dtype_bytes,
+                                            train_step_hlo)
+        tr = _trainer(devices, strategy, spec, dp=8)
+        state = tr.init_state()
+        xb, yb, wb = tr.put_batch(*_batch())
+        return collective_dtype_bytes(train_step_hlo(tr, state, xb, yb,
+                                                     wb))
+
+    def test_fp32_baseline_has_no_reduced_wire(self, devices):
+        d = self._dtypes(devices, "fused", "none")
+        assert "u16" not in d and "s8" not in d
+        assert d["f32"] >= self.GRAD_BYTES
+
+    def test_bf16_fused_wire(self, devices):
+        d = self._dtypes(devices, "fused", "bf16")
+        # Two movement phases at 2 bytes/elem >= one grad at half width.
+        assert d.get("u16", 0) >= self.GRAD_BYTES // 2
+        # f32 collectives: only the loss/guard scalar psums remain.
+        assert d.get("f32", 0) <= 64
+
+    def test_int8_fused_wire(self, devices):
+        d = self._dtypes(devices, "fused", "int8")
+        assert d.get("s8", 0) >= self.GRAD_BYTES // 4
+        assert "u16" not in d
+        # f32: scalar psums + the per-block scales (554 params / 256
+        # block ~ a few dozen scale floats across both phases).
+        assert d.get("f32", 0) <= 512
+
+    @pytest.mark.slow  # one extra dp=8 trainer compile
+    def test_int8_scattered_rung_wire(self, devices):
+        """ZeRO's compressed reduce_scatter moves s8; the f32 that
+        remains is the rung's own fp32 PARAMETER all_gather (documented
+        out of compression's scope) plus scales and scalars."""
+        d = self._dtypes(devices, "zero", "int8")
+        assert d.get("s8", 0) > 0
+        assert d.get("f32", 0) <= self.GRAD_BYTES + 512

@@ -174,3 +174,41 @@ def test_per_replica_losses_reported(devices):
                       mesh=mesh)
     _, losses = run_steps(trainer, n_steps=1)
     assert losses[0].shape == (4,)  # one loss per dp slot
+
+
+class TestStrategyLookup:
+    """Name-resolution error contract (sync.py). ``canonical_strategy``
+    must reject unknown ``part*`` aliases itself — the old pass-through
+    deferred the failure to ``get_sync_strategy``'s dict lookup, and a
+    caller comparing only the canonical name would silently treat
+    'part9' as the no-sync strategy."""
+
+    def test_unknown_part_alias_rejected(self):
+        from tpu_ddp.parallel.sync import canonical_strategy
+        with pytest.raises(ValueError, match=r"unknown part alias 'part9'"):
+            canonical_strategy("part9")
+        with pytest.raises(ValueError, match=r"available parts"):
+            canonical_strategy("part0")
+
+    def test_known_names_resolve(self):
+        from tpu_ddp.parallel.sync import canonical_strategy
+        assert canonical_strategy("part4") == "zero"
+        assert canonical_strategy("fused") == "fused"
+        # Non-part junk passes through: get_sync_strategy owns that error.
+        assert canonical_strategy("bogus") == "bogus"
+
+    def test_get_sync_strategy_error_lists_options(self):
+        from tpu_ddp.parallel.sync import (PART_TO_STRATEGY,
+                                           SYNC_STRATEGIES,
+                                           get_sync_strategy)
+        with pytest.raises(ValueError) as ei:
+            get_sync_strategy("bogus")
+        msg = str(ei.value)
+        assert msg.startswith("unknown sync strategy 'bogus'")
+        assert str(sorted(SYNC_STRATEGIES)) in msg
+        assert str(sorted(PART_TO_STRATEGY)) in msg
+
+    def test_get_sync_strategy_part_alias_error(self):
+        from tpu_ddp.parallel.sync import get_sync_strategy
+        with pytest.raises(ValueError, match=r"unknown part alias"):
+            get_sync_strategy("part7")

@@ -384,13 +384,24 @@ def main(argv=None) -> int:
                         "tpu_ddp/train/pipeline.py); 0 = synchronous "
                         "loop. Sets TPU_DDP_DISPATCH_DEPTH for every "
                         "rank (default: the workers' config default)")
+    p.add_argument("--grad-compress", default=None,
+                   choices=("none", "bf16", "int8", "int8-noef"),
+                   help="gradient wire format for the sync collectives "
+                        "(tpu_ddp/parallel/compress.py): bf16 halves, "
+                        "int8 ~quarters the bytes on the wire (int8 "
+                        "carries an error-feedback residual; int8-noef "
+                        "is the ablation without it). Sets "
+                        "TPU_DDP_GRAD_COMPRESS for every rank")
     args, extra = p.parse_known_args(argv)
-    env = None
+    env = {}
     if args.dispatch_depth is not None:
         if args.dispatch_depth < 0:
             p.error(f"--dispatch-depth must be >= 0, "
                     f"got {args.dispatch_depth}")
-        env = {"TPU_DDP_DISPATCH_DEPTH": str(args.dispatch_depth)}
+        env["TPU_DDP_DISPATCH_DEPTH"] = str(args.dispatch_depth)
+    if args.grad_compress is not None:
+        env["TPU_DDP_GRAD_COMPRESS"] = args.grad_compress
+    env = env or None
     try:
         res = launch_elastic(args.part, args.nproc,
                              max_restarts=args.max_restarts,

@@ -1,0 +1,420 @@
+"""Compressed gradient collectives — bf16/int8 wire formats for the ladder.
+
+Every rung of the sync ladder ships gradients at fp32; this layer wraps
+any rung with a reduced wire format while keeping fp32 accumulation:
+
+- ``none``  — no-op (the fp32 baseline).
+- ``bf16``  — gradients cast to bfloat16 before the collective, mean
+  accumulated in fp32 after. Stateless; 2x the wire bytes back.
+- ``int8``  — blockwise int8 quantization (per-block fp32 scales over
+  ``block_size``-element blocks, stochastic rounding) with an
+  error-feedback residual: each device re-injects the quantization
+  error it introduced into its NEXT step's gradient, so the bias of
+  the lossy wire telescopes away (Seide et al.'s 1-bit-SGD trick,
+  generalized to 8 bits). ~4x the wire bytes back.
+- ``int8-noef`` — int8 without the residual (ablation: shows the drift
+  error feedback removes; tests/test_compress.py pins it).
+
+Wire scheme. A compressed all-reduce is built from dtype-PRESERVING
+movement collectives instead of an arithmetic ``psum``:
+
+    phase 1 (reduce):    all_to_all of quantized rows — each device
+                         receives every peer's row of ITS 1/N chunk and
+                         accumulates the mean in fp32;
+    phase 2 (broadcast): the owner re-quantizes its chunk's mean and
+                         all_gathers it (replicated rungs only — the
+                         ZeRO/FSDP scattered path stops after phase 1,
+                         exactly the folded reduce_scatter半 they need).
+
+Two reasons this shape, both load-bearing:
+
+1. Wire volume. At N devices an fp32 all-reduce moves 8S(N-1)/N bytes
+   for S gradient elements. The two-phase scheme moves 2 * wS(N-1)/N
+   (w = wire bytes/element), i.e. exactly 8/(2w): 2.0x for bf16, ~3.9x
+   for int8 (+1/64 scale overhead). A naive "all_gather the quantized
+   gradients" moves (N-1)wS — at w=1, N=8 that is NO reduction.
+2. HLO verifiability. Arithmetic collectives are subject to backend
+   float-legalization: XLA:CPU's FloatNormalization rewrites a bf16
+   ``all-reduce`` to convert→f32-all-reduce→convert, silently widening
+   the wire back to fp32 (measured; the numerics keep the bf16
+   rounding, the bytes don't shrink). Movement collectives at INTEGER
+   dtypes are untouched by that pass on every backend, so bf16 payloads
+   travel bitcast as ``u16`` and int8 as ``s8`` — the compiled-HLO
+   invariant (tests/test_compress.py, scripts/comm_volume.py) can then
+   assert the reduced dtype is really on the wire, not constant-folded
+   away (utils/hlo_comm.py scans for it).
+
+Error-feedback algebra (int8). With per-device residual r_i and
+acc_i = g_i + r_i, phase 1 introduces e1_i = acc_i - deq(q(acc_i)) on
+device i and phase 2 introduces e2 = m - deq(q(m)) on the chunk's
+owner, where m is the fp32 mean of the dequantized rows. The applied
+gradient is mean_i(acc_i) - mean_i(e1_i) - e2, so setting
+
+    r_i' = e1_i  +  N * e2   (the owner's chunk only)
+
+makes mean_i(r_i') equal the full error — the residual carried into the
+next step compensates exactly (owner-attributed: only the device that
+quantized the mean charges itself the broadcast error, scaled by N so
+the mean over devices recovers it once).
+
+The residual pytree lives in ``TrainState.comp_state`` (engine.py):
+threaded through the jitted step's carry, donated with params/opt
+state, checkpointed, selected OLD on a StepGuard skip (a skipped step
+must not consume residuals), and reset to zeros on restore-mismatch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+SPECS = ("none", "bf16", "int8", "int8-noef")
+
+# Replicated rungs the compressor can wrap (kind -> collective shape);
+# the ZeRO/FSDP rungs use scatter_mean instead.
+REPLICATED_KINDS = ("gather_scatter", "all_reduce", "fused")
+
+
+def get_compressor(spec: str | None, block_size: int = 256
+                   ) -> "GradCompressor":
+    """Resolve a compressor spec string (None == 'none')."""
+    return GradCompressor(spec or "none", block_size=block_size)
+
+
+class GradCompressor:
+    """Gradient wire compression for one sync rung.
+
+    Jit-side entry points (call INSIDE the shard_map'd step):
+
+    - :meth:`sync_replicated` — full compressed mean for the replicated
+      rungs (gather_scatter / all_reduce / fused); replaces ``sync_fn``.
+    - :meth:`scatter_mean` — phase-1-only compressed reduce_scatter for
+      ZeRO-1/FSDP: per-leaf 1/N fp32 mean slices in the flat-padded
+      layout ``parallel/zero.py`` uses (chunk = ceil(size/N), so the
+      slices feed ``ZeRO1.apply_scattered``/``ZeRO3.apply`` directly).
+
+    Host-side: :meth:`init_state` builds the carried state (int8 only —
+    a replicated uint32 seed counter for stochastic rounding, plus the
+    per-device error-feedback residual, global shape (dp, *leaf_shape)
+    sharded over dp); :meth:`state_specs` its shard_map specs.
+    """
+
+    def __init__(self, spec: str = "none", block_size: int = 256):
+        if spec not in SPECS:
+            raise ValueError(
+                f"unknown grad_compress spec {spec!r}; available: "
+                f"{list(SPECS)}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.spec = spec
+        self.block_size = int(block_size)
+        self.is_int8 = spec.startswith("int8")
+        self.error_feedback = spec == "int8"
+        # Only int8 carries state (seed counter + residual); bf16 is a
+        # pure cast and 'none' a no-op.
+        self.stateful = self.is_int8
+        self.wire_dtype = ("s8" if self.is_int8
+                           else "u16" if spec == "bf16" else None)
+
+    def describe(self) -> dict:
+        """JSON-serializable summary (bench.py's extra.grad_compress)."""
+        return {"spec": self.spec, "wire_dtype": self.wire_dtype,
+                "block_size": self.block_size if self.is_int8 else None,
+                "error_feedback": self.error_feedback}
+
+    # ---- carried state (host side) -------------------------------------
+
+    def init_state(self, params_template, dp: int, seed: int = 0,
+                   abstract: bool = False):
+        """Fresh comp state for a dp-way mesh, or None when stateless.
+
+        ``params_template`` supplies CANONICAL leaf shapes (under FSDP
+        the compressed path differentiates w.r.t. the gathered full
+        params, so residuals are canonical-shaped there too). Residual
+        leaves are host numpy — the engine device_puts them P(dp).
+        ``abstract=True`` returns ShapeDtypeStructs (for spec/template
+        derivation without allocating dp full param copies)."""
+        if not self.stateful:
+            return None
+        state = {"seed": (jax.ShapeDtypeStruct((), np.uint32) if abstract
+                          else np.uint32(seed))}
+        if self.error_feedback:
+            if abstract:
+                mk = lambda t: jax.ShapeDtypeStruct(  # noqa: E731
+                    (dp,) + tuple(t.shape), np.float32)
+            else:
+                mk = lambda t: np.zeros(  # noqa: E731
+                    (dp,) + tuple(t.shape), np.float32)
+            state["residual"] = jax.tree.map(mk, params_template)
+        return state
+
+    def state_specs(self, comp_state):
+        """shard_map spec tree for :meth:`init_state`'s output: the seed
+        counter replicated, residual leaves sharded over dp's leading
+        axis (each device carries ITS OWN error)."""
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_ddp.parallel.mesh import DATA_AXIS
+        if comp_state is None:
+            return None
+        specs = {"seed": P()}
+        if "residual" in comp_state:
+            specs["residual"] = jax.tree.map(lambda _: P(DATA_AXIS),
+                                             comp_state["residual"])
+        return specs
+
+    # ---- quantization kernel -------------------------------------------
+
+    def _quant(self, x, key):
+        """Blockwise int8 over the LAST axis (must be % block_size):
+        per-block scale = max|x|/127, stochastic rounding via
+        floor(x/scale + u), u ~ U[0,1) — unbiased per element."""
+        b = self.block_size
+        blk = x.reshape(x.shape[:-1] + (-1, b))
+        amax = jnp.max(jnp.abs(blk), axis=-1)
+        scale = jnp.maximum(amax / 127.0, jnp.float32(1e-30))
+        u = jax.random.uniform(key, blk.shape, jnp.float32)
+        q = jnp.clip(jnp.floor(blk / scale[..., None] + u), -127, 127)
+        return q.astype(jnp.int8).reshape(x.shape), scale
+
+    def _dequant(self, q, scale):
+        b = self.block_size
+        blk = q.astype(jnp.float32).reshape(q.shape[:-1] + (-1, b))
+        return (blk * scale[..., None]).reshape(q.shape)
+
+    # ---- bf16 wire (stateless) -----------------------------------------
+
+    @staticmethod
+    def _to_wire_bf16(x):
+        """f32 -> bf16, bitcast u16 so backend float-normalization can
+        never widen the collective back to f32 (module docstring)."""
+        return lax.bitcast_convert_type(x.astype(jnp.bfloat16), jnp.uint16)
+
+    @staticmethod
+    def _from_wire_bf16(w):
+        return lax.bitcast_convert_type(w, jnp.bfloat16).astype(jnp.float32)
+
+    # ---- layout helpers ------------------------------------------------
+
+    def _pad_to(self, flat, total):
+        return jnp.pad(flat, (0, total - flat.shape[0]))
+
+    def _qchunk(self, chunk: int) -> int:
+        """Chunk rounded up to a whole number of quant blocks (the extra
+        tail is quantization-internal padding, sliced off after)."""
+        b = self.block_size
+        return -(-chunk // b) * b
+
+    # ---- the two-phase compressed mean ---------------------------------
+
+    def _bf16_two_phase(self, flat, chunk, axis_name, n):
+        """(n*chunk,) f32 -> exact-dp-mean-of-bf16-payloads, re-broadcast
+        at bf16. Movement collectives only; fp32 accumulation."""
+        rows = self._to_wire_bf16(flat.reshape(n, chunk))
+        rows = lax.all_to_all(rows, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)
+        m = jnp.mean(self._from_wire_bf16(rows), axis=0)      # (chunk,)
+        full = lax.all_gather(self._to_wire_bf16(m), axis_name,
+                              tiled=True)                     # (n*chunk,)
+        return self._from_wire_bf16(full)
+
+    def _int8_phase1(self, flat, chunk, axis_name, n, key):
+        """Quantized all_to_all reduce: (n*chunk,) f32 ->
+        (my chunk's fp32 mean (chunk,), my phase-1 error (n*chunk,))."""
+        qchunk = self._qchunk(chunk)
+        rows = flat.reshape(n, chunk)
+        rows_q = jnp.pad(rows, ((0, 0), (0, qchunk - chunk)))
+        q1, s1 = self._quant(rows_q, key)
+        deq_own = self._dequant(q1, s1)[:, :chunk]
+        err = (rows - deq_own).reshape(-1)
+        q1t = lax.all_to_all(q1, axis_name, split_axis=0,
+                             concat_axis=0, tiled=True)
+        s1t = lax.all_to_all(s1, axis_name, split_axis=0,
+                             concat_axis=0, tiled=True)
+        m = jnp.mean(self._dequant(q1t, s1t)[:, :chunk], axis=0)
+        return m, err
+
+    def _int8_two_phase(self, flat, chunk, axis_name, n, key):
+        """Full compressed all-reduce: phase-1 reduce + re-quantized
+        all_gather broadcast. Returns (mean (n*chunk,), err (n*chunk,))
+        with the phase-2 error owner-attributed at N x into this
+        device's chunk (module docstring algebra)."""
+        k1, k2 = jax.random.split(key)
+        m, err = self._int8_phase1(flat, chunk, axis_name, n, k1)
+        qchunk = self._qchunk(chunk)
+        q2, s2 = self._quant(self._pad_to(m, qchunk), k2)
+        full_q = lax.all_gather(q2, axis_name, tiled=False)   # (n, qchunk)
+        full_s = lax.all_gather(s2, axis_name, tiled=False)
+        out = self._dequant(full_q, full_s)[:, :chunk].reshape(-1)
+        e2 = m - self._dequant(q2, s2)[:chunk]
+        idx = lax.axis_index(axis_name)
+        own = lax.dynamic_slice(err, (idx * chunk,), (chunk,))
+        err = lax.dynamic_update_slice(err, own + n * e2, (idx * chunk,))
+        return out, err
+
+    def _int8_gather_all(self, flat, axis_name, n, key):
+        """gather_scatter wire shape: every device quantizes its FULL
+        payload and all_gathers it; each replica dequantizes and means
+        locally (identical values everywhere, so the reference's
+        root-selects-the-mean step is a no-op and elided). Returns
+        (mean (L,), err (L,))."""
+        total = flat.shape[0]
+        qtotal = self._qchunk(total)
+        q, s = self._quant(self._pad_to(flat, qtotal), key)
+        err = flat - self._dequant(q, s)[:total]
+        qg = lax.all_gather(q, axis_name, tiled=False)        # (n, qtotal)
+        sg = lax.all_gather(s, axis_name, tiled=False)
+        m = jnp.mean(self._dequant(qg, sg)[:, :total], axis=0)
+        return m, err
+
+    # ---- per-step PRNG -------------------------------------------------
+
+    def _device_key(self, comp, axis_name):
+        """Per-(step, device) base key; per-leaf keys fold the leaf
+        index in. Each device quantizes only its OWN payloads, so keys
+        need not agree across devices — determinism of the applied
+        gradient comes from the all_gathered phase-2 bytes."""
+        base = jax.random.key(comp["seed"])
+        return jax.random.fold_in(base, lax.axis_index(axis_name))
+
+    @staticmethod
+    def _bump_seed(comp):
+        return comp["seed"] + jnp.uint32(1)
+
+    # ---- residual plumbing ---------------------------------------------
+
+    @staticmethod
+    def _res_leaf(comp, i, g):
+        """Residual for leaf i as a g-shaped array (the shard_map block
+        of the (dp, *shape) leaf is (1, *shape))."""
+        return jax.tree.leaves(comp["residual"])[i].reshape(g.shape)
+
+    # ---- public jit-side API -------------------------------------------
+
+    def sync_replicated(self, kind, grads, comp, axis_name, n):
+        """Compressed replacement for the replicated rungs' ``sync_fn``:
+        (grads, comp) -> (synced fp32 grads, new comp). Call inside the
+        shard_map'd step; ``kind`` picks the rung's collective shape
+        (one pair per leaf for all_reduce, ONE pair for the whole
+        concatenated tree for fused, a full-payload all_gather for
+        gather_scatter)."""
+        if kind not in REPLICATED_KINDS:
+            raise ValueError(f"sync_replicated got kind {kind!r}; "
+                             f"expected one of {REPLICATED_KINDS}")
+        if self.spec == "none":
+            raise ValueError("sync_replicated on a 'none' compressor; "
+                             "use the rung's sync_fn")
+        leaves, treedef = jax.tree.flatten(grads)
+        if self.spec == "bf16":
+            out = [self._bf16_leaf(kind, g, axis_name, n) for g in leaves]
+            return treedef.unflatten(out), None
+        return self._int8_replicated(kind, leaves, treedef, comp,
+                                     axis_name, n)
+
+    def _bf16_leaf(self, kind, g, axis_name, n):
+        size = g.size
+        flat = g.astype(jnp.float32).reshape(-1)
+        if kind == "gather_scatter":
+            stacked = lax.all_gather(self._to_wire_bf16(flat), axis_name,
+                                     tiled=False)             # (n, size)
+            return jnp.mean(self._from_wire_bf16(stacked),
+                            axis=0).reshape(g.shape)
+        chunk = -(-size // n)
+        out = self._bf16_two_phase(self._pad_to(flat, n * chunk), chunk,
+                                   axis_name, n)
+        return out[:size].reshape(g.shape)
+
+    def _int8_replicated(self, kind, leaves, treedef, comp, axis_name, n):
+        key = self._device_key(comp, axis_name)
+        new_comp = dict(comp)
+        new_comp["seed"] = self._bump_seed(comp)
+
+        def acc_for(i, g):
+            flat = g.astype(jnp.float32).reshape(-1)
+            if self.error_feedback:
+                flat = flat + self._res_leaf(comp, i, g).reshape(-1)
+            return flat
+
+        if kind == "fused":
+            # ONE collective pair for the whole tree: concatenate the
+            # accumulated leaves, run the two-phase mean once, split.
+            sizes = [g.size for g in leaves]
+            flat = jnp.concatenate([acc_for(i, g)
+                                    for i, g in enumerate(leaves)])
+            total = int(sum(sizes))
+            chunk = -(-total // n)
+            m, err = self._int8_two_phase(
+                self._pad_to(flat, n * chunk), chunk, axis_name, n,
+                jax.random.fold_in(key, 0))
+            outs, errs, off = [], [], 0
+            for g, size in zip(leaves, sizes):
+                outs.append(m[off:off + size].reshape(g.shape))
+                errs.append(err[off:off + size])
+                off += size
+        else:
+            outs, errs = [], []
+            for i, g in enumerate(leaves):
+                size = g.size
+                flat = acc_for(i, g)
+                leaf_key = jax.random.fold_in(key, i)
+                if kind == "gather_scatter":
+                    m, err = self._int8_gather_all(flat, axis_name, n,
+                                                   leaf_key)
+                else:  # all_reduce: one pair per leaf
+                    chunk = -(-size // n)
+                    m, err = self._int8_two_phase(
+                        self._pad_to(flat, n * chunk), chunk, axis_name,
+                        n, leaf_key)
+                outs.append(m[:size].reshape(g.shape))
+                errs.append(err[:size])
+        if self.error_feedback:
+            res_leaves = jax.tree.leaves(comp["residual"])
+            new_comp["residual"] = jax.tree.unflatten(
+                jax.tree.structure(comp["residual"]),
+                [e[:r.size].reshape(r.shape)
+                 for e, r in zip(errs, res_leaves)])
+        return treedef.unflatten(outs), new_comp
+
+    def scatter_mean(self, grads, comp, axis_name, n):
+        """Compressed reduce_scatter for the ZeRO-1/FSDP rungs: (grads,
+        comp) -> (per-leaf (chunk,) fp32 MEAN slices, new comp) with
+        chunk = ceil(size/N) — the exact flat-padded layout
+        ``ZeRO1.apply_scattered`` and ``ZeRO3.apply`` consume. Phase 1
+        only: the result stays scattered (the rung's parameter
+        all_gather is its own second half and stays fp32 — parameters,
+        not gradients, are out of this layer's scope)."""
+        leaves, treedef = jax.tree.flatten(grads)
+        if self.spec == "bf16":
+            def leaf(g):
+                size = g.size
+                chunk = -(-size // n)
+                flat = self._pad_to(g.astype(jnp.float32).reshape(-1),
+                                    n * chunk)
+                rows = lax.all_to_all(
+                    self._to_wire_bf16(flat.reshape(n, chunk)), axis_name,
+                    split_axis=0, concat_axis=0, tiled=True)
+                return jnp.mean(self._from_wire_bf16(rows), axis=0)
+            return treedef.unflatten([leaf(g) for g in leaves]), None
+        key = self._device_key(comp, axis_name)
+        new_comp = dict(comp)
+        new_comp["seed"] = self._bump_seed(comp)
+        outs, errs = [], []
+        for i, g in enumerate(leaves):
+            size = g.size
+            chunk = -(-size // n)
+            flat = g.astype(jnp.float32).reshape(-1)
+            if self.error_feedback:
+                flat = flat + self._res_leaf(comp, i, g).reshape(-1)
+            m, err = self._int8_phase1(
+                self._pad_to(flat, n * chunk), chunk, axis_name, n,
+                jax.random.fold_in(key, i))
+            outs.append(m)
+            errs.append(err[:size])
+        if self.error_feedback:
+            res_leaves = jax.tree.leaves(comp["residual"])
+            new_comp["residual"] = jax.tree.unflatten(
+                jax.tree.structure(comp["residual"]),
+                [e.reshape(r.shape) for e, r in zip(errs, res_leaves)])
+        return treedef.unflatten(outs), new_comp

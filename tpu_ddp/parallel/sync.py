@@ -122,8 +122,20 @@ PART_TO_STRATEGY = {
 
 
 def canonical_strategy(name: str) -> str:
-    """Resolve a part alias ('part4') to its strategy name ('zero')."""
-    return PART_TO_STRATEGY.get(name, name)
+    """Resolve a part alias ('part4') to its strategy name ('zero').
+
+    An unknown ``part*`` name raises immediately: passing it through
+    (the old behavior) deferred the failure to ``get_sync_strategy``'s
+    dict lookup — or, worse, to a caller that only compares the
+    canonical name and silently treated 'part9' as a no-sync strategy.
+    """
+    if name in PART_TO_STRATEGY:
+        return PART_TO_STRATEGY[name]
+    if name.startswith("part"):
+        raise ValueError(
+            f"unknown part alias {name!r}; available parts: "
+            f"{sorted(PART_TO_STRATEGY)}")
+    return name
 
 
 def get_sync_strategy(name: str):

@@ -116,7 +116,7 @@ def quarantine_checkpoint(path: str) -> str | None:
 
 
 def restore_newest_verified(directory: str, template,
-                            log=print) -> tuple:
+                            log=print, drop_extra: tuple = ()) -> tuple:
     """Restore the newest checkpoint that passes digest verification.
 
     Walks steps newest-first; a checkpoint that fails verification or
@@ -138,7 +138,8 @@ def restore_newest_verified(directory: str, template,
             # verify=False: every leaf was just hashed by
             # verify_checkpoint — don't pay for the digests twice.
             return ckpt.restore_checkpoint(directory, template, step,
-                                           verify=False)
+                                           verify=False,
+                                           drop_extra=drop_extra)
         except CheckpointCorruptError as e:
             last_error = e
             q = quarantine_checkpoint(path)

@@ -46,6 +46,11 @@ class TrainState:
     params: Any
     opt_state: Any
     step: int = 0
+    # Gradient-compression carry (parallel/compress.py): None unless the
+    # compressor is stateful (int8's stochastic-rounding seed counter +
+    # error-feedback residual). Threaded through the jitted step, donated
+    # with params/opt_state, checkpointed, reset on restore-mismatch.
+    comp_state: Any = None
 
 
 class _LossWindow:
@@ -196,6 +201,36 @@ class Trainer:
             from tpu_ddp.parallel.zero import ZeRO3
             self.zero3 = ZeRO3(self.optimizer, DATA_AXIS, self._dp,
                                template=self._params_template())
+        # Gradient wire compression (parallel/compress.py). Wraps any
+        # SYNCING rung; under 'none' (no sync) or without a dp>1 mesh
+        # there is no collective to compress, so the spec degrades to the
+        # no-op with a warning rather than silently changing semantics.
+        from tpu_ddp.parallel.compress import (REPLICATED_KINDS,
+                                               get_compressor)
+        self.compressor = get_compressor(self.config.grad_compress)
+        canon = canonical_strategy(strategy)
+        self._comp_active = (self.compressor.spec != "none"
+                             and mesh is not None and self._dp > 1
+                             and canon != "none")
+        if self.compressor.spec != "none" and not self._comp_active:
+            import warnings
+            warnings.warn(
+                f"grad_compress={self.compressor.spec!r} needs a dp>1 "
+                "mesh and a syncing strategy (got "
+                f"strategy={strategy!r}, dp={self._dp}); compression "
+                "disabled.", stacklevel=2)
+            self.compressor = get_compressor("none")
+        self._comp_stateful = (self._comp_active
+                               and self.compressor.stateful)
+        self._comp_kind = canon if canon in REPLICATED_KINDS else None
+        if self._comp_stateful:
+            self._comp_template = self.compressor.init_state(
+                self._params_template(), self._dp, abstract=True)
+            self._comp_specs = self.compressor.state_specs(
+                self._comp_template)
+        else:
+            self._comp_template = None
+            self._comp_specs = None
         if mesh is not None:
             self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
             self._repl_sharding = NamedSharding(mesh, P())
@@ -249,7 +284,21 @@ class Trainer:
             params = jax.device_put(params, self._param_put_sharding)
             opt_state = jax.device_put(opt_state,
                                        self._opt_shardings(opt_state))
-        return TrainState(params=params, opt_state=opt_state)
+        comp_state = None
+        if self._comp_stateful:
+            comp_state = self.compressor.init_state(
+                self._params_template(), self._dp, seed=seed)
+            comp_state = jax.device_put(comp_state,
+                                        self._comp_shardings())
+        return TrainState(params=params, opt_state=opt_state,
+                          comp_state=comp_state)
+
+    def _comp_shardings(self):
+        """NamedShardings for the compressor carry: seed replicated,
+        residual leaves dp-sharded on their leading axis."""
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                            self._comp_specs,
+                            is_leaf=lambda x: isinstance(x, P))
 
     # ---- checkpoint / resume (no reference equivalent, SURVEY.md §5) ---
 
@@ -267,6 +316,13 @@ class Trainer:
         synchronously on every process."""
         params = state.params
         opt_state = state.opt_state
+        comp_state = state.comp_state
+        if comp_state is not None and self.mesh is not None:
+            # The error-feedback residual is dp-sharded (each device's
+            # own quantization error); gather before the process-0 gate.
+            from tpu_ddp.utils.checkpoint import gather_tree_to_host
+            comp_state = gather_tree_to_host(comp_state,
+                                             self._repl_sharding)
         if self.mesh is not None and (self.is_zero or self.is_fsdp):
             # ZeRO/FSDP shard state over dp; gather to host LEAF BY LEAF
             # before the process-0 gate (each gather is a collective
@@ -289,6 +345,11 @@ class Trainer:
         from tpu_ddp.utils import checkpoint as ckpt
         tree = {"params": params, "opt_state": opt_state,
                 "step": np.int64(state.step)}
+        if comp_state is not None:
+            # Saved ONLY when the compressor is stateful, so the plain
+            # layout stays byte-compatible with pre-compression
+            # checkpoints; restore tolerates either (reset on mismatch).
+            tree["comp_state"] = comp_state
         if background:
             if not hasattr(self, "_async_writer"):
                 self._async_writer = ckpt.AsyncCheckpointWriter()
@@ -328,13 +389,51 @@ class Trainer:
         opt_t = jax.eval_shape(inner.init, params_t)
         template = {"params": params_t, "opt_state": opt_t,
                     "step": np.int64(0)}
-        if step is None:
-            from tpu_ddp.resilience.integrity import \
-                restore_newest_verified
-            restored, _ = restore_newest_verified(directory, template)
+
+        def _restore(tmpl, drop_extra=()):
+            if step is None:
+                from tpu_ddp.resilience.integrity import \
+                    restore_newest_verified
+                restored, _ = restore_newest_verified(
+                    directory, tmpl, drop_extra=drop_extra)
+                return restored
+            restored, _ = ckpt.restore_checkpoint(directory, tmpl, step,
+                                                  drop_extra=drop_extra)
+            return restored
+
+        # Compression carry: restore it when this trainer carries one
+        # and the checkpoint has a MATCHING one; on any mismatch —
+        # checkpoint without comp_state, different dp, different
+        # residual layout — fall back to the base tree and RESET the
+        # carry (zero residual, fresh seed). The error-feedback residual
+        # is an optimization accelerator, not model state: resetting
+        # costs a few re-absorbed quantization errors, never
+        # correctness. Symmetrically, a compression-less trainer drops a
+        # checkpoint's comp_state leaves instead of refusing the file.
+        comp_state = None
+        if self._comp_stateful:
+            comp_t = self.compressor.init_state(
+                params_t, self._dp, seed=self.config.seed,
+                abstract=True)
+            try:
+                restored = _restore({**template, "comp_state": comp_t})
+                comp_state = restored["comp_state"]
+            except (KeyError, ValueError):
+                import warnings
+                warnings.warn(
+                    "checkpoint has no matching comp_state (different "
+                    "dp, layout, or a pre-compression run); resetting "
+                    "the error-feedback residual to zeros.", stacklevel=2)
+                restored = _restore(template,
+                                    drop_extra=("comp_state",))
+                comp_state = self.compressor.init_state(
+                    params_t, self._dp, seed=self.config.seed)
         else:
-            restored, _ = ckpt.restore_checkpoint(directory, template,
-                                                  step)
+            try:
+                restored = _restore(template)
+            except (KeyError, ValueError):
+                restored = _restore(template,
+                                    drop_extra=("comp_state",))
         params, opt_state = restored["params"], restored["opt_state"]
         if self.is_zero:
             opt_state = self.optimizer.flatten_opt(opt_state)
@@ -345,8 +444,12 @@ class Trainer:
             params = jax.device_put(params, self._param_put_sharding)
             opt_state = jax.device_put(opt_state,
                                        self._opt_shardings(opt_state))
+        if comp_state is not None:
+            comp_state = jax.device_put(comp_state,
+                                        self._comp_shardings())
         return TrainState(params=params, opt_state=opt_state,
-                          step=int(restored["step"]))
+                          step=int(restored["step"]),
+                          comp_state=comp_state)
 
     # ---- train step ----------------------------------------------------
 
@@ -406,10 +509,15 @@ class Trainer:
                 select_update(bad, opt_state, new_opt),
                 bad.astype(jnp.float32))
 
-    def _base_step(self, params, opt_state, images, labels, weights):
+    def _base_step(self, params, opt_state, images, labels, weights,
+                   comp=None):
         images = self._maybe_normalize(images)
 
         if self.is_fsdp:
+            if self._comp_active:
+                return self._fsdp_compressed_step(
+                    params, opt_state, images, labels, weights, comp)
+
             def loss_fn(flat):
                 # all_gather materializes full params transiently; its
                 # AD transpose reduce-scatters the cotangent, delivering
@@ -435,43 +543,113 @@ class Trainer:
             params, opt_state, skipped = self._guarded_apply(
                 params, opt_state, loss, grads,
                 lambda: self.zero3.apply(params, grads, opt_state))
-            return params, opt_state, loss, skipped
+            return params, opt_state, loss, skipped, None
 
         def loss_fn(p):
             return self._loss_terms(self.model.apply(p, images),
                                     labels, weights)
 
         (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        # Under ZeRO sync_fn is the identity: the optimizer's own
-        # reduce_scatter + all_gather pair performs the synchronization.
-        grads = self.sync_fn(grads, DATA_AXIS) if self.mesh is not None \
-            else self.sync_fn(grads)
+        new_comp = None
+        if self._comp_active and not self.is_zero:
+            # Compressed replicated rungs: the compressor IS the sync.
+            # The guard flag must come from the PRE-compression local
+            # grads — a NaN can vanish through the int8 cast, and the
+            # error-feedback carry must roll back on a skipped step.
+            guard_grads = grads
+            grads, new_comp = self.compressor.sync_replicated(
+                self._comp_kind, grads, comp, DATA_AXIS, self._dp)
+        else:
+            # Under ZeRO sync_fn is the identity: the optimizer's own
+            # reduce_scatter + all_gather pair performs the
+            # synchronization.
+            grads = self.sync_fn(grads, DATA_AXIS) \
+                if self.mesh is not None else self.sync_fn(grads)
+            guard_grads = grads
         if self.is_zero:
             # Clip (if any) happens on the wrapper's dp-scattered slices
             # — the only place the synced gradient values exist. The
             # guard flag, by contrast, must come from the PRE-scatter
             # local grads (sync_fn is identity here) psum'd across dp —
             # a rank-local decision would diverge the replicas.
-            params, opt_state, skipped = self._guarded_apply(
-                params, opt_state, loss, grads,
-                lambda: self.optimizer.apply(
-                    params, grads, opt_state,
-                    clip_norm=self.clip_grad_norm))
-            return params, opt_state, loss, skipped
+            if self._comp_active:
+                # Compressed ZeRO: the compressor's phase-1 all_to_all
+                # replaces the wrapper's psum_scatter, delivering the
+                # dp-scattered fp32 MEAN slices apply_scattered expects.
+                g_sh, new_comp = self.compressor.scatter_mean(
+                    grads, comp, DATA_AXIS, self._dp)
+                params, opt_state, skipped = self._guarded_apply(
+                    params, opt_state, loss, grads,
+                    lambda: self.optimizer.apply_scattered(
+                        params, g_sh, opt_state,
+                        clip_norm=self.clip_grad_norm))
+            else:
+                params, opt_state, skipped = self._guarded_apply(
+                    params, opt_state, loss, grads,
+                    lambda: self.optimizer.apply(
+                        params, grads, opt_state,
+                        clip_norm=self.clip_grad_norm))
+            new_comp = self._comp_rollback(skipped, comp, new_comp)
+            return params, opt_state, loss, skipped, new_comp
         if self.clip_grad_norm is not None:
             # Replicated rungs: grads are identical on every replica
-            # after sync, so the local squared sum IS the global one.
-            # (Under strategy 'none' each replica clips by its own
-            # norm — consistent with that rung's no-sync semantics.)
+            # after sync (compressed or not — the compressed mean is
+            # all_gathered, so every replica holds the same bytes), so
+            # the local squared sum IS the global one. (Under strategy
+            # 'none' each replica clips by its own norm — consistent
+            # with that rung's no-sync semantics.)
             from tpu_ddp.ops.optim import clip_scale_from_sq, clip_tree
             sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                      for g in jax.tree.leaves(grads))
             grads = clip_tree(grads,
                               clip_scale_from_sq(sq, self.clip_grad_norm))
         params, opt_state, skipped = self._guarded_apply(
-            params, opt_state, loss, grads,
+            params, opt_state, loss, guard_grads,
             lambda: self.optimizer.apply(params, grads, opt_state))
-        return params, opt_state, loss, skipped
+        new_comp = self._comp_rollback(skipped, comp, new_comp)
+        return params, opt_state, loss, skipped, new_comp
+
+    def _comp_rollback(self, skipped, comp, new_comp):
+        """A skipped (guarded) step must not consume the compression
+        carry: the residual would otherwise absorb a gradient that was
+        never applied, and the seed would advance — select the OLD carry
+        back so the skip stays an exact no-op."""
+        if new_comp is None:
+            return None
+        return select_update(skipped > 0, comp, new_comp)
+
+    def _fsdp_compressed_step(self, params, opt_state, images, labels,
+                              weights, comp):
+        """FSDP with a compressed wire: the param all_gather moves
+        OUTSIDE the differentiated function, so the gradient arrives as
+        full canonical leaves LOCALLY (no f32 reduce_scatter from the AD
+        transpose) and the compressor's phase-1 all_to_all performs the
+        folded reduce_scatter at the reduced dtype. The parameter
+        all_gather itself stays fp32 — parameters, not gradients, and
+        out of this layer's scope (docs/DESIGN.md §14)."""
+        full = self.zero3.gather_params(params)
+
+        def loss_fn(p):
+            return self._loss_terms(self.model.apply(p, images),
+                                    labels, weights)
+
+        (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(full)
+        g_sh, new_comp = self.compressor.scatter_mean(
+            grads, comp, DATA_AXIS, self._dp)
+        if self.clip_grad_norm is not None:
+            # The scattered mean slices hold distinct elements per
+            # device: psum the squared sums for the exact global norm.
+            from tpu_ddp.ops.optim import clip_scale_from_sq, clip_tree
+            sq = lax.psum(
+                sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(g_sh)),
+                DATA_AXIS)
+            g_sh = clip_tree(g_sh,
+                             clip_scale_from_sq(sq, self.clip_grad_norm))
+        params, opt_state, skipped = self._guarded_apply(
+            params, opt_state, loss, grads,
+            lambda: self.zero3.apply(params, g_sh, opt_state))
+        new_comp = self._comp_rollback(skipped, comp, new_comp)
+        return params, opt_state, loss, skipped, new_comp
 
     def _build_train_step(self) -> Callable:
         # The step returns (params, opt_state, loss, fused) where
@@ -482,15 +660,41 @@ class Trainer:
         # its public per-replica shape for train_step's callers.
         if self.mesh is None:
             def base(params, opt_state, images, labels, weights):
-                params, opt_state, loss, skipped = self._base_step(
+                params, opt_state, loss, skipped, _ = self._base_step(
                     params, opt_state, images, labels, weights)
                 fused = jnp.stack([loss.astype(jnp.float32), skipped])
                 return params, opt_state, loss, fused
 
             return jax.jit(base, donate_argnums=(0, 1))
 
+        opt_spec = self._opt_spec()
+        param_spec = self._param_spec()
+
+        if self._comp_stateful:
+            # Stateful compression (int8): the carry threads through the
+            # jitted step as a third donated argument — the residual is
+            # param-sized, so donation keeps one buffer alive, not two.
+            def comp_body(params, opt_state, comp, images, labels,
+                          weights):
+                params, opt_state, loss, skipped, comp = self._base_step(
+                    params, opt_state, images, labels, weights, comp)
+                fused = jnp.stack([loss.astype(jnp.float32),
+                                   skipped]).reshape(1, 2)
+                return params, opt_state, comp, loss.reshape(1), fused
+
+            mapped = jax.shard_map(
+                comp_body,
+                mesh=self.mesh,
+                in_specs=(param_spec, opt_spec, self._comp_specs,
+                          P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(param_spec, opt_spec, self._comp_specs,
+                           P(DATA_AXIS), P(DATA_AXIS)),
+                check_vma=False,
+            )
+            return jax.jit(mapped, donate_argnums=(0, 1, 2))
+
         def sharded_body(params, opt_state, images, labels, weights):
-            params, opt_state, loss, skipped = self._base_step(
+            params, opt_state, loss, skipped, _ = self._base_step(
                 params, opt_state, images, labels, weights)
             # Per-replica scalar -> (1,) so out_spec P(dp) stacks to (dp,):
             # each node keeps printing ITS shard's running loss, as in the
@@ -502,8 +706,6 @@ class Trainer:
                                skipped]).reshape(1, 2)
             return params, opt_state, loss.reshape(1), fused
 
-        opt_spec = self._opt_spec()
-        param_spec = self._param_spec()
         mapped = jax.shard_map(
             sharded_body,
             mesh=self.mesh,
@@ -513,6 +715,19 @@ class Trainer:
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def lower_train_step(self, state: TrainState, images, labels,
+                         weights):
+        """``jit.lower`` the compiled train step with ``state`` —
+        signature-agnostic (the stateful-compression step takes the
+        carry as a third argument). Used by the HLO inspection tooling
+        (scripts/comm_volume.py, utils/hlo_comm.py)."""
+        if self._comp_stateful:
+            return self._train_step.lower(
+                state.params, state.opt_state, state.comp_state,
+                images, labels, weights)
+        return self._train_step.lower(state.params, state.opt_state,
+                                      images, labels, weights)
 
     def build_multi_step(self, k: int):
         """Compile a K-steps-per-dispatch train call: ``fn(state, xs,
@@ -531,15 +746,16 @@ class Trainer:
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
 
-        def scan_body(params, opt_state, xs, ys, ws):
+        def scan_body(params, opt_state, comp, xs, ys, ws):
             def step(carry, xyw):
-                p, o = carry
-                p, o, loss, skipped = self._base_step(p, o, *xyw)
-                return (p, o), (loss, skipped)
+                p, o, c = carry
+                p, o, loss, skipped, c = self._base_step(p, o, *xyw,
+                                                         comp=c)
+                return (p, o, c), (loss, skipped)
 
-            (params, opt_state), (losses, skips) = lax.scan(
-                step, (params, opt_state), (xs, ys, ws))
-            return params, opt_state, losses, skips
+            (params, opt_state, comp), (losses, skips) = lax.scan(
+                step, (params, opt_state, comp), (xs, ys, ws))
+            return params, opt_state, comp, losses, skips
 
         # As in _build_train_step, the per-step [loss, skipped] pairs are
         # fused into ONE device array — (k, 2) without a mesh, global
@@ -547,17 +763,36 @@ class Trainer:
         # single fetch.
         if self.mesh is None:
             def body(params, opt_state, xs, ys, ws):
-                params, opt_state, losses, skips = scan_body(
-                    params, opt_state, xs, ys, ws)
+                params, opt_state, _, losses, skips = scan_body(
+                    params, opt_state, None, xs, ys, ws)
                 fused = jnp.stack([losses.astype(jnp.float32), skips],
                                   axis=-1)
                 return params, opt_state, losses, fused
 
             fn = jax.jit(body, donate_argnums=(0, 1))
+        elif self._comp_stateful:
+            def comp_sharded_body(params, opt_state, comp, xs, ys, ws):
+                params, opt_state, comp, losses, skips = scan_body(
+                    params, opt_state, comp, xs, ys, ws)
+                fused = jnp.stack(
+                    [losses.astype(jnp.float32).reshape(k, 1),
+                     skips.reshape(k, 1)], axis=-1)  # (k, 1, 2)
+                return (params, opt_state, comp, losses.reshape(k, 1),
+                        fused)
+
+            b = P(None, DATA_AXIS)
+            mapped = jax.shard_map(
+                comp_sharded_body, mesh=self.mesh,
+                in_specs=(self._param_spec(), self._opt_spec(),
+                          self._comp_specs, b, b, b),
+                out_specs=(self._param_spec(), self._opt_spec(),
+                           self._comp_specs, b, P(None, DATA_AXIS)),
+                check_vma=False)
+            fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
         else:
             def sharded_body(params, opt_state, xs, ys, ws):
-                params, opt_state, losses, skips = scan_body(
-                    params, opt_state, xs, ys, ws)
+                params, opt_state, _, losses, skips = scan_body(
+                    params, opt_state, None, xs, ys, ws)
                 fused = jnp.stack(
                     [losses.astype(jnp.float32).reshape(k, 1),
                      skips.reshape(k, 1)], axis=-1)  # (k, 1, 2)
@@ -575,13 +810,20 @@ class Trainer:
         def run(state: TrainState, xs, ys, ws=None):
             if ws is None:
                 ws = jnp.ones(xs.shape[:2], jnp.float32)
-            params, opt_state, losses, fused = fn(
-                state.params, state.opt_state, xs, ys, ws)
+            if self._comp_stateful:
+                params, opt_state, comp, losses, fused = fn(
+                    state.params, state.opt_state, state.comp_state,
+                    xs, ys, ws)
+            else:
+                comp = state.comp_state
+                params, opt_state, losses, fused = fn(
+                    state.params, state.opt_state, xs, ys, ws)
             # The fused bundle rides on the side (run keeps its public
             # (state, losses) shape); the epoch loop harvests it for
             # loss/skip accounting with one fetch.
             self._last_fused = fused
-            return TrainState(params, opt_state, state.step + k), losses
+            return TrainState(params, opt_state, state.step + k,
+                              comp), losses
 
         return run
 
@@ -619,12 +861,19 @@ class Trainer:
         (see _build_train_step)."""
         if weights is None:
             weights = jnp.ones((images.shape[0],), jnp.float32)
-        params, opt_state, loss, fused = self._train_step(
-            state.params, state.opt_state, images, labels, weights)
+        if self._comp_stateful:
+            params, opt_state, comp, loss, fused = self._train_step(
+                state.params, state.opt_state, state.comp_state,
+                images, labels, weights)
+        else:
+            comp = state.comp_state
+            params, opt_state, loss, fused = self._train_step(
+                state.params, state.opt_state, images, labels, weights)
         # Stashed for last_step_skipped (the public train_step keeps
         # its (state, loss) shape).
         self._last_fused = fused
-        return TrainState(params, opt_state, state.step + 1), loss, fused
+        return TrainState(params, opt_state, state.step + 1,
+                          comp), loss, fused
 
     def train_step(self, state: TrainState, images, labels,
                    weights=None) -> tuple:

@@ -216,7 +216,8 @@ def latest_step(directory: str) -> int | None:
 
 
 def restore_checkpoint(directory: str, template, step: int | None = None,
-                       verify: bool = True):
+                       verify: bool = True,
+                       drop_extra: tuple = ()):
     """Restore into the structure of ``template``; returns ``(state, step)``.
 
     ``template`` supplies the pytree structure (and is typically a freshly
@@ -231,6 +232,13 @@ def restore_checkpoint(directory: str, template, step: int | None = None,
     naming the checkpoint path, so callers can tell "this checkpoint is
     damaged" apart from "this checkpoint is for a different model"
     (which stays ``ValueError``/``KeyError``).
+
+    ``drop_extra`` names top-level path prefixes whose saved leaves are
+    IGNORED (e.g. ``("comp_state",)`` lets a compression-less trainer
+    read a checkpoint that carries an error-feedback residual). The
+    remaining saved leaves must then match the template leaf-for-leaf —
+    each surviving key's path is checked against the template's, which
+    is stricter than the default count-only structural check.
     """
     from tpu_ddp.resilience.integrity import (CheckpointCorruptError,
                                               leaf_digest)
@@ -262,13 +270,35 @@ def restore_checkpoint(directory: str, template, step: int | None = None,
     with npz_cm as npz:
         paths_and_leaves, treedef = \
             jax.tree_util.tree_flatten_with_path(template)
-        if len(paths_and_leaves) != len(manifest["leaves"]):
+        saved_keys = None
+        if drop_extra:
+            def _dropped(key: str) -> bool:
+                leaf_path = key.split(":", 1)[1]
+                return any(leaf_path == p or leaf_path.startswith(p + ".")
+                           for p in drop_extra)
+            saved_keys = [k for k in manifest["leaves"]
+                          if not _dropped(k)]
+            if len(paths_and_leaves) != len(saved_keys):
+                raise ValueError(
+                    f"checkpoint has {len(saved_keys)} leaves after "
+                    f"dropping {drop_extra}, template has "
+                    f"{len(paths_and_leaves)} — structures differ")
+        elif len(paths_and_leaves) != len(manifest["leaves"]):
             raise ValueError(
                 f"checkpoint has {len(manifest['leaves'])} leaves, "
                 f"template has {len(paths_and_leaves)} — structures differ")
         restored = []
         for i, (tree_path, leaf) in enumerate(paths_and_leaves):
-            key = _leaf_key(i, tree_path)
+            if saved_keys is not None:
+                key = saved_keys[i]
+                want_path = jax.tree_util.keystr(tree_path, simple=True,
+                                                 separator=".")
+                if key.split(":", 1)[1] != want_path:
+                    raise KeyError(
+                        f"leaf {want_path!r} of the template aligns to "
+                        f"saved leaf {key!r} — structure mismatch")
+            else:
+                key = _leaf_key(i, tree_path)
             if key not in npz:
                 raise KeyError(
                     f"leaf {key!r} missing from checkpoint {path!r} "

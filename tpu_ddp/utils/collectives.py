@@ -51,24 +51,39 @@ def _ops(axis: str, n: int):
 
 
 def bench_collectives(mesh: Mesh, mb: float = 4.0, iters: int = 10,
-                      axis: str = DATA_AXIS) -> dict:
+                      axis: str = DATA_AXIS,
+                      dtype: str = "float32") -> dict:
     """Time each collective on ``mesh``'s ``axis``; returns a dict
     ``{op: {"ms": avg_ms, "gbps": payload_gb_per_s}}``.
 
-    ``mb`` is the per-device payload in MiB (float32). Runs anywhere a
-    mesh exists — on the virtual CPU mesh the numbers are only useful
+    ``mb`` is the per-device payload in MiB of ``dtype`` — the element
+    count scales with the itemsize, so ``dtype="int8"`` times the same
+    BYTES through 4x the elements, which is exactly the compressed-wire
+    question (parallel/compress.py ships gradients as s8/u16): does the
+    fabric move reduced-dtype payloads at the same line rate? Bandwidth
+    is computed from the actual itemsize. Integer dtypes skip nothing:
+    psum/psum_scatter reduce integers exactly. Runs anywhere a mesh
+    exists — on the virtual CPU mesh the numbers are only useful
     relative to each other; on real chips they expose the ICI.
     """
     n = mesh.shape[axis]
     if n < 2:
         raise ValueError(f"axis {axis!r} has size {n}; need >= 2 devices "
                          "to move bytes")
-    n_elems = int(mb * (1 << 20) / 4)
+    # jnp resolves names numpy alone does not know (e.g. "bfloat16").
+    dt = jnp.dtype(dtype)
+    itemsize = dt.itemsize
+    n_elems = int(mb * (1 << 20) / itemsize)
     n_elems -= n_elems % n  # divisible for the reshaping ops
-    bytes_payload = n_elems * 4
+    bytes_payload = n_elems * itemsize
 
-    host = np.random.default_rng(0).normal(size=(n * n_elems,)) \
-        .astype(np.float32)
+    rng = np.random.default_rng(0)
+    if jnp.issubdtype(dt, jnp.integer):
+        info = jnp.iinfo(dt)
+        host = rng.integers(info.min, info.max + 1, size=(n * n_elems,)) \
+            .astype(dt)
+    else:
+        host = rng.normal(size=(n * n_elems,)).astype(dt)
     # Shard the payload over the SAME axis the collectives run on
     # (other mesh axes replicate), or the measurement is meaningless.
     x = jax.device_put(host, NamedSharding(mesh, P(axis)))
@@ -111,12 +126,17 @@ def main(argv=None) -> int:
     ap.add_argument("--mb", type=float, default=4.0,
                     help="per-device payload in MiB")
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--dtype", default="float32",
+                    help="payload dtype (float32, bfloat16, int8, ... — "
+                         "compressed-wire microbenchmarks)")
     args = ap.parse_args(argv)
     mesh = make_mesh()
     out = {"devices": int(np.prod(list(mesh.shape.values()))),
            "platform": jax.devices()[0].platform,
            "payload_mib": args.mb,
-           "collectives": bench_collectives(mesh, args.mb, args.iters)}
+           "dtype": args.dtype,
+           "collectives": bench_collectives(mesh, args.mb, args.iters,
+                                            dtype=args.dtype)}
     print(json.dumps(out))
     return 0
 

@@ -84,6 +84,15 @@ class TrainConfig:
     # is active and inside the timing window). Env: TPU_DDP_DISPATCH_DEPTH.
     dispatch_depth: int = 2
 
+    # Gradient wire compression (tpu_ddp/parallel/compress.py): the
+    # dtype gradients travel the sync collectives at. "none" (fp32
+    # baseline), "bf16" (cast before, fp32-accumulate after — 2x fewer
+    # wire bytes), "int8" (blockwise quantization with error-feedback
+    # residual — ~4x) or "int8-noef" (ablation without the residual).
+    # Env: TPU_DDP_GRAD_COMPRESS. Requires a dp>1 mesh and a syncing
+    # strategy; degrades to "none" with a warning otherwise.
+    grad_compress: str = "none"
+
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
     max_iters: int | None = None
@@ -129,6 +138,17 @@ class TrainConfig:
             raise ValueError(
                 f"dispatch_depth must be >= 0, got {self.dispatch_depth} "
                 "(0 = synchronous loop)")
+        env_gc = os.environ.get("TPU_DDP_GRAD_COMPRESS")
+        if env_gc:
+            self.grad_compress = env_gc
+        # Mirrors parallel/compress.py SPECS (the source of truth, which
+        # re-validates); duplicated so a bad env/config fails HERE with
+        # the flag name, not deep inside Trainer construction.
+        if self.grad_compress not in ("none", "bf16", "int8",
+                                      "int8-noef"):
+            raise ValueError(
+                f"grad_compress={self.grad_compress!r}: expected "
+                "none|bf16|int8|int8-noef (TPU_DDP_GRAD_COMPRESS)")
         # f32 end-to-end runs turn the bf16-rounding drift story into a
         # measurement (run_experiments --dtype float32): bit-equivalent
         # programs must then agree to f32 reduction-order tolerance.
