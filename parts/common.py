@@ -125,6 +125,14 @@ def run_part(part: str, argv=None):
     mesh = make_mesh() if distributed else None
     dp_size = mesh.shape["dp"] if mesh is not None else 1
 
+    # Autotuning (tpu_ddp/tune/): resolve BEFORE get_model so tuned
+    # model-level knobs (pallas_bn, compute_dtype) reach construction.
+    # batch_size above is safe — global_batch_size is never searched.
+    if cfg.autotune != "off":
+        from tpu_ddp import tune
+        cfg = tune.resolve(cfg, strategy=PART_TO_STRATEGY[part],
+                           mesh=mesh)
+
     # TPU_DDP_SHARD_EVAL=1: process-sharded test set + dp-psum'd eval
     # (1/N per-device eval compute) instead of the reference's
     # every-node-evaluates-everything semantics. CIFAR path only — the

@@ -1,0 +1,112 @@
+"""Autotune sweep: tuned-vs-default steps/sec per bench family.
+
+Runs the measured-trial tuner (``tpu_ddp/tune/``) over each requested
+preset family — the ISSUE-4 resnet50 re-tune plus the vgg11 control —
+and commits what it finds: default vs tuned steps/sec, the chosen knob
+values, trial/quarantine counts, and the search mode. Cache-free
+(``tune.tuned_vs_default``), so the artifact records what the search
+measures on THIS host today, not a stale entry.
+
+The committed ``experiments/autotune.json`` is the evidence for two
+claims: the regression guard holds (tuned >= default for every family,
+equal allowed), and the knob space's winners are workload-dependent
+(what vgg11's hand-tuned defaults already get right, resnet50's may
+not — the motivation in ISSUE 4).
+
+Usage: JAX_PLATFORMS=cpu python scripts/autotune_sweep.py
+       python scripts/autotune_sweep.py --families resnet50_imagenet \
+           --iters 8 --batch-size 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--families",
+                    default="vgg11_cifar10,resnet50_imagenet",
+                    help="comma-separated preset names to tune")
+    ap.add_argument("--iters", type=int, default=8,
+                    help="batches per trial epoch")
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="override the preset's global batch (CPU "
+                         "hosts need small ones; a real chip should "
+                         "tune at the production batch)")
+    ap.add_argument("--max-trials", type=int, default=32)
+    ap.add_argument("--timeout-s", type=float, default=300.0,
+                    help="per-trial wall ceiling")
+    ap.add_argument("--out", default=None,
+                    help="output JSON path (default "
+                         "experiments/autotune.json)")
+    args = ap.parse_args(argv)
+    families = [f for f in args.families.split(",") if f]
+
+    import jax
+
+    from tpu_ddp import tune
+
+    if args.batch_size is not None:
+        # The batch override must flow through the SAME path a user's
+        # would (TrainConfig.__post_init__), keeping the fingerprint
+        # honest about what was actually tuned.
+        os.environ["TPU_DDP_GLOBAL_BATCH"] = str(args.batch_size)
+
+    results = {}
+    for family in families:
+        print(f"=== tuning {family} ===", flush=True)
+        try:
+            cell = tune.tuned_vs_default(
+                family, n_batches=args.iters,
+                max_trials=args.max_trials, timeout_s=args.timeout_s,
+                log=lambda s: print(s, flush=True))
+            if cell["default_steps_per_sec"] and \
+                    cell["tuned_steps_per_sec"]:
+                cell["speedup"] = round(cell["tuned_steps_per_sec"]
+                                        / cell["default_steps_per_sec"],
+                                        3)
+        except Exception as e:  # noqa: BLE001 — record, keep sweeping
+            cell = {"error": f"{type(e).__name__}: {e}"}
+        results[family] = cell
+
+    record = {
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "iters_per_trial": args.iters,
+        "batch_size_override": args.batch_size,
+        "families": results,
+    }
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "experiments", "autotune.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+
+    print(f"\nwrote {out}\n")
+    print("| family | default steps/s | tuned steps/s | speedup "
+          "| overrides | trials (quarantined) |")
+    print("|---|---:|---:|---:|---|---:|")
+    for family, cell in results.items():
+        if "error" in cell:
+            print(f"| {family} | — | — | — | error: {cell['error']} "
+                  "| — |")
+            continue
+        print(f"| {family} | {cell['default_steps_per_sec']} "
+              f"| {cell['tuned_steps_per_sec']} "
+              f"| {cell.get('speedup', '—')} "
+              f"| `{json.dumps(cell['overrides'], sort_keys=True)}` "
+              f"| {cell['trials']} ({cell['quarantined']}) |")
+    return record
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
@@ -34,6 +33,7 @@ def measure(pp: int, num_micro: int, schedule: str, seq_len: int = 128,
     from tpu_ddp.models.transformer import make_transformer
     from tpu_ddp.parallel.mesh import make_mesh
     from tpu_ddp.train.lm import PipelineLMTrainer, make_lm_batch
+    from tpu_ddp.utils.timing import warm_then_median_s
 
     if batch is None:
         batch = 2 * num_micro  # 2 examples per microbatch
@@ -58,13 +58,16 @@ def measure(pp: int, num_micro: int, schedule: str, seq_len: int = 128,
     except Exception as e:  # noqa: BLE001 — record, don't die
         out["memory_analysis_error"] = f"{type(e).__name__}: {e}"
 
-    state, loss = tr.train_step(state, x, y)
-    np.asarray(loss)  # compile+warm
-    t0 = time.perf_counter()
-    for _ in range(iters):
+    # Shared warm+window helper (utils/timing.py, round-8
+    # consolidation): warm call compiles, one window, one sync at the
+    # window edge.
+    def timed_step():
+        nonlocal state
         state, loss = tr.train_step(state, x, y)
-    np.asarray(loss)
-    out["step_s"] = round((time.perf_counter() - t0) / iters, 4)
+        return loss
+
+    step_s, _ = warm_then_median_s(timed_step, iters=iters, windows=1)
+    out["step_s"] = round(step_s, 4)
     if schedule == "gpipe":
         out["bubble_frac"] = round((pp - 1) / (num_micro + pp - 1), 4)
     else:

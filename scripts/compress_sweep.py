@@ -33,7 +33,6 @@ import dataclasses
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
@@ -105,6 +104,7 @@ def run_combo(strategy, spec, xs, ys, n_devices):
     from tpu_ddp.parallel.mesh import make_mesh
     from tpu_ddp.train.engine import Trainer
     from tpu_ddp.utils.config import TrainConfig
+    from tpu_ddp.utils.timing import warm_then_median_s
     from tpu_ddp.utils.hlo_comm import (collective_dtype_bytes,
                                         collective_volume, train_step_hlo)
 
@@ -122,14 +122,14 @@ def run_combo(strategy, spec, xs, ys, n_devices):
         state, loss = tr.train_step(state, *tr.put_batch(x, y))
         losses.append(float(np.mean(np.asarray(loss))))
 
-    # steps/sec on the staged batch (no host put in the timed loop).
-    state, loss = tr.train_step(state, xb, yb, wb)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(TIME_STEPS):
+    # steps/sec on the staged batch (no host put in the timed loop);
+    # shared warm+window helper (utils/timing.py, round-8 consolidation).
+    def timed_step():
+        nonlocal state
         state, loss = tr.train_step(state, xb, yb, wb)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / TIME_STEPS
+        return loss
+
+    dt, _ = warm_then_median_s(timed_step, iters=TIME_STEPS, windows=1)
 
     final = float(np.mean(losses[-10:]))
     return {

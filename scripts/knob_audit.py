@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Cross-check the perf-knob surfaces: TrainConfig <-> env <-> launch <-> tune.
+
+The autotuner's registry (``tpu_ddp/tune/space.py``) claims that each
+knob's ``TrainConfig`` field, its ``TPU_DDP_*`` env var, and its
+``tpu_ddp.launch`` flag all name the same setting. Those surfaces live
+in three hand-written files (``utils/config.py``'s env block,
+``launch.py``'s argparse, the registry itself) and have no compiler
+keeping them honest — this audit is that compiler. CI runs it
+(``tests/test_knob_audit.py``); it fails loudly on ANY drift:
+
+1. a registry field that doesn't exist on ``TrainConfig``;
+2. a registry env var that ``TrainConfig.__post_init__`` doesn't
+   actually parse — checked BEHAVIORALLY (set the env, construct a
+   config, require the field to change), not by grepping, so a typo'd
+   ``os.environ.get`` key or a dead branch fails too;
+3. a field default outside the knob's candidate values (the search must
+   always be able to return "keep the default");
+4. a registry ``flag`` that ``launch.py`` doesn't define, or defines
+   without wiring to the registry's env var;
+5. a perf-knob ``TPU_DDP_*`` var parsed by ``utils/config.py`` with NO
+   registry entry — the drift that motivated this script: a new knob
+   must land in the search space, not beside it.
+
+Exit 0 and silence = all surfaces agree.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# TPU_DDP_* vars parsed by utils/config.py that are deliberately NOT
+# perf knobs (test caps, convergence hyperparameters, resilience
+# cadences, the autotuner's own mode switch). Anything config.py parses
+# beyond these must be in the registry.
+NONPERF_ENV = {
+    "TPU_DDP_MAX_ITERS", "TPU_DDP_LR", "TPU_DDP_CKPT_EVERY",
+    "TPU_DDP_CHECK_REPLICAS_EVERY", "TPU_DDP_GUARD",
+    "TPU_DDP_GUARD_MAX_BAD", "TPU_DDP_AUTOTUNE",
+}
+
+
+class _scrubbed_env:
+    """Temporarily clear every TPU_DDP_* var (the behavioral checks
+    must see ONLY the one they set), restoring on exit."""
+
+    def __init__(self, **set_vars):
+        self.set_vars = set_vars
+        self.saved: dict = {}
+
+    def __enter__(self):
+        for key in list(os.environ):
+            if key.startswith("TPU_DDP_"):
+                self.saved[key] = os.environ.pop(key)
+        os.environ.update(self.set_vars)
+        return self
+
+    def __exit__(self, *exc):
+        for key in list(os.environ):
+            if key.startswith("TPU_DDP_"):
+                del os.environ[key]
+        os.environ.update(self.saved)
+        return False
+
+
+def _launch_source() -> str:
+    import tpu_ddp.launch
+    with open(tpu_ddp.launch.__file__) as f:
+        return f.read()
+
+
+def _config_source() -> str:
+    import tpu_ddp.utils.config
+    with open(tpu_ddp.utils.config.__file__) as f:
+        return f.read()
+
+
+def audit(knobs=None) -> list[str]:
+    """Returns the list of drift findings (empty == all green).
+    ``knobs`` overrides the registry for the self-test that seeds a
+    deliberate drift."""
+    from tpu_ddp.tune.space import KNOBS
+    from tpu_ddp.utils.config import TrainConfig
+
+    knobs = KNOBS if knobs is None else knobs
+    problems: list[str] = []
+    with _scrubbed_env():
+        defaults = TrainConfig()
+
+    for knob in knobs:
+        # (1) field exists
+        if not hasattr(defaults, knob.field):
+            problems.append(
+                f"{knob.name}: registry field {knob.field!r} does not "
+                "exist on TrainConfig")
+            continue
+        default = getattr(defaults, knob.field)
+
+        # (3) default is a candidate (skip audit-only knobs: values=())
+        if knob.values and default not in knob.values:
+            problems.append(
+                f"{knob.name}: TrainConfig default {default!r} is not "
+                f"among the registry candidates {knob.values!r} — the "
+                "search could never return 'keep the default'")
+
+        # (2) env var actually parsed, behaviorally
+        probe = None
+        for v in knob.values:
+            if v != default:
+                probe = v
+                break
+        if probe is None and not knob.values:
+            # audit-only knob (e.g. global_batch_size): synthesize a
+            # probe off the default's type.
+            probe = default * 2 if isinstance(default, int) else None
+        if probe is not None:
+            with _scrubbed_env(**{knob.env: knob.encode(probe)}):
+                try:
+                    got = getattr(TrainConfig(), knob.field)
+                except Exception as e:  # noqa: BLE001 — report, don't die
+                    problems.append(
+                        f"{knob.name}: setting {knob.env}="
+                        f"{knob.encode(probe)!r} makes TrainConfig "
+                        f"raise {type(e).__name__}: {e}")
+                    got = default
+            if got != probe:
+                problems.append(
+                    f"{knob.name}: {knob.env}={knob.encode(probe)!r} "
+                    f"did not set TrainConfig.{knob.field} (got "
+                    f"{got!r}, wanted {probe!r}) — env var not parsed "
+                    "or parsed into a different field")
+
+        # (4) launch flag exists and wires to this env var
+        if knob.flag is not None:
+            src = _launch_source()
+            if f'"{knob.flag}"' not in src:
+                problems.append(
+                    f"{knob.name}: registry flag {knob.flag!r} is not "
+                    "defined by tpu_ddp/launch.py")
+            elif f'env["{knob.env}"]' not in src:
+                problems.append(
+                    f"{knob.name}: tpu_ddp/launch.py defines "
+                    f"{knob.flag!r} but never sets {knob.env!r} for "
+                    "the ranks")
+
+    # (5) reverse: every perf env var config.py parses has an entry
+    parsed = set(re.findall(r'"(TPU_DDP_[A-Z_]+)"', _config_source()))
+    registered = {k.env for k in knobs}
+    for env in sorted(parsed - NONPERF_ENV - registered):
+        problems.append(
+            f"utils/config.py parses {env} but tune/space.py has no "
+            "registry entry for it — new knobs must land in the search "
+            "space (add a Knob, or add the var to NONPERF_ENV with a "
+            "reason)")
+    return problems
+
+
+def main() -> int:
+    problems = audit()
+    if problems:
+        print(f"knob audit: {len(problems)} problem(s)")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print("knob audit: all surfaces agree "
+          "(TrainConfig <-> env <-> launch <-> tune/space.py)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

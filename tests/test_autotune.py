@@ -1,0 +1,553 @@
+"""Autotuner subsystem (tpu_ddp/tune/): space, cache, search, resolve.
+
+Fast by construction: search logic runs against fake evaluate functions
+(no compiles), the cache lifecycle against a tmp dir, and the constraint
+model against synthetic Workload contexts. The one real measured-trial
+search (the acceptance smoke: >=3 knobs, <120 s, cache hit on rerun)
+is ``slow``-marked.
+"""
+
+import dataclasses
+import json
+import os
+import time
+
+import pytest
+
+import tpu_ddp.tune as tune
+from tpu_ddp.tune import cache as tcache
+from tpu_ddp.tune.space import (KNOBS, Workload, fingerprint_for,
+                                parse_knob_filter, searchable_knobs,
+                                space_version, violations, workload_for)
+from tpu_ddp.tune.search import run_search
+from tpu_ddp.utils.config import TrainConfig
+from tpu_ddp.utils.timing import timed_window_s, warm_then_median_s
+
+
+CPU1 = Workload(platform="cpu", dp=1, processes=1, strategy="fused",
+                collective_cadence=False)
+
+
+@pytest.fixture()
+def cfg(monkeypatch):
+    for key in list(os.environ):
+        if key.startswith("TPU_DDP_"):
+            monkeypatch.delenv(key)
+    return TrainConfig()
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    d = tmp_path / "tune"
+    monkeypatch.setenv("TPU_DDP_TUNE_CACHE_DIR", str(d))
+    return d
+
+
+# ---------------------------------------------------------------- space
+
+class TestConstraints:
+    def test_default_assignment_feasible(self):
+        assert violations({"dispatch_depth": 2, "steps_per_dispatch": 1,
+                           "device_prefetch": 0}, CPU1) == []
+
+    def test_pallas_requires_tpu(self):
+        bad = violations({"pallas_sgd": True, "pallas_bn": True}, CPU1)
+        assert len(bad) == 2 and all("TPU" in b for b in bad)
+        tpu = dataclasses.replace(CPU1, platform="tpu")
+        assert violations({"pallas_sgd": True}, tpu) == []
+
+    def test_grad_compress_needs_dp_and_syncing_rung(self):
+        assert violations({"grad_compress": "int8"}, CPU1)
+        nosync = Workload(platform="tpu", dp=8, strategy="none")
+        assert violations({"grad_compress": "bf16"}, nosync)
+        ok = Workload(platform="tpu", dp=8, strategy="fused")
+        assert violations({"grad_compress": "bf16"}, ok) == []
+
+    def test_depth_vs_multiprocess_cadence(self):
+        ctx = Workload(platform="tpu", dp=8, processes=2,
+                       strategy="fused", collective_cadence=True)
+        assert violations({"dispatch_depth": 2}, ctx)
+        assert violations({"dispatch_depth": 0}, ctx) == []
+        one_proc = dataclasses.replace(ctx, processes=1)
+        assert violations({"dispatch_depth": 2}, one_proc) == []
+
+    def test_grouped_dispatch_fallback_cells(self):
+        assert violations({"steps_per_dispatch": 4,
+                           "device_prefetch": 2}, CPU1)
+        cad = dataclasses.replace(CPU1, collective_cadence=True)
+        assert violations({"steps_per_dispatch": 4}, cad)
+
+
+class TestSearchSpace:
+    def test_cpu_single_process_space_has_three_knobs(self, cfg):
+        # The acceptance floor: the vgg11 CPU smoke config must expose a
+        # >=3-knob search (pallas knobs are off-TPU, grad_compress has
+        # no dp>1 syncing rung -> both filtered by the constraints).
+        names = {k.name for k, _ in searchable_knobs(cfg, CPU1)}
+        assert names == {"dispatch_depth", "steps_per_dispatch",
+                         "device_prefetch"}
+
+    def test_current_value_listed_first(self, cfg):
+        cfg.dispatch_depth = 4
+        for knob, cands in searchable_knobs(cfg, CPU1):
+            assert cands[0] == getattr(cfg, knob.field)
+
+    def test_semantic_knobs_gated(self, cfg, monkeypatch):
+        base = {k.name for k, _ in searchable_knobs(cfg, CPU1)}
+        assert "compute_dtype" not in base
+        monkeypatch.setenv("TPU_DDP_TUNE_SEMANTIC", "1")
+        gated = {k.name for k, _ in searchable_knobs(cfg, CPU1)}
+        assert "compute_dtype" in gated
+        # global_batch_size stays out even then: audit-only (values=())
+        assert "global_batch_size" not in gated
+
+    def test_env_pinned_knob_excluded(self, cfg, monkeypatch):
+        monkeypatch.setenv("TPU_DDP_DISPATCH_DEPTH", "4")
+        names = {k.name for k, _ in searchable_knobs(cfg, CPU1)}
+        assert "dispatch_depth" not in names
+
+    def test_knob_filter_parsing(self):
+        only = parse_knob_filter("dispatch_depth=0|2, steps_per_dispatch")
+        assert only == {"dispatch_depth": (0, 2),
+                        "steps_per_dispatch": None}
+        assert parse_knob_filter("") is None
+        with pytest.raises(ValueError, match="unknown knob"):
+            parse_knob_filter("warp_speed")
+
+    def test_knob_filter_shrinks_space(self, cfg, monkeypatch):
+        monkeypatch.setenv("TPU_DDP_TUNE_KNOBS",
+                           "dispatch_depth=0|2,device_prefetch")
+        space = searchable_knobs(cfg, CPU1)
+        assert {k.name for k, _ in space} == {"dispatch_depth",
+                                              "device_prefetch"}
+        depth = dict((k.name, c) for k, c in space)["dispatch_depth"]
+        assert set(depth) == {0, 2} and depth[0] == 2  # current first
+
+    def test_space_version_tracks_registry(self, monkeypatch):
+        v0 = space_version()
+        import tpu_ddp.tune.space as space_mod
+        monkeypatch.setattr(space_mod, "KNOBS", KNOBS[:-1])
+        assert space_version() != v0
+
+
+class TestFingerprint:
+    def test_stable_and_discriminating(self, cfg):
+        fp1 = fingerprint_for(cfg, "fused", None)
+        fp2 = fingerprint_for(cfg, "fused", None)
+        assert fp1.key() == fp2.key()
+        bigger = TrainConfig(global_batch_size=512)
+        assert fingerprint_for(bigger, "fused", None).key() != fp1.key()
+        assert fingerprint_for(cfg, "zero", None).key() != fp1.key()
+
+    def test_workload_for_reads_runtime(self, cfg, devices):
+        ctx = workload_for(cfg, "part3", None)
+        assert ctx.platform == "cpu" and ctx.processes == 1
+        assert ctx.strategy == "fused"  # canonicalized alias
+        cfg.check_replicas_every = 5
+        assert workload_for(cfg, "fused", None).collective_cadence
+
+
+# ---------------------------------------------------------------- cache
+
+class TestCacheLifecycle:
+    def test_store_then_hit(self, cfg, cache_dir):
+        fp = fingerprint_for(cfg, "fused", None)
+        path = tcache.store(fp, {"dispatch_depth": 4},
+                            meta={"trials": 7})
+        hit = tcache.load(fp)
+        assert hit["overrides"] == {"dispatch_depth": 4}
+        assert hit["meta"]["trials"] == 7
+        assert hit["path"] == path
+
+    def test_absent_is_a_plain_miss(self, cfg, cache_dir):
+        assert tcache.load(fingerprint_for(cfg, "fused", None)) is None
+
+    def test_corrupt_entry_quarantined(self, cfg, cache_dir):
+        fp = fingerprint_for(cfg, "fused", None)
+        path = tcache.store(fp, {})
+        with open(path, "w") as f:
+            f.write("{truncated")
+        with pytest.warns(UserWarning, match="corrupt"):
+            assert tcache.load(fp) is None
+        assert not os.path.exists(path)
+        assert os.path.exists(path + ".corrupt")
+
+    def test_quarantine_never_overwrites_prior_evidence(self, cfg,
+                                                        cache_dir):
+        fp = fingerprint_for(cfg, "fused", None)
+        for _ in range(2):
+            path = tcache.store(fp, {})
+            with open(path, "w") as f:
+                f.write("not json")
+            with pytest.warns(UserWarning):
+                tcache.load(fp)
+        assert os.path.exists(path + ".corrupt")
+        assert os.path.exists(path + ".corrupt-2")
+
+    def test_fingerprint_mismatch_quarantined(self, cfg, cache_dir):
+        # A hand-copied entry sitting at another workload's key must be
+        # rejected: applying it would tune the wrong workload.
+        fp_a = fingerprint_for(cfg, "fused", None)
+        fp_b = fingerprint_for(TrainConfig(global_batch_size=512),
+                               "fused", None)
+        src = tcache.store(fp_a, {"dispatch_depth": 0})
+        os.makedirs(os.path.dirname(tcache.entry_path(fp_b)),
+                    exist_ok=True)
+        os.replace(src, tcache.entry_path(fp_b))
+        with pytest.warns(UserWarning, match="different fingerprint"):
+            assert tcache.load(fp_b) is None
+        assert os.path.exists(tcache.entry_path(fp_b) + ".corrupt")
+
+    def test_schema_bump_is_a_soft_miss(self, cfg, cache_dir):
+        fp = fingerprint_for(cfg, "fused", None)
+        path = tcache.store(fp, {"dispatch_depth": 0})
+        with open(path) as f:
+            payload = json.load(f)
+        payload["schema_version"] = tcache.SCHEMA_VERSION + 1
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        assert tcache.load(fp) is None
+        # NOT corruption: the stale file stays for the next store() to
+        # overwrite — no .corrupt sibling appears.
+        assert os.path.exists(path)
+        assert not os.path.exists(path + ".corrupt")
+
+    def test_unknown_override_keys_quarantined(self, cfg, cache_dir):
+        fp = fingerprint_for(cfg, "fused", None)
+        path = tcache.store(fp, {"retired_knob": 3})
+        with pytest.warns(UserWarning, match="outside the knob registry"):
+            assert tcache.load(fp) is None
+        assert os.path.exists(path + ".corrupt")
+
+
+# -------------------------------------------------------------- resolve
+
+class TestResolve:
+    def test_cached_mode_empty_cache_warns_and_defaults(self, cfg,
+                                                        cache_dir):
+        cfg.autotune = "cached"
+        lines = []
+        out = tune.resolve(cfg, strategy="fused", mesh=None,
+                           log=lines.append)
+        assert out.autotune == "off"
+        assert out.dispatch_depth == cfg.dispatch_depth
+        assert any("cached mode: no entry" in ln for ln in lines)
+
+    def test_cached_mode_applies_stored_overrides(self, cfg, cache_dir):
+        fp = fingerprint_for(cfg, "fused", None)
+        tcache.store(fp, {"dispatch_depth": 0, "steps_per_dispatch": 8})
+        cfg.autotune = "cached"
+        lines = []
+        out = tune.resolve(cfg, strategy="fused", mesh=None,
+                           log=lines.append)
+        assert (out.dispatch_depth, out.steps_per_dispatch) == (0, 8)
+        assert any("cache hit: trials=0" in ln for ln in lines)
+        assert cfg.dispatch_depth == 2  # original never mutated
+
+    def test_env_pin_beats_cached_override(self, cfg, cache_dir,
+                                           monkeypatch):
+        fp = fingerprint_for(cfg, "fused", None)
+        tcache.store(fp, {"dispatch_depth": 0})
+        monkeypatch.setenv("TPU_DDP_DISPATCH_DEPTH", "4")
+        cfg.dispatch_depth = 4  # what __post_init__ would have done
+        # Same fingerprint (depth is not in the fingerprint), but the
+        # explicit pin must survive the tuned override.
+        cfg.autotune = "cached"
+        lines = []
+        out = tune.resolve(cfg, strategy="fused", mesh=None,
+                           log=lines.append)
+        assert out.dispatch_depth == 4
+        assert any("pins the knob" in ln for ln in lines)
+
+    def test_model_built_drops_model_level_overrides(self, cfg):
+        out = tune.apply_overrides(
+            cfg, {"pallas_bn": True, "dispatch_depth": 0},
+            model_built=True, log=lambda s: None)
+        assert out.pallas_bn is False and out.dispatch_depth == 0
+        out2 = tune.apply_overrides(
+            cfg, {"pallas_bn": True}, model_built=False,
+            log=lambda s: None)
+        assert out2.pallas_bn is True
+
+    def test_apply_does_not_rerun_post_init(self, cache_dir,
+                                            monkeypatch):
+        # The dataclasses.replace trap: re-running __post_init__ would
+        # re-read TPU_DDP_AUTOTUNE and re-arm the tuner (recursion) and
+        # clobber tuned values with env. apply_overrides must not.
+        monkeypatch.setenv("TPU_DDP_AUTOTUNE", "search")
+        cfg = TrainConfig()
+        assert cfg.autotune == "search"
+        out = tune.apply_overrides(cfg, {"dispatch_depth": 1},
+                                   log=lambda s: None)
+        assert out.autotune == "off" and out.dispatch_depth == 1
+
+    def test_search_mode_via_fake_runner_writes_cache(self, cfg,
+                                                      cache_dir,
+                                                      monkeypatch):
+        # Full resolve(search) flow with the measurement faked out:
+        # depth 0 measures fastest, so it must be searched, stored,
+        # applied — and a second resolve must hit the cache (0 trials).
+        class FakeRunner:
+            def __init__(self, *a, **kw):
+                self.trials = 0
+                self.quarantined = []
+
+            def evaluate(self, assignment, fidelity="short"):
+                self.trials += 1
+                return 10.0 + (5.0 if assignment.get(
+                    "dispatch_depth", 2) == 0 else 0.0), None
+
+        monkeypatch.setattr(tune, "TrialRunner", FakeRunner)
+        cfg.autotune = "search"
+        lines = []
+        out = tune.resolve(cfg, strategy="fused", mesh=None,
+                           log=lines.append)
+        assert out.dispatch_depth == 0
+        search_lines = [ln for ln in lines
+                        if ln.startswith("[autotune] search:")]
+        assert len(search_lines) == 1
+        assert "overrides={\"dispatch_depth\": 0}" in search_lines[0]
+
+        cfg2 = TrainConfig()
+        cfg2.autotune = "search"
+        lines2 = []
+        out2 = tune.resolve(cfg2, strategy="fused", mesh=None,
+                            log=lines2.append)
+        assert out2.dispatch_depth == 0
+        assert any("cache hit: trials=0" in ln for ln in lines2)
+
+    def test_provenance_lines_parse(self, cfg, cache_dir, monkeypatch):
+        # scripts/run_experiments.py's autotune stage greps the
+        # provenance lines out of subprocess stdout; its regexes must
+        # track the REAL lines resolve() emits, not a copy frozen in
+        # the test. Drive resolve twice (search, then hit) and feed the
+        # captured lines through the stage's own parser.
+        from scripts.run_experiments import (_RE_TUNE_HIT,
+                                             _RE_TUNE_SEARCH,
+                                             _parse_autotune)
+
+        class FakeRunner:
+            def __init__(self, *a, **kw):
+                self.trials = 0
+                self.quarantined = []
+
+            def evaluate(self, assignment, fidelity="short"):
+                self.trials += 1
+                return 10.0 + (5.0 if assignment.get(
+                    "dispatch_depth", 2) == 0 else 0.0), None
+
+        monkeypatch.setattr(tune, "TrialRunner", FakeRunner)
+        cfg.autotune = "search"
+        lines = []
+        tune.resolve(cfg, strategy="fused", mesh=None, log=lines.append)
+        search_out = "\n".join(lines)
+        assert _RE_TUNE_SEARCH.search(search_out)
+        parsed = _parse_autotune(search_out)
+        assert parsed["searched"] and parsed["trials"] > 0
+        assert parsed["overrides"] == {"dispatch_depth": 0}
+
+        cfg2 = TrainConfig()
+        cfg2.autotune = "search"
+        lines2 = []
+        tune.resolve(cfg2, strategy="fused", mesh=None,
+                     log=lines2.append)
+        hit_out = "\n".join(lines2)
+        assert _RE_TUNE_HIT.search(hit_out)
+        parsed2 = _parse_autotune(hit_out)
+        assert parsed2["cache_hit"] and parsed2["trials"] == 0
+        assert parsed2["overrides"] == parsed["overrides"]
+
+    def test_multiprocess_search_refused(self, cfg, cache_dir,
+                                         monkeypatch):
+        import jax
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        cfg.autotune = "search"
+        lines = []
+        out = tune.resolve(cfg, strategy="fused", mesh=None,
+                           log=lines.append)
+        assert out.dispatch_depth == cfg.dispatch_depth
+        assert any("refused under multi-process" in ln for ln in lines)
+
+
+# --------------------------------------------------------------- search
+
+def _space(cfg, names):
+    return [(k, c) for k, c in searchable_knobs(cfg, CPU1)
+            if k.name in names]
+
+
+class TestSearchLogic:
+    def test_grid_mode_for_two_knobs(self, cfg):
+        calls = []
+
+        def evaluate(assignment, fidelity):
+            calls.append((dict(assignment), fidelity))
+            sps = 10.0
+            if assignment.get("dispatch_depth") == 4:
+                sps += 2
+            if assignment.get("device_prefetch") == 2:
+                sps += 1
+            return sps, None
+
+        knobs = _space(cfg, {"dispatch_depth", "device_prefetch"})
+        base = {k.field: c[0] for k, c in knobs}
+        out = run_search(knobs, evaluate, base)
+        assert out["mode"] == "grid"
+        assert out["overrides"] == {"dispatch_depth": 4,
+                                    "device_prefetch": 2}
+        assert out["tuned_steps_per_sec"] >= out["default_steps_per_sec"]
+        # grid = full cross product at short fidelity (4 x 2 = 8 cells)
+        assert len([c for c in calls if c[1] == "short"]) == 8
+
+    def test_coordinate_descent_for_three_knobs(self, cfg):
+        def evaluate(assignment, fidelity):
+            sps = 10.0
+            sps += {0: 3, 1: 1, 2: 0, 4: 2}[
+                assignment.get("dispatch_depth", 2)]
+            sps += {1: 0, 4: 2, 8: 1}[
+                assignment.get("steps_per_dispatch", 1)]
+            return sps, None
+
+        knobs = _space(cfg, {"dispatch_depth", "steps_per_dispatch",
+                             "device_prefetch"})
+        base = {k.field: c[0] for k, c in knobs}
+        out = run_search(knobs, evaluate, base)
+        assert out["mode"] == "coordinate_descent"
+        assert out["overrides"]["dispatch_depth"] == 0
+        assert out["overrides"]["steps_per_dispatch"] == 4
+
+    def test_memoization_never_remeasures(self, cfg):
+        seen = {}
+
+        def evaluate(assignment, fidelity):
+            key = (tuple(sorted(assignment.items())), fidelity)
+            seen[key] = seen.get(key, 0) + 1
+            return 10.0, None
+
+        knobs = _space(cfg, {"dispatch_depth", "steps_per_dispatch",
+                             "device_prefetch"})
+        base = {k.field: c[0] for k, c in knobs}
+        run_search(knobs, evaluate, base)
+        assert max(seen.values()) == 1
+
+    def test_quarantined_cells_counted_infeasible_cells_not(self, cfg):
+        def evaluate(assignment, fidelity):
+            d = assignment.get("dispatch_depth", 2)
+            if d == 4:
+                return None, "quarantined: XlaRuntimeError: boom"
+            if d == 1:
+                return None, "constraint: known-invalid"
+            return 10.0 + (1.0 if d == 0 else 0.0), None
+
+        knobs = _space(cfg, {"dispatch_depth", "device_prefetch"})
+        base = {k.field: c[0] for k, c in knobs}
+        out = run_search(knobs, evaluate, base)
+        assert out["quarantined"] >= 1
+        assert out["overrides"].get("dispatch_depth") == 0
+        trials = [h for h in out["history"]
+                  if h["reason"] is None
+                  or h["reason"].startswith("quarantined")]
+        assert out["trials"] == len(trials)
+
+    def test_regression_guard_keeps_defaults(self, cfg):
+        # Short windows lie (noise favors depth 0), the long confirm
+        # tells the truth (default wins): the tuner must ship nothing.
+        def evaluate(assignment, fidelity):
+            d = assignment.get("dispatch_depth", 2)
+            if fidelity == "short":
+                return (12.0 if d == 0 else 10.0), None
+            return (9.0 if d == 0 else 10.0), None
+
+        knobs = _space(cfg, {"dispatch_depth", "device_prefetch"})
+        base = {k.field: c[0] for k, c in knobs}
+        out = run_search(knobs, evaluate, base)
+        assert out["overrides"] == {}
+        assert out["tuned_steps_per_sec"] == out["default_steps_per_sec"]
+
+    def test_everything_infeasible_returns_defaults(self, cfg):
+        def evaluate(assignment, fidelity):
+            return None, "quarantined: OOM"
+
+        knobs = _space(cfg, {"dispatch_depth", "device_prefetch"})
+        base = {k.field: c[0] for k, c in knobs}
+        out = run_search(knobs, evaluate, base)
+        assert out["overrides"] == {}
+
+    def test_empty_space(self):
+        out = run_search([], lambda a, f: (1.0, None), {})
+        assert out == {"overrides": {}, "default_steps_per_sec": None,
+                       "tuned_steps_per_sec": None, "trials": 0,
+                       "quarantined": 0, "mode": "empty", "history": []}
+
+
+# ------------------------------------------------------- timing helpers
+
+class TestTimingHelpers:
+    def test_timed_window_requires_iters(self):
+        with pytest.raises(ValueError, match="iters"):
+            timed_window_s(lambda: None, 0)
+
+    def test_median_and_samples(self):
+        ticks = iter(range(100))
+
+        def run():
+            return next(ticks)
+
+        synced = []
+        median, samples = warm_then_median_s(
+            run, iters=2, windows=3, warmup=1, sync=synced.append)
+        assert len(samples) == 3
+        assert median == sorted(samples)[1]
+        # one sync for warmup + one per window, on the LAST call's value
+        assert len(synced) == 4
+
+    def test_default_sync_tolerates_none(self):
+        median, samples = warm_then_median_s(lambda: None, iters=1,
+                                             windows=1)
+        assert len(samples) == 1 and median >= 0
+
+
+# -------------------------------------------- acceptance smoke (slow)
+
+@pytest.mark.slow
+def test_search_acceptance_smoke(tmp_path, monkeypatch):
+    """The ISSUE acceptance cell: TPU_DDP_AUTOTUNE=search on the vgg11
+    CPU smoke config completes a >=3-knob search in under 120 s, writes
+    a cache entry, and a second run hits the cache (0 trials) with
+    identical overrides."""
+    import jax
+
+    from tpu_ddp.parallel.mesh import make_mesh
+
+    for key in list(os.environ):
+        if key.startswith("TPU_DDP_"):
+            monkeypatch.delenv(key)
+    monkeypatch.setenv("TPU_DDP_TUNE_CACHE_DIR", str(tmp_path / "tune"))
+    monkeypatch.setenv("TPU_DDP_TUNE_ITERS", "3")
+    monkeypatch.setenv("TPU_DDP_TUNE_WINDOWS", "2")
+    monkeypatch.setenv("TPU_DDP_AUTOTUNE", "search")
+
+    mesh = make_mesh(jax.devices()[:1])
+    cfg = TrainConfig.preset("vgg11_cifar10", global_batch_size=8)
+    assert cfg.autotune == "search"
+    ctx = workload_for(cfg, "fused", mesh)
+    assert len(searchable_knobs(cfg, ctx)) >= 3
+
+    lines = []
+    t0 = time.perf_counter()
+    tuned = tune.resolve(cfg, strategy="fused", mesh=mesh,
+                         log=lines.append)
+    wall = time.perf_counter() - t0
+    assert wall < 120, f"search took {wall:.1f}s (budget 120s)"
+    search_lines = [ln for ln in lines
+                    if ln.startswith("[autotune] search:")]
+    assert len(search_lines) == 1
+
+    cfg2 = TrainConfig.preset("vgg11_cifar10", global_batch_size=8)
+    lines2 = []
+    rerun = tune.resolve(cfg2, strategy="fused", mesh=mesh,
+                         log=lines2.append)
+    assert any("cache hit: trials=0" in ln for ln in lines2)
+    for field in ("dispatch_depth", "steps_per_dispatch",
+                  "device_prefetch"):
+        assert getattr(rerun, field) == getattr(tuned, field)

@@ -1,0 +1,79 @@
+"""The knob audit must pass on the live tree AND catch seeded drift.
+
+A consistency checker that never fails is indistinguishable from one
+that checks nothing — every drift class the audit claims to detect is
+seeded here with a deliberately-broken registry entry and must produce
+a finding that names the problem.
+"""
+
+import dataclasses
+
+from scripts.knob_audit import NONPERF_ENV, audit
+from tpu_ddp.tune.space import KNOBS, Knob, knob_by_field
+
+
+def test_live_tree_is_clean():
+    # The CI gate: any drift between TrainConfig, the env block, the
+    # launch flags, and the registry fails the suite with the audit's
+    # own message naming the surface that moved.
+    assert audit() == []
+
+
+def test_catches_missing_field():
+    drifted = KNOBS + (Knob("ghost", "no_such_field",
+                            "TPU_DDP_DISPATCH_DEPTH", values=(1, 2)),)
+    findings = audit(drifted)
+    assert any("no_such_field" in f and "does not exist" in f
+               for f in findings)
+
+
+def test_catches_unparsed_env_var():
+    # The env var exists in no __post_init__ branch: setting it must
+    # leave the field at its default, which the behavioral check flags.
+    drifted = KNOBS + (Knob("drift", "dispatch_depth",
+                            "TPU_DDP_NO_SUCH_VAR", values=(0, 1, 2, 4)),)
+    findings = audit(drifted)
+    assert any("TPU_DDP_NO_SUCH_VAR" in f and "not parsed" in f
+               for f in findings)
+
+
+def test_catches_env_wired_to_wrong_field():
+    # TPU_DDP_PREFETCH is parsed — but into device_prefetch, not
+    # steps_per_dispatch. The probe value lands in the wrong field.
+    drifted = KNOBS + (Knob("crossed", "steps_per_dispatch",
+                            "TPU_DDP_PREFETCH", values=(1, 4)),)
+    findings = audit(drifted)
+    assert any("crossed" in f for f in findings)
+
+
+def test_catches_default_outside_candidates():
+    bad = tuple(dataclasses.replace(k, values=(7, 9))
+                if k.name == "dispatch_depth" else k for k in KNOBS)
+    findings = audit(bad)
+    assert any("keep the default" in f for f in findings)
+
+
+def test_catches_unknown_launch_flag():
+    drifted = KNOBS + (Knob("flagless", "dispatch_depth",
+                            "TPU_DDP_DISPATCH_DEPTH", values=(0, 2),
+                            flag="--no-such-flag"),)
+    findings = audit(drifted)
+    assert any("--no-such-flag" in f for f in findings)
+
+
+def test_reverse_check_catches_unregistered_perf_env():
+    # Drop the grad_compress entry: config.py still parses
+    # TPU_DDP_GRAD_COMPRESS, so the reverse sweep must flag it as a
+    # knob living outside the search space.
+    pruned = tuple(k for k in KNOBS if k.name != "grad_compress")
+    findings = audit(pruned)
+    assert any("TPU_DDP_GRAD_COMPRESS" in f and "no registry entry" in f
+               for f in findings)
+
+
+def test_nonperf_allowlist_is_exact():
+    # Every allowlisted var must still be absent from the registry —
+    # an entry appearing for one means the allowlist line should go.
+    registered = {k.env for k in KNOBS}
+    assert not (NONPERF_ENV & registered)
+    assert knob_by_field("dispatch_depth") is not None

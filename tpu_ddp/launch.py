@@ -392,6 +392,15 @@ def main(argv=None) -> int:
                         "carries an error-feedback residual; int8-noef "
                         "is the ablation without it). Sets "
                         "TPU_DDP_GRAD_COMPRESS for every rank")
+    p.add_argument("--autotune", default=None,
+                   choices=("off", "cached", "search"),
+                   help="perf-knob autotuning (tpu_ddp/tune/): 'cached' "
+                        "applies a previously searched tuning for this "
+                        "workload fingerprint, 'search' runs measured "
+                        "trials and persists the winner (single-process "
+                        "only; multi-process ranks fall back to 'cached' "
+                        "semantics). Sets TPU_DDP_AUTOTUNE for every "
+                        "rank")
     args, extra = p.parse_known_args(argv)
     env = {}
     if args.dispatch_depth is not None:
@@ -401,6 +410,8 @@ def main(argv=None) -> int:
         env["TPU_DDP_DISPATCH_DEPTH"] = str(args.dispatch_depth)
     if args.grad_compress is not None:
         env["TPU_DDP_GRAD_COMPRESS"] = args.grad_compress
+    if args.autotune is not None:
+        env["TPU_DDP_AUTOTUNE"] = args.autotune
     env = env or None
     try:
         res = launch_elastic(args.part, args.nproc,

@@ -138,6 +138,16 @@ class Trainer:
     ):
         self.model = model
         self.config = config or TrainConfig()
+        # Autotuning fallback hook (tpu_ddp/tune/): parts/common.py
+        # resolves BEFORE get_model so model-level knobs apply; direct
+        # Trainer construction resolves here with model_built=True
+        # (model-level overrides are dropped with a warning). resolve()
+        # returns a config with autotune="off", so this cannot recurse
+        # through the trial runner's own Trainer constructions.
+        if getattr(self.config, "autotune", "off") != "off":
+            from tpu_ddp import tune
+            self.config = tune.resolve(self.config, strategy=strategy,
+                                       mesh=mesh, model_built=True)
         # Global-norm gradient clipping (round-3 verdict item 6):
         # torch.nn.utils.clip_grad_norm_ semantics. Applied to the
         # SYNCED gradients, so every rung clips by the same global norm:
@@ -745,6 +755,18 @@ class Trainer:
         """
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
+        # Memoized per k: each build creates fresh closures, so jax.jit's
+        # own cache can never hit across builds — without this, an
+        # E-epoch grouped-K run re-COMPILES the scan every epoch
+        # (_train_epoch_multi builds per epoch; surfaced by the
+        # autotuner's repeated-epoch trials). Everything the closures
+        # capture (mesh, specs, _comp_stateful, the step body) is fixed
+        # at construction, so reuse is sound.
+        cache = getattr(self, "_multi_step_cache", None)
+        if cache is None:
+            cache = self._multi_step_cache = {}
+        if k in cache:
+            return cache[k]
 
         def scan_body(params, opt_state, comp, xs, ys, ws):
             def step(carry, xyw):
@@ -825,6 +847,7 @@ class Trainer:
             return TrainState(params, opt_state, state.step + k,
                               comp), losses
 
+        cache[k] = run
         return run
 
     def put_batches(self, images_k, labels_k):

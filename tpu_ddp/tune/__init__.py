@@ -1,0 +1,179 @@
+"""tpu_ddp.tune — measured-trial autotuning over the perf-knob space.
+
+The reference ladder is a *manual* search over sync strategies; this
+repo's knob space has long outgrown hand-tuning (sync rung, wire
+format, dispatch depth/grouping, prefetch, Pallas kernels, dtype — see
+``space.KNOBS``). The tuner closes the loop from measurement:
+
+- ``TPU_DDP_AUTOTUNE=search`` (or ``TrainConfig.autotune="search"`` /
+  ``launch --autotune search``): run timed trials on the live workload
+  (``runner.py``) under coordinate descent + successive halving
+  (``search.py``), persist the winner to the fingerprint-keyed cache
+  (``cache.py``), apply it;
+- ``TPU_DDP_AUTOTUNE=cached``: apply a previously searched tuning when
+  one exists for this exact workload fingerprint, warn-and-default
+  otherwise — safe to leave on everywhere;
+- ``off`` (default): the tuner does not exist.
+
+:func:`resolve` is the single integration point — ``parts/common.py``
+calls it before the model is built (all knobs applicable) and
+``train/engine.py`` calls it as a fallback for direct ``Trainer``
+construction (model-level knobs are dropped with a warning there).
+Explicit ``TPU_DDP_*`` env pins always beat the tuner: a pinned knob is
+neither searched nor overridden.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import time
+
+from tpu_ddp.tune import cache as tune_cache
+from tpu_ddp.tune.runner import TrialRunner
+from tpu_ddp.tune.search import run_search
+from tpu_ddp.tune.space import (KNOBS, MODEL_LEVEL_FIELDS, Fingerprint,
+                                fingerprint_for, knob_by_field,
+                                searchable_knobs, workload_for)
+
+__all__ = ["resolve", "apply_overrides", "tuned_vs_default",
+           "fingerprint_for", "searchable_knobs", "KNOBS", "Fingerprint"]
+
+
+def apply_overrides(cfg, overrides: dict, *, model_built: bool = False,
+                    log=print):
+    """A copy of ``cfg`` with tuned ``overrides`` applied and
+    ``autotune`` disarmed. ``copy.copy`` + ``setattr``, never
+    ``dataclasses.replace`` — replace() re-runs ``__post_init__``, which
+    would re-read the env (re-arming ``TPU_DDP_AUTOTUNE`` into a
+    recursion, and clobbering tuned values with env defaults).
+
+    Skipped, with a log line naming why: fields pinned by their own
+    ``TPU_DDP_*`` env var (the user's explicit pin wins), and — when
+    ``model_built`` — model-level fields (``pallas_bn``,
+    ``compute_dtype``) that can no longer take effect because
+    ``get_model`` already ran.
+    """
+    out = copy.copy(cfg)
+    out.autotune = "off"
+    for field, value in overrides.items():
+        knob = knob_by_field(field)
+        if knob is None:
+            log(f"[autotune] ignoring unknown override {field!r}")
+            continue
+        if os.environ.get(knob.env):
+            log(f"[autotune] override {field}={value!r} skipped: "
+                f"{knob.env} is explicitly set and pins the knob")
+            continue
+        if model_built and field in MODEL_LEVEL_FIELDS \
+                and value != getattr(cfg, field):
+            log(f"[autotune] override {field}={value!r} skipped: the "
+                "model is already built (apply tunings via "
+                "parts/common.py or launch --autotune to cover "
+                "model-level knobs)")
+            continue
+        setattr(out, field, value)
+    return out
+
+
+def resolve(cfg, *, strategy: str = "none", mesh=None,
+            model_built: bool = False, log=print):
+    """Resolve ``cfg.autotune`` into a concrete config: search, load, or
+    fall back to defaults — always returning a config with
+    ``autotune="off"`` so downstream construction can't recurse."""
+    mode = getattr(cfg, "autotune", "off")
+    if mode == "off":
+        return cfg
+
+    import jax
+
+    fp = fingerprint_for(cfg, strategy, mesh)
+    hit = tune_cache.load(fp)
+    if hit is not None:
+        log(f"[autotune] cache hit: trials=0 "
+            f"overrides={json.dumps(hit['overrides'], sort_keys=True)} "
+            f"<- {hit['path']}")
+        return apply_overrides(cfg, hit["overrides"],
+                               model_built=model_built, log=log)
+
+    if mode == "cached":
+        log(f"[autotune] cached mode: no entry for {fp.key()}; using "
+            "defaults (populate with TPU_DDP_AUTOTUNE=search)")
+        return apply_overrides(cfg, {}, model_built=model_built, log=log)
+
+    # mode == "search"
+    if jax.process_count() > 1:
+        # Per-process trial loops would run collectives on different
+        # schedules across hosts (deadlock) and measure contended
+        # devices (garbage). Search single-process, share via the cache.
+        log("[autotune] search mode refused under multi-process "
+            f"(process_count={jax.process_count()}); using defaults — "
+            "run TPU_DDP_AUTOTUNE=search single-process to populate "
+            "the cache, then use TPU_DDP_AUTOTUNE=cached")
+        return apply_overrides(cfg, {}, model_built=model_built, log=log)
+
+    ctx = workload_for(cfg, strategy, mesh)
+    knobs = searchable_knobs(cfg, ctx)
+    base = {knob.field: cands[0] for knob, cands in knobs}
+    t0 = time.perf_counter()
+    runner = TrialRunner(cfg, ctx, strategy=strategy, mesh=mesh, log=log)
+    result = run_search(knobs, runner.evaluate, base, log=log)
+    wall = time.perf_counter() - t0
+
+    path = tune_cache.store(fp, result["overrides"], meta={
+        "trials": result["trials"],
+        "quarantined": result["quarantined"],
+        "mode": result["mode"],
+        "wall_s": round(wall, 2),
+        "default_steps_per_sec": result["default_steps_per_sec"],
+        "tuned_steps_per_sec": result["tuned_steps_per_sec"],
+        "searched_knobs": [knob.name for knob, _ in knobs],
+    })
+    log(f"[autotune] search: trials={result['trials']} "
+        f"quarantined={result['quarantined']} wall_s={wall:.1f} "
+        f"overrides={json.dumps(result['overrides'], sort_keys=True)} "
+        f"-> {path}")
+    return apply_overrides(cfg, result["overrides"],
+                           model_built=model_built, log=log)
+
+
+def tuned_vs_default(config: str, *, strategy: str = "fused", mesh=None,
+                     n_batches: int | None = None,
+                     max_trials: int | None = None,
+                     timeout_s: float | None = None,
+                     log=None) -> dict:
+    """Search one preset family WITHOUT touching the persistent cache
+    and report tuned-vs-default steps/sec — bench.py's
+    ``extra.autotune`` block and ``scripts/autotune_sweep.py`` both
+    record this, so the headline shows the tuner paying rent."""
+    import jax
+
+    from tpu_ddp.parallel.mesh import make_mesh
+    from tpu_ddp.utils.config import TrainConfig
+
+    cfg = TrainConfig.preset(config)
+    cfg.autotune = "off"
+    if mesh is None:
+        mesh = make_mesh(jax.devices()[:1])
+    ctx = workload_for(cfg, strategy, mesh)
+    knobs = searchable_knobs(cfg, ctx)
+    base = {knob.field: cands[0] for knob, cands in knobs}
+    t0 = time.perf_counter()
+    runner = TrialRunner(cfg, ctx, strategy=strategy, mesh=mesh,
+                         n_batches=n_batches, max_trials=max_trials,
+                         timeout_s=timeout_s, log=log)
+    result = run_search(knobs, runner.evaluate, base,
+                        log=log or (lambda s: None))
+    return {
+        "config": config,
+        "searched_knobs": [knob.name for knob, _ in knobs],
+        "overrides": result["overrides"],
+        "default_steps_per_sec": result["default_steps_per_sec"],
+        "tuned_steps_per_sec": result["tuned_steps_per_sec"],
+        "trials": result["trials"],
+        "quarantined": result["quarantined"],
+        "mode": result["mode"],
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "fingerprint": fingerprint_for(cfg, strategy, mesh).asdict(),
+    }

@@ -1,0 +1,244 @@
+"""Timed autotuning trials: one knob assignment -> one steps/sec number.
+
+A trial runs ``Trainer.train_epoch`` over a fixed set of synthetic
+host batches (bench.py's zero-egress protocol: raw uint8 over the wire,
+normalization fused into the jitted step) with the candidate knobs
+applied, using the shared warm-compile + median-of-windows loop from
+``utils/timing.py`` — warm epoch first (compile + first execution,
+the reference's discarded iteration 0), then back-to-back timed epochs
+with one sync per window. Short windows (``fidelity="short"``) feed the
+search's pruning passes; long windows confirm finalists
+(``tune/search.py``).
+
+Robustness is the point, not an afterthought: a compile failure, OOM,
+divergence, or wall-clock blowout in ONE cell must mark that point
+infeasible and keep searching, never kill the tuner. Every trial body is
+wrapped; the failure reason is recorded in the trial history (and the
+cell never re-measured — the search memoizes).
+
+Trial mechanics that keep measurements honest:
+
+- the trial config is a ``copy.copy`` of the workload config with
+  ``autotune="off"`` (no recursion), the reference timing window
+  disabled (``timing_first_iter=1, timing_last_iter=0`` — the window
+  forces synchronous dispatch, which would mask ``dispatch_depth``;
+  the depth_sweep idiom), and ``guard_max_bad_steps`` effectively
+  infinite (random-label synthetic data at the preset lr can trip the
+  divergence guard; a trial measures throughput, not convergence — the
+  guard is host-side, so this changes no compiled program);
+- trainers are cached per *jit-relevant* knob subset (model dtype,
+  Pallas kernels, wire format) and loop-level knobs (dispatch depth,
+  K-per-dispatch, prefetch) are mutated on the cached trainer's config
+  — the same step executable serves every loop-knob cell, so trials
+  price dispatch discipline, not recompilation.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import time
+
+import numpy as np
+
+from tpu_ddp.tune.space import Workload, violations
+from tpu_ddp.utils.timing import warm_then_median_s
+
+__all__ = ["TrialRunner"]
+
+# Knobs whose value changes the compiled step or the model itself: a new
+# value needs a new Trainer (and model). Everything else is a host-loop
+# property mutated on the shared trainer (pipeline.depth_sweep idiom).
+JIT_FIELDS = ("compute_dtype", "pallas_sgd", "pallas_bn", "grad_compress")
+LOOP_FIELDS = ("dispatch_depth", "steps_per_dispatch", "device_prefetch")
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    return int(raw) if raw else default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    return float(raw) if raw else default
+
+
+class TrialRunner:
+    """Measures knob assignments for one workload.
+
+    ``evaluate(assignment, fidelity)`` returns ``(steps_per_sec, None)``
+    for a successful trial or ``(None, reason)`` for an infeasible /
+    quarantined cell. The runner owns the synthetic batches, the
+    per-jit-key trainer cache, the trial counter, and the budget knobs
+    (``TPU_DDP_TUNE_ITERS`` batches per epoch, ``TPU_DDP_TUNE_TIMEOUT_S``
+    per-trial wall ceiling, ``TPU_DDP_TUNE_MAX_TRIALS``).
+    """
+
+    def __init__(self, cfg, ctx: Workload, *, strategy: str = "fused",
+                 mesh=None, n_batches: int | None = None,
+                 timeout_s: float | None = None,
+                 max_trials: int | None = None, log=None):
+        self.ctx = ctx
+        self.strategy = strategy
+        self.mesh = mesh
+        self.log = log or (lambda s: None)
+        # steps_per_dispatch=8 needs >= 8 uniform batches per epoch to
+        # engage the grouped path at all; 16 gives it two dispatches.
+        self.n_batches = n_batches or _env_int("TPU_DDP_TUNE_ITERS", 16)
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else _env_float("TPU_DDP_TUNE_TIMEOUT_S", 60.0))
+        self.max_trials = (max_trials if max_trials is not None
+                           else _env_int("TPU_DDP_TUNE_MAX_TRIALS", 64))
+        self.long_windows = _env_int("TPU_DDP_TUNE_WINDOWS", 3)
+        self.trials = 0
+        self.quarantined: list[dict] = []
+        # (jit_key, effective K) pairs whose executables are already
+        # compiled — their trials skip the warm epoch. dispatch_depth
+        # and device_prefetch are pure host-loop properties (no new
+        # executable), so the compile surface is exactly (trainer, K).
+        self._warmed: set = set()
+
+        # The trial base config: workload config minus everything that
+        # would make a trial lie (see module docstring). copy.copy, not
+        # dataclasses.replace — replace() re-runs __post_init__, which
+        # re-applies env overrides on top of trial values.
+        base = copy.copy(cfg)
+        base.autotune = "off"
+        base.timing_first_iter, base.timing_last_iter = 1, 0
+        base.guard_max_bad_steps = 10**9
+        base.max_iters = None
+        base.log_every = 10**9
+        self.base_cfg = base
+
+        import jax
+
+        world = max(1, jax.process_count())
+        batch = cfg.per_node_batch_size(world)
+        rng = np.random.default_rng(0)
+        side = cfg.image_size
+        n_distinct = min(4, self.n_batches)
+        distinct = [
+            (rng.integers(0, 256, size=(batch, side, side,
+                                        cfg.in_channels)).astype(np.uint8),
+             rng.integers(0, cfg.num_classes,
+                          size=batch).astype(np.int32))
+            for _ in range(n_distinct)]
+        reps = -(-self.n_batches // n_distinct)
+        self.host_batches = (distinct * reps)[:self.n_batches]
+        self._trainers: dict = {}
+
+    # -- trainer cache ------------------------------------------------
+
+    def _jit_key(self, assignment: dict) -> tuple:
+        return tuple(assignment.get(f, getattr(self.base_cfg, f))
+                     for f in JIT_FIELDS)
+
+    def _trainer_for(self, assignment: dict):
+        key = self._jit_key(assignment)
+        hit = self._trainers.get(key)
+        if hit is not None:
+            return hit
+
+        import jax.numpy as jnp
+
+        from tpu_ddp.models import get_model
+        from tpu_ddp.train.engine import Trainer
+
+        cfg = copy.copy(self.base_cfg)
+        for f, v in assignment.items():
+            setattr(cfg, f, v)
+        model = get_model(cfg.model, num_classes=cfg.num_classes,
+                          use_pallas_bn=cfg.pallas_bn,
+                          compute_dtype=jnp.dtype(cfg.compute_dtype))
+        trainer = Trainer(model, cfg, strategy=self.strategy,
+                          mesh=self.mesh)
+        state = trainer.init_state()
+        self._trainers[key] = (trainer, state)
+        return self._trainers[key]
+
+    # -- trials -------------------------------------------------------
+
+    def evaluate(self, assignment: dict,
+                 fidelity: str = "short") -> tuple[float | None, str | None]:
+        """Measure ``assignment`` (field -> value, defaults implied for
+        absent fields); ``fidelity`` picks the window count (short=1
+        prunes, long=3 confirms with a median)."""
+        bad = violations({**{f: getattr(self.base_cfg, f)
+                             for f in JIT_FIELDS + LOOP_FIELDS},
+                          **assignment}, self.ctx)
+        if bad:
+            return None, "constraint: " + "; ".join(bad)
+        if self.trials >= self.max_trials:
+            return None, f"budget: max_trials={self.max_trials} reached"
+
+        self.trials += 1
+        windows = self.long_windows if fidelity == "long" else 1
+        t_start = time.perf_counter()
+        try:
+            trainer, state = self._trainer_for(assignment)
+            cfg = trainer.config
+            saved = {f: getattr(cfg, f) for f in LOOP_FIELDS}
+            try:
+                for f in LOOP_FIELDS:
+                    setattr(cfg, f, assignment.get(f, saved[f]))
+
+                def epoch():
+                    nonlocal state
+                    state, stats = trainer.train_epoch(
+                        state, list(self.host_batches), epoch=0,
+                        log=lambda s: None)
+                    return None  # train_epoch already syncs its tail
+
+                # Warm (compile + first execution) only when this cell
+                # needs an executable no earlier trial built: the
+                # grouped-K path engages exactly when K>1 with no
+                # prefetch and no in-loop cadence (engine.train_epoch),
+                # so the compile surface is (trainer, effective K).
+                spd = assignment.get("steps_per_dispatch",
+                                     saved["steps_per_dispatch"])
+                grouped = (spd > 1
+                           and not assignment.get(
+                               "device_prefetch",
+                               saved["device_prefetch"])
+                           and not cfg.ckpt_every_iters
+                           and not cfg.check_replicas_every)
+                warm_key = (self._jit_key(assignment),
+                            spd if grouped else 1)
+                if warm_key not in self._warmed:
+                    epoch()
+                    self._warmed.add(warm_key)
+                    if time.perf_counter() - t_start > self.timeout_s:
+                        raise TimeoutError(
+                            f"warm epoch blew the {self.timeout_s}s "
+                            "trial budget")
+                epoch_s, samples = warm_then_median_s(
+                    epoch, iters=1, windows=windows, warmup=0,
+                    sync=lambda _: None)
+            finally:
+                for f, v in saved.items():
+                    setattr(cfg, f, v)
+                # Trials share state across cells on purpose (random
+                # labels; throughput only) — write the advanced state
+                # back so the cache never rewinds to step 0.
+                self._trainers[self._jit_key(assignment)] = (trainer,
+                                                             state)
+        except KeyboardInterrupt:
+            raise
+        except BaseException as e:  # noqa: BLE001 — quarantine, don't die
+            # XlaRuntimeError (compile failure / RESOURCE_EXHAUSTED OOM),
+            # TrainingDivergedError, TimeoutError... a bad cell is an
+            # infeasible point, not a dead search.
+            if isinstance(e, (SystemExit, GeneratorExit)):
+                raise
+            reason = f"quarantined: {type(e).__name__}: {e}"
+            self.quarantined.append({"assignment": dict(assignment),
+                                     "reason": reason})
+            self.log(f"[autotune] trial quarantined "
+                     f"({dict(assignment)}): {type(e).__name__}: {e}")
+            return None, reason
+
+        sps = self.n_batches / epoch_s
+        self.log(f"[autotune] trial {self.trials}: {dict(assignment)} "
+                 f"-> {sps:.2f} steps/s ({fidelity}, "
+                 f"windows={[round(s, 4) for s in samples]})")
+        return sps, None

@@ -1,0 +1,329 @@
+"""The perf-knob search space: one declarative registry + constraints.
+
+Every knob the autotuner may turn is ONE entry here, carrying the
+``TrainConfig`` field it sets, the ``TPU_DDP_*`` env var that field
+parses, the ``python -m tpu_ddp.launch`` flag (when one exists) and the
+candidate values trials may measure. ``scripts/knob_audit.py``
+cross-checks the four surfaces against each other (and against the
+hand-rolled env block in ``utils/config.py``) so they cannot silently
+drift — a new knob lands as one registry entry, not N files.
+
+The constraint model (:func:`violations`) encodes the combinations the
+engine itself refuses or degrades, so the search never spends a trial
+on a cell whose measurement would be a lie:
+
+- Pallas kernels compile for the TPU backend only (ops/pallas/);
+- ``grad_compress != "none"`` needs a dp>1 mesh AND a syncing rung —
+  the Trainer warns and degrades to fp32 otherwise (DESIGN.md §14);
+- ``dispatch_depth > 0`` is forced to 0 by the streaming loop when a
+  multi-process run carries a collective-bearing in-loop cadence
+  (ckpt/replica-digest collectives must enqueue at the same loop
+  position on every process — DESIGN.md §13 guard (e));
+- ``steps_per_dispatch > 1`` falls back to the per-step path under
+  in-loop cadences or ``device_prefetch > 0`` (engine.py), so those
+  cells duplicate their per-step twins.
+
+``semantic=True`` marks knobs whose value changes the training
+computation itself (dtype, batch size), not just its schedule; the
+default space excludes them so tuned runs stay numerically identical
+to default runs (opt in with ``TPU_DDP_TUNE_SEMANTIC=1``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Mapping
+
+__all__ = ["Knob", "KNOBS", "Workload", "Fingerprint", "violations",
+           "searchable_knobs", "space_version", "fingerprint_for",
+           "workload_for", "knob_by_field", "parse_knob_filter"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One tunable knob and every surface it must agree across."""
+
+    name: str              # registry name (== the TrainConfig field)
+    field: str             # TrainConfig attribute the tuner sets
+    env: str               # TPU_DDP_* env var utils/config.py parses
+    values: tuple          # candidate values (must include the default)
+    flag: str | None = None  # tpu_ddp.launch flag, when one exists
+    semantic: bool = False   # changes numerics, not just schedule
+    doc: str = ""
+
+    def encode(self, value) -> str:
+        """The env-var string that makes TrainConfig parse ``value`` —
+        the round-trip knob_audit drives behaviourally."""
+        if isinstance(value, bool):
+            return "1" if value else "0"
+        return str(value)
+
+
+# The registry. Values are chosen so the default config is always a
+# member (the search must be able to return "keep the defaults") and
+# the non-default members are the settings the repo's own sweeps have
+# shown to matter (scripts/host_gap.py, EXPERIMENTS.md §9/§10).
+KNOBS: tuple[Knob, ...] = (
+    Knob("dispatch_depth", "dispatch_depth", "TPU_DDP_DISPATCH_DEPTH",
+         values=(0, 1, 2, 4), flag="--dispatch-depth",
+         doc="async dispatch window (train/pipeline.py); 0 = sync loop"),
+    Knob("steps_per_dispatch", "steps_per_dispatch",
+         "TPU_DDP_STEPS_PER_DISPATCH", values=(1, 4, 8),
+         doc="K uniform batches per jitted lax.scan dispatch"),
+    Knob("device_prefetch", "device_prefetch", "TPU_DDP_PREFETCH",
+         values=(0, 2),
+         doc="host->device transfers kept in flight (data/prefetch.py)"),
+    Knob("grad_compress", "grad_compress", "TPU_DDP_GRAD_COMPRESS",
+         values=("none", "bf16", "int8"), flag="--grad-compress",
+         doc="gradient wire format on the sync collectives "
+             "(parallel/compress.py; int8-noef is an ablation, not a "
+             "candidate)"),
+    Knob("pallas_sgd", "pallas_sgd", "TPU_DDP_PALLAS_SGD",
+         values=(False, True),
+         doc="fused Pallas SGD momentum update kernel (TPU only)"),
+    Knob("pallas_bn", "pallas_bn", "TPU_DDP_PALLAS_BN",
+         values=(False, True),
+         doc="fused Pallas BatchNorm+ReLU kernel (TPU only; model-"
+             "level — must be applied before get_model)"),
+    Knob("compute_dtype", "compute_dtype", "TPU_DDP_COMPUTE_DTYPE",
+         values=("bfloat16", "float32"), semantic=True,
+         doc="matmul/conv dtype; changes the training numerics, so "
+             "searched only with TPU_DDP_TUNE_SEMANTIC=1"),
+    Knob("global_batch_size", "global_batch_size",
+         "TPU_DDP_GLOBAL_BATCH", values=(), semantic=True,
+         doc="registered for the audit (field<->env agreement) but "
+             "never searched: batch size is a training hyperparameter, "
+             "not a schedule knob"),
+)
+
+# Model-level knobs are baked into get_model() before the Trainer ever
+# sees the config; tune.resolve(model_built=True) must drop them.
+MODEL_LEVEL_FIELDS = ("pallas_bn", "compute_dtype")
+
+
+def knob_by_field(field: str) -> Knob | None:
+    for k in KNOBS:
+        if k.field == field:
+            return k
+    return None
+
+
+def space_version() -> str:
+    """Hash of the registry structure: any change to the knob set or a
+    knob's candidate values invalidates cached tunings via the
+    fingerprint (stale overrides are a miss, never a surprise)."""
+    payload = [(k.name, k.field, k.env, k.flag, list(map(str, k.values)),
+                k.semantic) for k in KNOBS]
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()).hexdigest()[:12]
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """The static context constraints are evaluated against."""
+
+    platform: str = "cpu"          # jax.devices()[0].platform
+    dp: int = 1                    # data-parallel slots on the mesh
+    processes: int = 1             # jax.process_count()
+    strategy: str = "none"         # canonical sync rung
+    collective_cadence: bool = False  # in-loop ckpt/replica cadence
+
+
+def workload_for(cfg, strategy: str = "none", mesh=None) -> Workload:
+    """Build the constraint context from live runtime state (imports
+    jax lazily so pure space/cache tests never touch the backend)."""
+    import jax
+
+    from tpu_ddp.parallel.sync import canonical_strategy
+
+    dp = 1
+    if mesh is not None:
+        try:
+            dp = int(mesh.shape.get("dp", 1))
+        except Exception:  # noqa: BLE001 — a mesh without named axes
+            dp = 1
+    return Workload(
+        platform=jax.devices()[0].platform,
+        dp=dp,
+        processes=jax.process_count(),
+        strategy=canonical_strategy(strategy),
+        collective_cadence=bool(cfg.ckpt_every_iters
+                                or cfg.check_replicas_every),
+    )
+
+
+def violations(assignment: Mapping, ctx: Workload) -> list[str]:
+    """Reasons ``assignment`` (field -> value) is a known-invalid cell
+    for ``ctx``; empty list == feasible. Each rule mirrors a guard the
+    engine enforces at runtime (cited in the module docstring) — the
+    search skips these cells instead of measuring a degraded twin."""
+    bad = []
+    get = assignment.get
+    if ctx.platform != "tpu":
+        for field in ("pallas_sgd", "pallas_bn"):
+            if get(field):
+                bad.append(f"{field}=True requires the TPU backend "
+                           f"(platform is {ctx.platform!r})")
+    if get("grad_compress", "none") != "none":
+        if ctx.dp <= 1 or ctx.strategy == "none":
+            bad.append(
+                f"grad_compress={get('grad_compress')!r} requires a "
+                f"dp>1 mesh and a syncing rung (dp={ctx.dp}, "
+                f"strategy={ctx.strategy!r}) — Trainer degrades it to "
+                "'none' (DESIGN.md §14)")
+    if get("dispatch_depth", 0) and ctx.processes > 1 \
+            and ctx.collective_cadence:
+        bad.append(
+            "dispatch_depth>0 with a multi-process collective-bearing "
+            "cadence — the streaming loop forces depth 0 "
+            "(DESIGN.md §13 guard (e))")
+    if get("steps_per_dispatch", 1) > 1:
+        if get("device_prefetch", 0):
+            bad.append("steps_per_dispatch>1 with device_prefetch>0 — "
+                       "the engine falls back to the per-step path "
+                       "(duplicate of the prefetch-only cell)")
+        if ctx.collective_cadence:
+            bad.append("steps_per_dispatch>1 with an in-loop cadence — "
+                       "the engine falls back to the per-step path")
+    return bad
+
+
+def parse_knob_filter(spec: str | None) -> dict | None:
+    """Parse ``TPU_DDP_TUNE_KNOBS``: a comma-separated list of registry
+    names, each optionally pinning its candidate values —
+    ``"dispatch_depth=0|2,steps_per_dispatch"`` keeps two knobs and
+    shrinks the first to {0, 2}. Returns {name: values-or-None}, or
+    None when unset. Unknown names raise (a typo must not silently tune
+    the full space)."""
+    if not spec:
+        return None
+    out: dict = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, raw = item.partition("=")
+        name = name.strip()
+        knob = knob_by_field(name)
+        if knob is None:
+            raise ValueError(
+                f"TPU_DDP_TUNE_KNOBS: unknown knob {name!r}; known: "
+                f"{[k.name for k in KNOBS]}")
+        if not raw:
+            out[name] = None
+            continue
+        vals = []
+        for tok in raw.split("|"):
+            tok = tok.strip()
+            if knob.values and isinstance(knob.values[0], bool):
+                vals.append(tok.lower() in ("1", "true", "yes", "on"))
+            elif knob.values and isinstance(knob.values[0], int):
+                vals.append(int(tok))
+            else:
+                vals.append(tok)
+        out[name] = tuple(vals)
+    return out
+
+
+def searchable_knobs(cfg, ctx: Workload,
+                     include_semantic: bool | None = None,
+                     only: dict | None = None) -> list[tuple]:
+    """The live search space for ``cfg`` under ``ctx``: a list of
+    ``(knob, candidate_values)`` with the config's CURRENT value always
+    first (the search must be able to keep it). Knobs are dropped when
+    the constraint model leaves fewer than two candidates (e.g. the
+    Pallas knobs off-TPU) or when ``only`` (the parsed
+    ``TPU_DDP_TUNE_KNOBS`` filter) excludes them. Per-value feasibility
+    is checked with the other knobs at their config values; the search
+    re-checks full assignments, so coupled constraints stay exact."""
+    if include_semantic is None:
+        include_semantic = os.environ.get(
+            "TPU_DDP_TUNE_SEMANTIC", "") in ("1", "true", "yes", "on")
+    if only is None:
+        only = parse_knob_filter(os.environ.get("TPU_DDP_TUNE_KNOBS"))
+    base = {k.field: getattr(cfg, k.field) for k in KNOBS}
+    out = []
+    for knob in KNOBS:
+        if only is not None and knob.name not in only:
+            continue
+        if knob.semantic and not include_semantic:
+            continue
+        if os.environ.get(knob.env):
+            # An explicit TPU_DDP_* pin is the user overriding this
+            # knob by hand; the tuner must neither search nor override
+            # it (resolve() enforces the same rule for cached entries).
+            continue
+        values = knob.values
+        if only is not None and only[knob.name] is not None:
+            values = only[knob.name]
+        if not values:
+            continue
+        current = getattr(cfg, knob.field)
+        candidates = [current]
+        for v in values:
+            if v == current or v in candidates:
+                continue
+            if not violations({**base, knob.field: v}, ctx):
+                candidates.append(v)
+        if len(candidates) >= 2:
+            out.append((knob, tuple(candidates)))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Fingerprint:
+    """The workload identity a tuning is valid for. Any field changing
+    — model, data scale, mesh, backend, software version, or the knob
+    space itself — keys a different cache entry, so a tuning can never
+    be applied to a workload it was not measured on."""
+
+    model: str
+    dataset: str
+    global_batch_size: int
+    mesh_shape: str            # "dp=8,sp=1,..." or "none"
+    strategy: str
+    processes: int
+    platform: str
+    device_kind: str
+    jax_version: str
+    jaxlib_version: str
+    space_version: str
+
+    def asdict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def key(self) -> str:
+        """Stable cache key: sha256 over the canonical JSON form."""
+        return hashlib.sha256(
+            json.dumps(self.asdict(), sort_keys=True).encode()
+        ).hexdigest()[:16]
+
+
+def fingerprint_for(cfg, strategy: str = "none", mesh=None) -> Fingerprint:
+    import jax
+    import jaxlib
+
+    from tpu_ddp.parallel.sync import canonical_strategy
+
+    if mesh is not None:
+        mesh_shape = ",".join(f"{axis}={size}"
+                              for axis, size in mesh.shape.items())
+    else:
+        mesh_shape = "none"
+    dev = jax.devices()[0]
+    return Fingerprint(
+        model=cfg.model,
+        dataset=cfg.dataset,
+        global_batch_size=cfg.global_batch_size,
+        mesh_shape=mesh_shape,
+        strategy=canonical_strategy(strategy),
+        processes=jax.process_count(),
+        platform=dev.platform,
+        device_kind=dev.device_kind,
+        jax_version=jax.__version__,
+        jaxlib_version=jaxlib.__version__,
+        space_version=space_version(),
+    )

@@ -93,6 +93,15 @@ class TrainConfig:
     # strategy; degrades to "none" with a warning otherwise.
     grad_compress: str = "none"
 
+    # Autotuning (tpu_ddp/tune/): "off" (default), "cached" (apply a
+    # previously searched tuning for this workload fingerprint when the
+    # cache has one; defaults-with-warning otherwise — safe to leave on
+    # everywhere), or "search" (run measured trials over the knob space,
+    # persist the winner, apply it). Env: TPU_DDP_AUTOTUNE; launch flag
+    # --autotune. Explicit TPU_DDP_* pins on individual knobs always
+    # beat the tuner.
+    autotune: str = "off"
+
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
     max_iters: int | None = None
@@ -180,6 +189,13 @@ class TrainConfig:
         env_gb = os.environ.get("TPU_DDP_GUARD_MAX_BAD")
         if env_gb:
             self.guard_max_bad_steps = int(env_gb)
+        env_at = os.environ.get("TPU_DDP_AUTOTUNE")
+        if env_at:
+            self.autotune = env_at
+        if self.autotune not in ("off", "cached", "search"):
+            raise ValueError(
+                f"autotune={self.autotune!r}: expected off|cached|search "
+                "(TPU_DDP_AUTOTUNE)")
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.

@@ -6,12 +6,74 @@ iteration via ``time.perf_counter_ns()``; iterations 1..39 accumulated
 iteration 39. The JAX-correct analogue must call ``block_until_ready`` on
 the step outputs before stopping the clock — otherwise async dispatch makes
 every iteration look free (SURVEY.md §7 "hard parts").
+
+:func:`timed_window_s` / :func:`warm_then_median_s` are the shared
+warm-compile + timed-window loop that used to be hand-rolled in every
+sweep script (``scripts/compress_sweep.py``,
+``scripts/bench_pipeline_schedules.py``) and now also drives the
+autotuner's trials (``tpu_ddp/tune/runner.py``): warm calls first (the
+reference's discarded iteration 0), then back-to-back calls with ONE
+sync at the window edge, so the number prices the work, not per-call
+host round-trips.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
+
+
+def _default_sync(value) -> None:
+    """Block on a step's outputs (ignores None so ``run`` callbacks that
+    return nothing still get a correct, if trusting, clock stop)."""
+    if value is not None:
+        import jax
+
+        jax.block_until_ready(value)
+
+
+def timed_window_s(run, iters: int, sync=None) -> float:
+    """Average wall seconds per call over ONE window of ``iters``
+    back-to-back ``run()`` calls, with ``sync`` (default
+    ``jax.block_until_ready``) applied to the LAST call's return value
+    before the clock stops — the async-dispatch-correct window shape
+    (one sync per window, not per call). The caller is responsible for
+    warming/compiling first; see :func:`warm_then_median_s`.
+    """
+    if iters < 1:
+        raise ValueError(f"iters must be >= 1, got {iters}")
+    sync = sync or _default_sync
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = run()
+    sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def warm_then_median_s(run, iters: int, windows: int = 1,
+                       warmup: int = 1, sync=None) -> tuple[float, list]:
+    """``warmup`` discarded calls (compile + first execution), then
+    ``windows`` timed windows of ``iters`` calls each; returns
+    ``(median avg-s/call, all window samples)``.
+
+    The shared warm/median loop (round-7 consolidation): the median over
+    >= 3 windows is how every committed number in this repo defends
+    itself against host noise (bench.py's protocol); ``windows=1``
+    reproduces the old single-window sweep scripts exactly.
+    """
+    sync = sync or _default_sync
+    out = None
+    for _ in range(max(0, warmup)):
+        out = run()
+    sync(out)
+    samples = [timed_window_s(run, iters, sync=sync)
+               for _ in range(max(1, windows))]
+    ordered = sorted(samples)
+    mid = len(ordered) // 2
+    median = (ordered[mid] if len(ordered) % 2
+              else 0.5 * (ordered[mid - 1] + ordered[mid]))
+    return median, samples
 
 
 @dataclasses.dataclass
