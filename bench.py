@@ -391,6 +391,12 @@ def run_bench(batch_size: int | None = None, timed_iters: int = 39,
             # record must say which compressor produced its numbers,
             # same contract as the dispatch_pipeline probe below.
             "grad_compress": trainer.compressor.describe(),
+            # Active memory policy (tpu_ddp/memory/) — the effective
+            # per-model value after Trainer imprints the config, so an
+            # env/flag override shows up in the record.
+            "remat": getattr(trainer.model, "remat_policy",
+                             getattr(trainer.model, "remat", "none")),
+            "act_dtype": getattr(trainer.model, "act_dtype", "compute"),
             **({"dispatch_pipeline": dispatch_pipeline}
                if dispatch_pipeline else {}),
             "batch_size": batch_size,
@@ -547,6 +553,8 @@ def run_lm_bench(batch_size: int = 8, seq_len: int = 2048,
             "timed_iters": timed_iters,
             "model": model.name,
             "flash_attention": use_flash,
+            "remat": model.remat_policy,
+            "act_dtype": model.act_dtype,
             **({"decode": decode} if decode else {}),
             "platform": jax.devices()[0].platform,
             "device_kind": jax.devices()[0].device_kind,
@@ -599,6 +607,49 @@ def run_autotune_probe(families=("vgg11_cifar10",
                 and cell["tuned_steps_per_sec"] is not None:
             cell["speedup"] = round(cell["tuned_steps_per_sec"]
                                     / cell["default_steps_per_sec"], 3)
+    return out
+
+
+def run_remat_probe(config: str = "resnet50_imagenet",
+                    policies=("none", "blocks", "conv_stages")) -> dict:
+    """Memory-policy deltas on the big-activation cell (tpu_ddp/memory/):
+    compiled bytes-accessed + temp bytes (and step time, on TPU) for
+    remat=none vs each non-duplicate conv policy, through the SAME cell
+    protocol as the committed sweep (scripts/remat_sweep.py) — so the
+    bench record and experiments/remat_sweep.json agree by construction
+    (the host_gap/depth_sweep precedent). ``best`` names the policy
+    with the largest bytes-accessed cut that does not regress the
+    measured step (untimed on CPU: best-by-bytes alone, flagged)."""
+    from scripts.remat_sweep import measure_conv_cell
+
+    bs = int(os.environ.get("TPU_DDP_RESNET_BATCH", "512"))
+    cells = {p: _sub(measure_conv_cell, config, bs, p) for p in policies}
+    out: dict = {"batch": bs, "cells": cells}
+    base = cells.get("none", {})
+    xb0 = base.get("xla_bytes_accessed")
+    tb0 = base.get("temp_bytes")
+    t0 = base.get("measured_step_s")
+    best, best_cut = None, 0.0
+    for p, cell in cells.items():
+        if p == "none" or "error" in cell:
+            continue
+        xb, tb = cell.get("xla_bytes_accessed"), cell.get("temp_bytes")
+        if xb0 and xb:
+            cell["bytes_accessed_cut_pct"] = round(
+                100.0 * (xb0 - xb) / xb0, 1)
+        if tb0 and tb:
+            cell["temp_bytes_cut_pct"] = round(
+                100.0 * (tb0 - tb) / tb0, 1)
+        t = cell.get("measured_step_s")
+        if t0 and t:
+            cell["step_time_vs_none"] = round(t / t0, 3)
+        cut = cell.get("bytes_accessed_cut_pct", 0.0)
+        timed = t0 is not None and t is not None
+        ok = (t <= 1.02 * t0) if timed else True
+        if ok and cut > best_cut:
+            best, best_cut = p, cut
+    out["best"] = best
+    out["timed"] = t0 is not None
     return out
 
 
@@ -684,7 +735,7 @@ def main() -> dict:
     extra["configs"]["transformer_lm_large"] = _sub(
         run_lm_bench, model_name="TransformerLM-large", batch_size=64,
         timed_iters=3, with_decode=True,
-        model_overrides={"remat_blocks": False},
+        model_overrides={"remat": "none"},
         trainer_overrides={"grad_accum": 16})
     large = extra["configs"]["transformer_lm_large"]
     if "error" not in large:
@@ -693,7 +744,7 @@ def main() -> dict:
             r = _sub(run_lm_bench, model_name="TransformerLM-large",
                      batch_size=bs, timed_iters=2, with_xla_flops=False,
                      with_decode=False,
-                     model_overrides={"remat_blocks": False},
+                     model_overrides={"remat": "none"},
                      trainer_overrides={"grad_accum": ga})
             ladder[f"{bs}x{ga}"] = (
                 {"batch": bs, "grad_accum": ga,
@@ -712,7 +763,7 @@ def main() -> dict:
     extra["configs"]["transformer_lm_long"] = _sub(
         run_lm_bench, model_name="TransformerLM-large", batch_size=1,
         seq_len=8192, timed_iters=5, with_xla_flops=False,
-        with_decode=False, model_overrides={"remat_blocks": False})
+        with_decode=False, model_overrides={"remat": "none"})
     lm_flash = _sub(run_lm_bench, use_flash=True)
     lm_jnp = _sub(run_lm_bench, use_flash=False, timed_iters=10,
                   with_xla_flops=False)
@@ -754,6 +805,10 @@ def main() -> dict:
     # autotuner finds anything the hand-tuned defaults miss, and proves
     # its never-ship-a-regression guard on the real chip.
     extra["autotune"] = _sub(run_autotune_probe)
+    # Memory-policy probe (tpu_ddp/memory/): what remat buys (or costs)
+    # on the big-activation ResNet-50 cell, measured on this chip with
+    # the committed sweep's own protocol.
+    extra["remat"] = _sub(run_remat_probe)
     # Run-to-run variance control (round-3 verdict item 2): every
     # timed number is the MEDIAN of >= 3 consecutive chained windows,
     # with the raw per-window samples recorded next to it

@@ -156,7 +156,8 @@ def run_part(part: str, argv=None):
     import jax.numpy as jnp
     model = get_model(cfg.model, num_classes=cfg.num_classes,
                       use_pallas_bn=cfg.pallas_bn,
-                      compute_dtype=jnp.dtype(cfg.compute_dtype))
+                      compute_dtype=jnp.dtype(cfg.compute_dtype),
+                      remat=cfg.remat, act_dtype=cfg.act_dtype)
     from tpu_ddp.utils.metrics import from_env as metrics_from_env
     from tpu_ddp.utils.profiling import profile_dir_from_env, profile_trace
 

@@ -20,7 +20,12 @@ keeping them honest — this audit is that compiler. CI runs it
    without wiring to the registry's env var;
 5. a perf-knob ``TPU_DDP_*`` var parsed by ``utils/config.py`` with NO
    registry entry — the drift that motivated this script: a new knob
-   must land in the search space, not beside it.
+   must land in the search space, not beside it;
+6. a string-valued knob whose env surface ACCEPTS junk — setting the
+   env var to a non-candidate token must fail construction
+   (ValueError), not land in the field: a typo'd ``--remat``/env value
+   silently training the default would be the worst kind of drift.
+   Behavioral, like (2).
 
 Exit 0 and silence = all surfaces agree.
 """
@@ -133,6 +138,21 @@ def audit(knobs=None) -> list[str]:
                     f"did not set TrainConfig.{knob.field} (got "
                     f"{got!r}, wanted {probe!r}) — env var not parsed "
                     "or parsed into a different field")
+
+        # (6) string-valued knobs must VALIDATE their env surface:
+        # junk has to raise, not land in the field.
+        if knob.values and isinstance(knob.values[0], str):
+            junk = "knob-audit-junk"
+            with _scrubbed_env(**{knob.env: junk}):
+                try:
+                    got = getattr(TrainConfig(), knob.field)
+                except Exception:  # noqa: BLE001 — raising IS the pass
+                    got = None
+            if got == junk:
+                problems.append(
+                    f"{knob.name}: {knob.env}={junk!r} was accepted "
+                    f"into TrainConfig.{knob.field} — the env surface "
+                    "must validate (raise ValueError) on junk values")
 
         # (4) launch flag exists and wires to this env var
         if knob.flag is not None:

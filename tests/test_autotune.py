@@ -79,13 +79,15 @@ class TestConstraints:
 
 
 class TestSearchSpace:
-    def test_cpu_single_process_space_has_three_knobs(self, cfg):
+    def test_cpu_single_process_space_has_schedule_knobs(self, cfg):
         # The acceptance floor: the vgg11 CPU smoke config must expose a
         # >=3-knob search (pallas knobs are off-TPU, grad_compress has
-        # no dp>1 syncing rung -> both filtered by the constraints).
+        # no dp>1 syncing rung -> both filtered by the constraints;
+        # act_dtype is semantic-gated; remat is numerics-preserving so
+        # it IS searchable by default).
         names = {k.name for k, _ in searchable_knobs(cfg, CPU1)}
         assert names == {"dispatch_depth", "steps_per_dispatch",
-                         "device_prefetch"}
+                         "device_prefetch", "remat"}
 
     def test_current_value_listed_first(self, cfg):
         cfg.dispatch_depth = 4

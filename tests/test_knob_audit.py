@@ -71,6 +71,55 @@ def test_reverse_check_catches_unregistered_perf_env():
                for f in findings)
 
 
+def test_memory_policy_knobs_registered():
+    # The two memory-policy knobs (tpu_ddp/memory/) carry the full
+    # 4-surface contract; act_dtype changes numerics so it must be
+    # semantic (excluded from the default search like compute_dtype),
+    # remat must not be (it re-executes the same ops).
+    remat = knob_by_field("remat")
+    act = knob_by_field("act_dtype")
+    assert remat is not None and act is not None
+    assert remat.env == "TPU_DDP_REMAT" and remat.flag == "--remat"
+    assert act.env == "TPU_DDP_ACT_DTYPE" and act.flag == "--act-dtype"
+    assert act.semantic and not remat.semantic
+    assert set(remat.values) == {"none", "blocks", "conv_stages", "dots"}
+    assert set(act.values) == {"compute", "bf16", "f32"}
+
+
+def test_reverse_check_catches_unregistered_remat_env():
+    # Drop the remat entry: config.py still parses TPU_DDP_REMAT, so
+    # the reverse sweep must flag the knob living outside the space.
+    pruned = tuple(k for k in KNOBS if k.name != "remat")
+    findings = audit(pruned)
+    assert any("TPU_DDP_REMAT" in f and "no registry entry" in f
+               for f in findings)
+
+
+def test_catches_junk_accepting_string_env():
+    # Seed check (6)'s drift class: a config whose env surface swallows
+    # validation errors lets junk land in string fields — the audit
+    # must flag every such knob. Seeded by wrapping __post_init__ so
+    # the ValueError the validators raise is suppressed (the field
+    # keeps the junk the parse branch already wrote).
+    from tpu_ddp.utils.config import TrainConfig
+    orig = TrainConfig.__post_init__
+
+    def sloppy(self):
+        try:
+            orig(self)
+        except ValueError:
+            pass
+
+    TrainConfig.__post_init__ = sloppy
+    try:
+        findings = audit()
+        assert any("knob-audit-junk" in f and "must validate" in f
+                   for f in findings)
+        assert any("TPU_DDP_REMAT" in f for f in findings)
+    finally:
+        TrainConfig.__post_init__ = orig
+
+
 def test_nonperf_allowlist_is_exact():
     # Every allowlisted var must still be absent from the registry —
     # an entry appearing for one means the allowlist line should go.

@@ -1,0 +1,426 @@
+"""Memory-policy subsystem (tpu_ddp/memory/): remat + act_dtype.
+
+What the policy layer must guarantee, each pinned here:
+
+- the policy vocabulary validates at every surface (helpers, model
+  construction, TrainConfig env parse) — a typo'd policy raises, never
+  silently trains the default;
+- gradients under every remat policy match the remat=none program
+  (recompute re-executes the SAME ops; only what autodiff saves
+  changes) — per family, tiny f32 models;
+- ``act_dtype`` changes numerics only through the saved boundary
+  round-trip (bf16 boundaries under f32 compute: small, bounded drift);
+- the deprecated ``remat_blocks`` alias resolves through
+  ``remat_policy`` and the LM-large preset still gets block remat;
+- the config-level knobs imprint onto models at Trainer construction
+  (env -> TrainConfig -> apply_policy) without downgrading explicit
+  model policies;
+- the policied program composes with the engine surfaces: StepGuard
+  skip-rollback, the K-step scan, the streaming loop at
+  dispatch_depth>0, and the grad_compress EF carry (slow tier);
+- the motivating LM claim: plain (non-grad-accum) batch-256 LM-small
+  compiles under remat=blocks with a strictly smaller XLA temp-buffer
+  peak than remat=none (slow tier; abstract AOT compile, no buffers
+  materialize).
+"""
+
+import dataclasses
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.memory import (ACT_DTYPES, REMAT_POLICIES, apply_policy,
+                            cast_saved, checkpoint_policy,
+                            effective_remat, family_for_model,
+                            resolve_act_dtype, validate_act_dtype,
+                            validate_remat, wrap_stage)
+from tpu_ddp.models import make_transformer, make_vit
+from tpu_ddp.models.resnet import ResNetModel
+from tpu_ddp.models.vgg import VGGModel
+
+
+# ---------------------------------------------------------------------
+# tiny per-family models (f32: equivalence must not hide in bf16 noise)
+# ---------------------------------------------------------------------
+
+def _tiny_vgg(**kw):
+    return VGGModel(name="VGG-test", cfg=(8, "M", 16, "M"),
+                    num_classes=4, compute_dtype=jnp.float32, **kw)
+
+
+def _tiny_resnet(**kw):
+    return ResNetModel(name="ResNet-test", stage_blocks=(1, 1),
+                       num_classes=4, small_inputs=True,
+                       compute_dtype=jnp.float32, **kw)
+
+
+def _tiny_vit(**kw):
+    return make_vit("ViT-tiny", image_size=8, patch_size=4,
+                    num_layers=2, num_heads=2, d_model=16, d_ff=32,
+                    num_classes=4, compute_dtype=jnp.float32, **kw)
+
+
+def _tiny_lm(**kw):
+    return make_transformer("TransformerLM-tiny", max_seq_len=16,
+                            compute_dtype=jnp.float32, **kw)
+
+
+_FAMILIES = {
+    "vgg": (_tiny_vgg, lambda: np.random.default_rng(0).normal(
+        size=(2, 4, 4, 3)).astype(np.float32)),
+    "resnet": (_tiny_resnet, lambda: np.random.default_rng(0).normal(
+        size=(2, 8, 8, 3)).astype(np.float32)),
+    "vit": (_tiny_vit, lambda: np.random.default_rng(0).normal(
+        size=(2, 8, 8, 3)).astype(np.float32)),
+    "lm": (_tiny_lm, lambda: np.random.default_rng(0).integers(
+        0, 1024, size=(2, 16)).astype(np.int32)),
+}
+
+
+def _loss_and_grads(model, x):
+    params = model.init(jax.random.key(0))
+
+    def loss(p):
+        out = model.apply(p, jnp.asarray(x))
+        return jnp.mean(jnp.square(out.astype(jnp.float32)))
+
+    value, grads = jax.jit(jax.value_and_grad(loss))(params)
+    return float(value), grads
+
+
+def _assert_grads_close(ga, gb, rtol=1e-4, atol=1e-6):
+    la, lb = jax.tree.leaves(ga), jax.tree.leaves(gb)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------
+# policy helpers
+# ---------------------------------------------------------------------
+
+class TestHelpers:
+    def test_vocabulary(self):
+        assert set(REMAT_POLICIES) == {"none", "blocks", "conv_stages",
+                                       "dots"}
+        assert set(ACT_DTYPES) == {"compute", "bf16", "f32"}
+        for v in REMAT_POLICIES:
+            assert validate_remat(v) == v
+        for v in ACT_DTYPES:
+            assert validate_act_dtype(v) == v
+
+    def test_junk_rejected_naming_the_env_surface(self):
+        with pytest.raises(ValueError, match="TPU_DDP_REMAT"):
+            validate_remat("junk")
+        with pytest.raises(ValueError, match="TPU_DDP_ACT_DTYPE"):
+            validate_act_dtype("fp8")
+
+    def test_resolve_act_dtype(self):
+        assert resolve_act_dtype("compute", jnp.bfloat16) == jnp.bfloat16
+        assert resolve_act_dtype("compute", jnp.float32) == jnp.float32
+        assert resolve_act_dtype("bf16", jnp.float32) == jnp.bfloat16
+        assert resolve_act_dtype("f32", jnp.bfloat16) == jnp.float32
+
+    def test_cast_saved_matching_dtype_is_identity(self):
+        # The default policy must trace the EXACT pre-policy program:
+        # astype to the same dtype returns the operand, no convert op.
+        x = jnp.ones((3,), jnp.float32)
+        assert cast_saved(x, "compute", jnp.float32) is x
+        assert cast_saved(x, "f32", jnp.float32) is x
+        assert cast_saved(x, "bf16", jnp.float32).dtype == jnp.bfloat16
+
+    def test_checkpoint_policy(self):
+        assert checkpoint_policy("dots") is \
+            jax.checkpoint_policies.dots_saveable
+        assert checkpoint_policy("blocks") is None
+        assert checkpoint_policy("conv_stages") is None
+
+    def test_wrap_stage_none_is_identity(self):
+        def f(x):
+            return x * 2
+        assert wrap_stage(f, "none") is f
+
+    def test_wrap_stage_blocks_is_checkpoint(self):
+        f = wrap_stage(lambda x: jnp.sin(x) * 2, "blocks")
+        jaxpr = str(jax.make_jaxpr(jax.grad(f))(1.0))
+        assert "remat" in jaxpr or "checkpoint" in jaxpr
+
+    def test_effective_remat_degrades_conv_stages_on_attn(self):
+        with pytest.warns(UserWarning, match="conv_stages"):
+            assert effective_remat("conv_stages", "attn") == "blocks"
+        assert effective_remat("conv_stages", "conv") == "conv_stages"
+        assert effective_remat("dots", "conv") == "dots"
+        assert effective_remat("none", "attn") == "none"
+
+    def test_family_for_model(self):
+        assert family_for_model("VGG11") == "conv"
+        assert family_for_model("ResNet50") == "conv"
+        assert family_for_model("ViT-tiny") == "attn"
+        assert family_for_model("TransformerLM-small") == "attn"
+        assert family_for_model("SomethingElse") == ""
+
+
+class TestApplyPolicy:
+    def test_defaults_are_identity(self):
+        m = _tiny_vgg()
+        assert apply_policy(m) is m
+
+    def test_imprints_non_default(self):
+        m = apply_policy(_tiny_vgg(), remat="blocks", act_dtype="bf16")
+        assert m.remat == "blocks" and m.act_dtype == "bf16"
+
+    def test_never_downgrades_explicit_model_policy(self):
+        # Config defaults (remat="none") must not strip the LM-large
+        # preset's built-in block remat.
+        m = _tiny_lm(remat="blocks")
+        assert apply_policy(m, remat="none").remat == "blocks"
+
+    def test_non_default_config_wins(self):
+        m = _tiny_lm(remat="blocks")
+        assert apply_policy(m, remat="dots").remat == "dots"
+
+    def test_warns_and_ignores_model_without_fields(self):
+        @dataclasses.dataclass(frozen=True)
+        class NoPolicy:
+            pass
+        m = NoPolicy()
+        with pytest.warns(UserWarning, match="NoPolicy"):
+            assert apply_policy(m, remat="blocks") is m
+
+    def test_model_constructor_validates(self):
+        with pytest.raises(ValueError, match="remat"):
+            _tiny_vgg(remat="junk")
+        with pytest.raises(ValueError, match="act_dtype"):
+            _tiny_resnet(act_dtype="fp8")
+
+
+class TestAlias:
+    def test_remat_blocks_alias_resolves(self):
+        assert _tiny_lm(remat_blocks=True).remat_policy == "blocks"
+        assert _tiny_lm().remat_policy == "none"
+        assert _tiny_lm(remat="dots").remat_policy == "dots"
+
+    def test_lm_large_preset_keeps_block_remat(self):
+        # Construction only (the ~740M-param init never runs).
+        assert make_transformer("TransformerLM-large").remat_policy \
+            == "blocks"
+
+
+# ---------------------------------------------------------------------
+# gradient equivalence: remat re-executes, never changes, the math
+# ---------------------------------------------------------------------
+
+class TestGradientEquivalence:
+    _cache = {}
+
+    def _baseline(self, family):
+        if family not in self._cache:
+            build, data = _FAMILIES[family]
+            self._cache[family] = _loss_and_grads(build(), data())
+        return self._cache[family]
+
+    # Tier-1 keeps exactly ONE equivalence cell — the vgg baseline is
+    # the cheapest compile and conv_stages exercises the real
+    # jax.checkpoint wrapping path; every other (family, policy) cell
+    # runs in the slow tier (the 870 s tier-1 wall-clock budget has
+    # ~20 s of headroom over the seed suite on a single-core host).
+    @pytest.mark.parametrize("family,remat", [
+        ("vgg", "conv_stages"),
+    ])
+    def test_core_cells(self, family, remat):
+        l0, g0 = self._baseline(family)
+        build, data = _FAMILIES[family]
+        l1, g1 = _loss_and_grads(build(remat=remat), data())
+        assert np.isclose(l0, l1, rtol=1e-5)
+        _assert_grads_close(g0, g1)
+
+    @pytest.mark.slow  # 8 more tiny-model grad compiles
+    @pytest.mark.parametrize("family,remat", [
+        ("vgg", "blocks"), ("lm", "blocks"), ("lm", "dots"),
+        ("resnet", "blocks"), ("resnet", "conv_stages"),
+        ("resnet", "dots"), ("vit", "blocks"), ("vit", "dots"),
+        ("vgg", "dots"),
+    ])
+    def test_remaining_cells(self, family, remat):
+        l0, g0 = self._baseline(family)
+        build, data = _FAMILIES[family]
+        l1, g1 = _loss_and_grads(build(remat=remat), data())
+        assert np.isclose(l0, l1, rtol=1e-5)
+        _assert_grads_close(g0, g1)
+
+    @pytest.mark.slow  # one more tiny-vgg grad compile
+    def test_act_dtype_bf16_bounded_drift(self):
+        # bf16 boundaries under f32 compute: the ONLY numeric change is
+        # the saved-boundary round-trip, so gradients sit within bf16's
+        # ~3 decimal digits of the f32 program — close but NOT equal
+        # (equality would mean the cast never happened).
+        l0, g0 = self._baseline("vgg")
+        l1, g1 = _loss_and_grads(_tiny_vgg(remat="blocks",
+                                           act_dtype="bf16"),
+                                 _FAMILIES["vgg"][1]())
+        assert np.isclose(l0, l1, rtol=2e-2)
+        _assert_grads_close(g0, g1, rtol=5e-2, atol=5e-3)
+
+    @pytest.mark.slow  # one more tiny-LM grad compile
+    def test_conv_stages_on_attn_degrades_equivalently(self):
+        with pytest.warns(UserWarning, match="conv_stages"):
+            l1, g1 = _loss_and_grads(_tiny_lm(remat="conv_stages"),
+                                     _FAMILIES["lm"][1]())
+        l0, g0 = self._baseline("lm")
+        assert np.isclose(l0, l1, rtol=1e-5)
+        _assert_grads_close(g0, g1)
+
+
+# ---------------------------------------------------------------------
+# engine composition
+# ---------------------------------------------------------------------
+
+def _trainer(devices, dp=1, model=None, **cfg_kw):
+    from tpu_ddp.parallel.mesh import make_mesh
+    from tpu_ddp.train.engine import Trainer
+    from tpu_ddp.utils.config import TrainConfig
+    return Trainer(model if model is not None else _tiny_vgg(),
+                   TrainConfig(**cfg_kw), strategy="fused",
+                   mesh=make_mesh(devices[:dp]))
+
+
+def _vgg_batch(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(n, 4, 4, 3)).astype(np.float32),
+            rng.integers(0, 4, size=n).astype(np.int32))
+
+
+def _flat(tree):
+    return np.concatenate([np.ravel(np.asarray(jax.device_get(l)))
+                           for l in jax.tree.leaves(tree)])
+
+
+class TestEngineComposition:
+    def test_env_knobs_imprint_through_trainer(self, devices,
+                                               monkeypatch):
+        monkeypatch.setenv("TPU_DDP_REMAT", "conv_stages")
+        monkeypatch.setenv("TPU_DDP_ACT_DTYPE", "f32")
+        tr = _trainer(devices)
+        assert tr.model.remat == "conv_stages"
+        assert tr.model.act_dtype == "f32"
+
+    def test_config_junk_remat_rejected(self):
+        from tpu_ddp.utils.config import TrainConfig
+        with pytest.raises(ValueError, match="remat"):
+            TrainConfig(remat="junk")
+        with pytest.raises(ValueError, match="act_dtype"):
+            TrainConfig(act_dtype="junk")
+
+    @pytest.mark.slow  # two trainer compiles
+    def test_trajectory_matches_none(self, devices):
+        def run(remat):
+            tr = _trainer(devices, remat=remat)
+            state = tr.init_state()
+            for i in range(2):
+                state, loss = tr.train_step(
+                    state, *tr.put_batch(*_vgg_batch(seed=i)))
+            return _flat(state.params), float(
+                np.ravel(np.asarray(loss))[0])
+        p0, l0 = run("none")
+        p1, l1 = run("conv_stages")
+        assert np.isclose(l0, l1, rtol=1e-5)
+        np.testing.assert_allclose(p0, p1, rtol=1e-4, atol=1e-6)
+
+    @pytest.mark.slow  # one trainer compile
+    def test_step_guard_skip_rolls_back_under_remat(self, devices):
+        tr = _trainer(devices, remat="blocks")
+        state = tr.init_state()
+        state, _ = tr.train_step(state, *tr.put_batch(*_vgg_batch()))
+        p0 = _flat(state.params)
+        x, y = _vgg_batch(seed=5)
+        x[0, 0, 0, 0] = np.nan
+        state, _ = tr.train_step(state, *tr.put_batch(x, y))
+        assert tr.last_step_skipped()
+        np.testing.assert_array_equal(p0, _flat(state.params))
+
+    @pytest.mark.slow  # two trainer compiles (scan + single)
+    def test_multi_step_scan_matches_single_steps(self, devices):
+        tr = _trainer(devices, remat="blocks")
+        state = tr.init_state()
+        for i in range(2):
+            state, _ = tr.train_step(state,
+                                     *tr.put_batch(*_vgg_batch(seed=i)))
+        tr2 = _trainer(devices, remat="blocks")
+        s2 = tr2.init_state()
+        xs, ys = zip(*[_vgg_batch(seed=i) for i in range(2)])
+        s2, _ = tr2.build_multi_step(2)(
+            s2, *tr2.put_batches(np.stack(xs), np.stack(ys)))
+        np.testing.assert_allclose(_flat(state.params),
+                                   _flat(s2.params),
+                                   rtol=1e-6, atol=1e-7)
+
+    @pytest.mark.slow  # streaming epoch at depth 2
+    def test_dispatch_depth_streams_under_remat(self, devices):
+        tr = _trainer(devices, remat="blocks", dispatch_depth=2)
+        state = tr.init_state()
+
+        def gen():
+            for i in range(5):
+                yield _vgg_batch(seed=i)
+        state, stats = tr.train_epoch(state, gen(), epoch=0)
+        assert np.all(np.isfinite(_flat(state.params)))
+
+    @pytest.mark.slow  # dp=4 compile with int8 wire + remat
+    def test_grad_compress_ef_carry_composes(self, devices):
+        """int8 wire + error-feedback carry + block remat in ONE step:
+        the policied grads are what the compressor sees, and the
+        recompute must not perturb the deterministic EF trajectory
+        (recompute re-executes identical ops -> same grads -> same
+        quantization decisions)."""
+        def run(remat):
+            tr = _trainer(devices, dp=4, remat=remat,
+                          grad_compress="int8")
+            state = tr.init_state()
+            for i in range(2):
+                state, loss = tr.train_step(
+                    state, *tr.put_batch(*_vgg_batch(seed=i)))
+                jax.block_until_ready(state.params)
+            return state
+        s_remat = run("blocks")
+        s_none = run("none")
+        assert np.any(_flat(s_remat.comp_state["residual"]))
+        np.testing.assert_allclose(
+            _flat(s_remat.params), _flat(s_none.params),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            _flat(s_remat.comp_state["residual"]),
+            _flat(s_none.comp_state["residual"]),
+            rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------
+# the motivating LM claim: plain batch 256 compiles under block remat
+# ---------------------------------------------------------------------
+
+class TestLMPlainBatchCompile:
+    @pytest.mark.slow  # two LM-small b=256 AOT compiles (~1-2 min)
+    def test_batch_256_compiles_with_smaller_temp_peak(self):
+        """EXPERIMENTS §8/§10: plain (non-grad-accum) LM-small batches
+        > 32 failed to compile on the v5e — the saved-activation
+        working set outgrows HBM. Block remat is the fix. The compile
+        itself is abstract (jax.eval_shape params -> AOT lower), so
+        this regression runs on hosts that could never hold the
+        no-remat buffers; the temp-peak comparison is XLA's own buffer
+        assignment, a platform-independent claim."""
+        from scripts.remat_sweep import measure_lm_cell
+        cells = {r: measure_lm_cell(batch=256, remat=r,
+                                    with_time=False)
+                 for r in ("none", "blocks")}
+        for cell in cells.values():
+            assert "error" not in cell
+            assert cell.get("temp_bytes", 0) > 0
+        # Whether the blocks program FITS a given HBM is a TPU-run
+        # claim; the platform-independent regression is the ordering —
+        # block remat must cut the temp peak decisively (measured ~2x
+        # on this jaxlib; 0.75 leaves headroom for compiler drift).
+        assert cells["blocks"]["temp_bytes"] \
+            < 0.75 * cells["none"]["temp_bytes"]

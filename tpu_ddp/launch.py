@@ -392,6 +392,23 @@ def main(argv=None) -> int:
                         "carries an error-feedback residual; int8-noef "
                         "is the ablation without it). Sets "
                         "TPU_DDP_GRAD_COMPRESS for every rank")
+    p.add_argument("--remat", default=None,
+                   choices=("none", "blocks", "conv_stages", "dots"),
+                   help="activation rematerialization policy "
+                        "(tpu_ddp/memory/): which model stages "
+                        "recompute in the backward pass instead of "
+                        "saving activations — 'blocks' (per residual/"
+                        "transformer block), 'conv_stages' (per "
+                        "resolution stage, conv families), 'dots' "
+                        "(save matmul outputs only). Sets "
+                        "TPU_DDP_REMAT for every rank")
+    p.add_argument("--act-dtype", default=None,
+                   choices=("compute", "bf16", "f32"),
+                   help="saved-residual dtype at remat-stage "
+                        "boundaries (tpu_ddp/memory/): what autodiff "
+                        "stores between forward and backward; stage "
+                        "arithmetic stays in compute_dtype. Sets "
+                        "TPU_DDP_ACT_DTYPE for every rank")
     p.add_argument("--autotune", default=None,
                    choices=("off", "cached", "search"),
                    help="perf-knob autotuning (tpu_ddp/tune/): 'cached' "
@@ -410,6 +427,10 @@ def main(argv=None) -> int:
         env["TPU_DDP_DISPATCH_DEPTH"] = str(args.dispatch_depth)
     if args.grad_compress is not None:
         env["TPU_DDP_GRAD_COMPRESS"] = args.grad_compress
+    if args.remat is not None:
+        env["TPU_DDP_REMAT"] = args.remat
+    if args.act_dtype is not None:
+        env["TPU_DDP_ACT_DTYPE"] = args.act_dtype
     if args.autotune is not None:
         env["TPU_DDP_AUTOTUNE"] = args.autotune
     env = env or None

@@ -1,0 +1,26 @@
+"""Memory-policy subsystem: activation remat + residual precision.
+
+See :mod:`tpu_ddp.memory.policy` for the model, the policy table and
+the knob surfaces (``TrainConfig.remat`` / ``act_dtype``).
+"""
+
+from tpu_ddp.memory.policy import (  # noqa: F401
+    ACT_DTYPES,
+    REMAT_POLICIES,
+    apply_policy,
+    cast_saved,
+    checkpoint_policy,
+    effective_remat,
+    family_for_model,
+    resolve_act_dtype,
+    validate_act_dtype,
+    validate_remat,
+    wrap_stage,
+)
+
+__all__ = [
+    "ACT_DTYPES", "REMAT_POLICIES", "apply_policy", "cast_saved",
+    "checkpoint_policy", "effective_remat", "family_for_model",
+    "resolve_act_dtype", "validate_act_dtype", "validate_remat",
+    "wrap_stage",
+]

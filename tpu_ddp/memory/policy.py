@@ -1,0 +1,193 @@
+"""Activation-rematerialization & residual-precision policies.
+
+The round-5 roofline validation (EXPERIMENTS.md §9) pinned the conv
+families to the memory wall: ResNet-50 sustains 95.7% of the v5e's
+819 GB/s HBM peak while its flops-bound floor is ~4x lower. The only
+lever that moves a bandwidth-bound step is moving fewer bytes, and the
+classic bytes-for-FLOPs trade is activation rematerialization: wrap a
+model stage in ``jax.checkpoint`` so autodiff saves ONLY the stage's
+boundary input and recomputes the interior in the backward pass,
+instead of materializing every interior activation (and, for BN/LN,
+their f32 statistics residuals) to HBM between the forward and the
+backward. On the LM side the same lever is what lets plain
+(non-grad-accum) large batches compile at all — the saved-activation
+working set is the thing that outgrows HBM (EXPERIMENTS.md §10).
+
+This module is the ONE home of that policy for the whole model zoo.
+Every model family carries two static dataclass fields and resolves
+them through the helpers here:
+
+``remat`` — which regions recompute:
+
+- ``"none"``: save everything (the default; bit-identical to the
+  pre-policy programs).
+- ``"blocks"``: one checkpoint region per natural block — residual
+  bottleneck for ResNet, conv->BN->ReLU unit for VGG, transformer
+  block for the LM/ViT. Only block-boundary residuals are saved.
+- ``"conv_stages"``: coarser regions for the conv families — one per
+  resolution stage (ResNet's 4 stages; VGG's between-pool groups).
+  Fewer saved boundaries than ``blocks``, more recompute. Transformer
+  families have no conv stages: the policy degrades to ``blocks`` with
+  a warning (mirrored by the autotuner's constraint model so the
+  search never measures the duplicate cell).
+- ``"dots"``: checkpoint with ``jax.checkpoint_policies.dots_saveable``
+  — matmul outputs are saved, everything elementwise (LN, softmax,
+  GELU, BN statistics) recomputes. The standard transformer middle
+  ground. Conv stages contain no ``dot_general`` (convs are
+  ``conv_general_dilated``), so for conv families this compiles to the
+  same program as ``conv_stages`` (also encoded in the constraint
+  model as a duplicate cell).
+
+``act_dtype`` — the dtype of the SAVED stage-boundary residual stream:
+
+- ``"compute"``: no cast (default).
+- ``"bf16"`` / ``"f32"``: each stage boundary is cast to this dtype
+  before entering the next region, and every region casts back to
+  ``compute_dtype`` on entry — so the cast changes what autodiff
+  SAVES (the boundary tensors), not the arithmetic inside the stages.
+  ``bf16`` under f32 compute halves the residual-stream bytes
+  (semantic: boundaries round-trip through bf16); ``f32`` under bf16
+  compute is the precision-up direction.
+
+Models apply the policy themselves (their ``apply`` calls
+:func:`wrap_stage` / :func:`cast_saved` on static fields, so the
+policied program traces through every engine jit surface — plain jit,
+``shard_map``, the K-step scan, FSDP — with zero engine changes), and
+``train/engine.py`` imprints the config-level knobs onto the model via
+:func:`apply_policy` at Trainer construction. The 4-surface knob
+contract (``TrainConfig.remat`` / ``TPU_DDP_REMAT`` / ``launch
+--remat`` / ``tune/space.py``) is audited by ``scripts/knob_audit.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "REMAT_POLICIES", "ACT_DTYPES", "validate_remat",
+    "validate_act_dtype", "resolve_act_dtype", "cast_saved",
+    "checkpoint_policy", "wrap_stage", "effective_remat",
+    "family_for_model", "apply_policy",
+]
+
+REMAT_POLICIES = ("none", "blocks", "conv_stages", "dots")
+ACT_DTYPES = ("compute", "bf16", "f32")
+
+
+def validate_remat(value: str, where: str = "remat") -> str:
+    if value not in REMAT_POLICIES:
+        raise ValueError(
+            f"{where}={value!r}: expected one of {'|'.join(REMAT_POLICIES)}"
+            " (TPU_DDP_REMAT)")
+    return value
+
+
+def validate_act_dtype(value: str, where: str = "act_dtype") -> str:
+    if value not in ACT_DTYPES:
+        raise ValueError(
+            f"{where}={value!r}: expected one of {'|'.join(ACT_DTYPES)}"
+            " (TPU_DDP_ACT_DTYPE)")
+    return value
+
+
+def resolve_act_dtype(act_dtype: str, compute_dtype) -> jnp.dtype:
+    """The concrete dtype the saved boundary residuals carry."""
+    validate_act_dtype(act_dtype)
+    if act_dtype == "compute":
+        return jnp.dtype(compute_dtype)
+    return jnp.dtype(jnp.bfloat16 if act_dtype == "bf16" else jnp.float32)
+
+
+def cast_saved(x, act_dtype: str, compute_dtype):
+    """Cast a stage-boundary residual to the saved-activation dtype.
+
+    A no-op (the operand itself, no inserted convert) when the dtypes
+    already match — the default policy traces the exact pre-policy
+    program."""
+    return x.astype(resolve_act_dtype(act_dtype, compute_dtype))
+
+
+def checkpoint_policy(remat: str):
+    """The ``jax.checkpoint`` ``policy=`` argument for a remat mode
+    (None = save nothing inside the region, i.e. full remat)."""
+    validate_remat(remat)
+    if remat == "dots":
+        return jax.checkpoint_policies.dots_saveable
+    return None
+
+
+def wrap_stage(fn, remat: str, *, prevent_cse: bool = True,
+               static_argnums=()):
+    """Wrap one model stage under the remat policy; identity for
+    ``"none"``. ``prevent_cse=False`` is for stages already inside a
+    ``lax.scan`` body (the scan's loop structure prevents the
+    problematic CSE — parallel/pipeline.py)."""
+    if remat == "none":
+        return fn
+    kwargs = {}
+    policy = checkpoint_policy(remat)
+    if policy is not None:
+        kwargs["policy"] = policy
+    return jax.checkpoint(fn, prevent_cse=prevent_cse,
+                          static_argnums=static_argnums, **kwargs)
+
+
+def effective_remat(remat: str, family: str) -> str:
+    """Resolve a remat mode against a model family ("conv" | "attn").
+
+    Transformer families have no conv stages — ``conv_stages`` degrades
+    to ``blocks`` with a warning (the grad_compress degrade precedent:
+    warn, never silently change semantics the user asked for). The
+    autotuner's constraint model (tune/space.py violations) mirrors
+    this so the search skips the duplicate cell."""
+    validate_remat(remat)
+    if family == "attn" and remat == "conv_stages":
+        warnings.warn(
+            "remat='conv_stages' on a transformer family (no conv "
+            "stages): degrading to per-block remat ('blocks')",
+            stacklevel=2)
+        return "blocks"
+    return remat
+
+
+def family_for_model(name: str) -> str:
+    """Model-family classification for the constraint model:
+    "conv" | "attn" | "" (unknown)."""
+    if name.startswith(("VGG", "ResNet")):
+        return "conv"
+    if name.startswith(("ViT", "TransformerLM")):
+        return "attn"
+    return ""
+
+
+def apply_policy(model, remat: str = "none", act_dtype: str = "compute"):
+    """Imprint config-level memory policy onto a built model.
+
+    Models carry the policy as static frozen-dataclass fields, so this
+    is a ``dataclasses.replace`` — cheap, and every jit surface that
+    closes over the model retraces the policied apply automatically.
+    Config defaults never DOWNGRADE a model that was constructed with
+    an explicit policy (e.g. the TransformerLM-large preset's block
+    remat); a non-default config value always wins, since the config
+    is the tuner/env/flag surface."""
+    validate_remat(remat)
+    validate_act_dtype(act_dtype)
+    if remat == "none" and act_dtype == "compute":
+        return model
+    if not (dataclasses.is_dataclass(model) and hasattr(model, "remat")
+            and hasattr(model, "act_dtype")):
+        warnings.warn(
+            f"model {type(model).__name__} does not carry memory-policy "
+            f"fields; remat={remat!r} / act_dtype={act_dtype!r} ignored",
+            stacklevel=2)
+        return model
+    updates = {}
+    if remat != "none" and model.remat != remat:
+        updates["remat"] = remat
+    if act_dtype != "compute" and model.act_dtype != act_dtype:
+        updates["act_dtype"] = act_dtype
+    return dataclasses.replace(model, **updates) if updates else model

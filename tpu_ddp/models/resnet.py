@@ -11,6 +11,7 @@ semantic chosen for parity with the reference (part1/model.py:24,
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import jax
@@ -58,6 +59,18 @@ class ResNetModel:
     # Fused Pallas BatchNorm+ReLU kernel for the relu=True blocks
     # (tpu_ddp/ops/pallas/bn_relu.py); BN-without-relu stays on the jnp path.
     use_pallas_bn: bool = False
+    # Memory policy (tpu_ddp/memory/policy.py): "blocks" remats each
+    # bottleneck residual block, "conv_stages" each of the 4 resolution
+    # stages ("dots" has nothing to save inside a conv stage, so it
+    # compiles to the conv_stages program); act_dtype is the saved
+    # dtype of the inter-block residual stream.
+    remat: str = "none"
+    act_dtype: str = "compute"
+
+    def __post_init__(self):
+        from tpu_ddp.memory import validate_act_dtype, validate_remat
+        validate_remat(self.remat)
+        validate_act_dtype(self.act_dtype)
 
     def _conv_bn(self, key, h, w, c_in, c_out):
         k_w, = jax.random.split(key, 1)
@@ -112,7 +125,40 @@ class ResNetModel:
             y = jnp.maximum(y, 0)
         return y.astype(self.compute_dtype)
 
+    def _block_apply(self, block, x, stride):
+        """One bottleneck residual block (the remat unit under
+        ``remat='blocks'``). Enters in the saved-residual dtype,
+        computes in ``compute_dtype``. ``stride`` is static (closed
+        over, not traced)."""
+        cd = self.compute_dtype
+        x = x.astype(cd)
+        shortcut = x
+        y = _conv(x, block["conv1"]["kernel"], 1, cd)
+        y = self._bn_relu(y, block["conv1"])
+        y = _conv(y, block["conv2"]["kernel"], stride, cd)
+        y = self._bn_relu(y, block["conv2"])
+        y = _conv(y, block["conv3"]["kernel"], 1, cd)
+        y = self._bn_relu(y, block["conv3"], relu=False)
+        if "proj" in block:
+            shortcut = _conv(shortcut, block["proj"]["kernel"],
+                             stride, cd)
+            shortcut = self._bn_relu(shortcut, block["proj"],
+                                     relu=False)
+        elif stride != 1:
+            shortcut = lax.reduce_window(
+                shortcut, -jnp.inf, lax.max,
+                (1, 1, 1, 1), (1, stride, stride, 1), "SAME")
+        return jnp.maximum(y.astype(jnp.float32)
+                           + shortcut.astype(jnp.float32), 0).astype(cd)
+
+    def _stage_apply(self, stage, x, si):
+        for bi, block in enumerate(stage):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            x = self._block_apply(block, x, stride)
+        return x
+
     def apply(self, params, x):
+        from tpu_ddp.memory import cast_saved, effective_remat, wrap_stage
         cd = self.compute_dtype
         stem_stride = 1 if self.small_inputs else 2
         x = _conv(x, params["stem"]["kernel"], stem_stride, cd)
@@ -120,27 +166,23 @@ class ResNetModel:
         if not self.small_inputs:
             x = lax.reduce_window(
                 x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
-        for si, stage in enumerate(params["stages"]):
-            for bi, block in enumerate(stage):
-                stride = 2 if (si > 0 and bi == 0) else 1
-                shortcut = x
-                y = _conv(x, block["conv1"]["kernel"], 1, cd)
-                y = self._bn_relu(y, block["conv1"])
-                y = _conv(y, block["conv2"]["kernel"], stride, cd)
-                y = self._bn_relu(y, block["conv2"])
-                y = _conv(y, block["conv3"]["kernel"], 1, cd)
-                y = self._bn_relu(y, block["conv3"], relu=False)
-                if "proj" in block:
-                    shortcut = _conv(shortcut, block["proj"]["kernel"],
-                                     stride, cd)
-                    shortcut = self._bn_relu(shortcut, block["proj"],
-                                             relu=False)
-                elif stride != 1:
-                    shortcut = lax.reduce_window(
-                        shortcut, -jnp.inf, lax.max,
-                        (1, 1, 1, 1), (1, stride, stride, 1), "SAME")
-                x = jnp.maximum(y.astype(jnp.float32)
-                                + shortcut.astype(jnp.float32), 0).astype(cd)
+        remat = effective_remat(self.remat, "conv")
+        if remat in ("conv_stages", "dots"):
+            for si, stage in enumerate(params["stages"]):
+                fn = wrap_stage(
+                    functools.partial(self._stage_apply, si=si), remat)
+                x = fn(stage, cast_saved(x, self.act_dtype, cd))
+        else:
+            for si, stage in enumerate(params["stages"]):
+                for bi, block in enumerate(stage):
+                    stride = 2 if (si > 0 and bi == 0) else 1
+                    x = cast_saved(x, self.act_dtype, cd)
+                    if remat == "none":
+                        x = self._block_apply(block, x, stride)
+                    else:
+                        fn = wrap_stage(functools.partial(
+                            self._block_apply, stride=stride), remat)
+                        x = fn(block, x)
         x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         logits = jnp.dot(x.astype(cd), params["head"]["kernel"].astype(cd))
         logits = logits.astype(jnp.float32) \

@@ -108,9 +108,17 @@ class TransformerLM:
     # the all-to-all-gathered sequence). The ring path (sp>1, "ring")
     # has its own blockwise online softmax and ignores this flag.
     use_flash: bool = False
-    # Rematerialize each block in the backward pass (jax.checkpoint):
-    # trades ~num_layers x activation memory for one extra forward —
-    # the standard long-context memory lever on HBM-bound chips.
+    # Memory policy (tpu_ddp/memory/policy.py): "blocks" remats each
+    # transformer block in the backward pass — trades ~num_layers x
+    # activation memory for one extra forward, the standard
+    # long-context memory lever on HBM-bound chips; "dots" saves the
+    # matmul outputs and recomputes LN/softmax/GELU ("conv_stages"
+    # degrades to "blocks" here — no conv stages). act_dtype is the
+    # saved dtype of the inter-block residual stream.
+    remat: str = "none"
+    act_dtype: str = "compute"
+    # DEPRECATED alias for remat="blocks" (the pre-policy field); kept
+    # functional for back-compat, ignored when ``remat`` is set.
     remat_blocks: bool = False
     # Dropout on the embedding and each block's two residual branches.
     # Active only when the caller passes an ``rng`` to apply/trunk (the
@@ -131,7 +139,18 @@ class TransformerLM:
     def is_gqa(self) -> bool:
         return self.kv_heads != self.num_heads
 
+    @property
+    def remat_policy(self) -> str:
+        """Effective remat mode, honoring the deprecated
+        ``remat_blocks`` alias (``remat`` wins when set)."""
+        if self.remat != "none":
+            return self.remat
+        return "blocks" if self.remat_blocks else "none"
+
     def __post_init__(self):
+        from tpu_ddp.memory import validate_act_dtype, validate_remat
+        validate_remat(self.remat)
+        validate_act_dtype(self.act_dtype)
         if not 0.0 <= self.dropout_rate < 1.0:
             raise ValueError(f"dropout_rate must be in [0, 1), got "
                              f"{self.dropout_rate}")
@@ -336,12 +355,17 @@ class TransformerLM:
         if rng is not None:
             x = self._dropout(x, jax.random.fold_in(rng, self.num_layers))
         aux = jnp.float32(0.0)
-        blk_fn = self.block_apply_aux
-        if self.remat_blocks:
-            blk_fn = jax.checkpoint(blk_fn)
+        from tpu_ddp.memory import cast_saved, effective_remat, wrap_stage
+        remat = effective_remat(self.remat_policy, "attn")
+        if remat == "none" and self.act_dtype == "compute":
+            blk_fn = self.block_apply_aux
+        else:
+            # _block_entry re-enters compute_dtype, so the boundary
+            # cast below only changes what autodiff SAVES.
+            blk_fn = wrap_stage(self._block_entry, remat)
         for i, blk in enumerate(params["blocks"]):
             r = jax.random.fold_in(rng, i) if rng is not None else None
-            x, a = blk_fn(blk, x, pos, r)
+            x, a = blk_fn(blk, cast_saved(x, self.act_dtype, cd), pos, r)
             aux = aux + a
         x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
         return x, aux / max(self.num_layers, 1)
@@ -387,6 +411,14 @@ class TransformerLM:
             kvp = kvp.astype(cd).reshape(b, lc, 2, kv_loc, hd)
             k, v = kvp[:, :, 0], kvp[:, :, 1]
         return rope(q, pos), rope(k, pos), v
+
+    def _block_entry(self, blk, x, pos, rng=None):
+        """:meth:`block_apply_aux` with the residual stream re-entering
+        ``compute_dtype`` — the checkpoint-region entry point under a
+        memory policy (the saved boundary input is in ``act_dtype``,
+        the block arithmetic is not)."""
+        return self.block_apply_aux(blk, x.astype(self.compute_dtype),
+                                    pos, rng)
 
     def block_apply_aux(self, blk, x, pos, rng=None):
         cd = self.compute_dtype
@@ -506,7 +538,7 @@ def make_transformer(name: str = "TransformerLM-small",
         # exactly; fits a 16 GB v5e with f32 AdamW states + remat.
         "TransformerLM-large": dict(num_layers=12, num_heads=16,
                                     d_model=2048, d_ff=8192,
-                                    vocab_size=32000, remat_blocks=True),
+                                    vocab_size=32000, remat="blocks"),
         "TransformerLM-moe-tiny": dict(num_layers=2, num_heads=4,
                                        d_model=128, d_ff=256,
                                        vocab_size=1024, moe_experts=4),

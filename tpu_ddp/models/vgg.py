@@ -25,6 +25,7 @@ to ``num_classes``.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any
 
@@ -99,6 +100,18 @@ class VGGModel:
     # Use the fused Pallas BatchNorm+ReLU kernel (tpu_ddp/ops/pallas/
     # bn_relu.py) instead of the XLA-fused jnp pair below.
     use_pallas_bn: bool = False
+    # Memory policy (tpu_ddp/memory/policy.py): "blocks" remats each
+    # conv->BN->ReLU unit, "conv_stages" each between-pool group
+    # ("dots" has nothing to save inside a conv stage, so it compiles
+    # to the conv_stages program); act_dtype is the saved dtype of the
+    # between-stage activations.
+    remat: str = "none"
+    act_dtype: str = "compute"
+
+    def __post_init__(self):
+        from tpu_ddp.memory import validate_act_dtype, validate_remat
+        validate_remat(self.remat)
+        validate_act_dtype(self.act_dtype)
 
     # ---- parameters ----------------------------------------------------
 
@@ -139,42 +152,85 @@ class VGGModel:
 
     # ---- forward -------------------------------------------------------
 
+    @property
+    def _stage_plan(self) -> tuple:
+        """Conv-stage grouping of ``cfg``: ``((n_convs, pool_after),
+        ...)`` — one entry per between-pool group (the remat unit under
+        ``remat='conv_stages'``)."""
+        plan = []
+        n = 0
+        for width in self.cfg:
+            if width == "M":
+                plan.append((n, True))
+                n = 0
+            else:
+                n += 1
+        if n:
+            plan.append((n, False))
+        return tuple(plan)
+
+    def _conv_unit(self, p, x):
+        """One conv->bias->BN->ReLU entry (the remat unit under
+        ``remat='blocks'``). Enters in the saved-residual dtype,
+        computes in ``compute_dtype``."""
+        cd = self.compute_dtype
+        x = x.astype(cd)
+        # bf16 in / bf16 out: XLA:TPU still accumulates the MXU matmul
+        # in f32 internally; BN below recomputes stats in f32.
+        y = lax.conv_general_dilated(
+            x, p["kernel"].astype(cd),
+            window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = y.astype(jnp.float32) + p["bias"].astype(jnp.float32)
+        if self.use_pallas_bn:
+            from tpu_ddp.ops.pallas import batch_norm_relu
+            return batch_norm_relu(
+                y, p["bn_scale"].astype(jnp.float32),
+                p["bn_bias"].astype(jnp.float32), BN_EPS).astype(cd)
+        y = batch_norm(y, p["bn_scale"].astype(jnp.float32),
+                       p["bn_bias"].astype(jnp.float32))
+        return jnp.maximum(y, 0).astype(cd)
+
+    def _stage_apply(self, stage_params, x, pool):
+        for p in stage_params:
+            x = self._conv_unit(p, x)
+        return max_pool_2x2(x) if pool else x
+
     def apply(self, params, x):
         """Forward pass: NHWC image batch -> logits (float32).
 
         Mirrors reference part1/model.py:41-45: features -> flatten -> fc.
         Convs and the head matmul run in ``compute_dtype`` with float32
-        accumulation so the MXU sees bf16 operands.
+        accumulation so the MXU sees bf16 operands. Under a remat policy
+        each unit/stage is a ``jax.checkpoint`` region with its input
+        saved in the ``act_dtype`` boundary dtype (tpu_ddp/memory/).
         """
+        from tpu_ddp.memory import cast_saved, effective_remat, wrap_stage
         cd = self.compute_dtype
         x = x.astype(cd)
-        conv_i = 0
-        for width in self.cfg:
-            if width == "M":
-                x = max_pool_2x2(x)
-                continue
-            p = params["features"][conv_i]
-            conv_i += 1
-            # bf16 in / bf16 out: XLA:TPU still accumulates the MXU matmul
-            # in f32 internally; BN below recomputes stats in f32.
-            y = lax.conv_general_dilated(
-                x, p["kernel"].astype(cd),
-                window_strides=(1, 1), padding="SAME",
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            )
-            y = y.astype(jnp.float32) + p["bias"].astype(jnp.float32)
-            if self.use_pallas_bn:
-                from tpu_ddp.ops.pallas import batch_norm_relu
-                x = batch_norm_relu(
-                    y, p["bn_scale"].astype(jnp.float32),
-                    p["bn_bias"].astype(jnp.float32), BN_EPS).astype(cd)
-            else:
-                y = batch_norm(y, p["bn_scale"].astype(jnp.float32),
-                               p["bn_bias"].astype(jnp.float32))
-                x = jnp.maximum(y, 0).astype(cd)
+        remat = effective_remat(self.remat, "conv")
+        feats = params["features"]
+        if remat in ("conv_stages", "dots"):
+            i = 0
+            for n, pool in self._stage_plan:
+                fn = wrap_stage(
+                    functools.partial(self._stage_apply, pool=pool), remat)
+                x = fn(feats[i:i + n], cast_saved(x, self.act_dtype, cd))
+                i += n
+        else:
+            unit = (self._conv_unit if remat == "none"
+                    else wrap_stage(self._conv_unit, remat))
+            conv_i = 0
+            for width in self.cfg:
+                if width == "M":
+                    x = max_pool_2x2(x)
+                    continue
+                x = unit(feats[conv_i], cast_saved(x, self.act_dtype, cd))
+                conv_i += 1
         # After 5 pools a 32x32 input is 1x1x512 -> flatten to 512
         # (reference part1/model.py:42-44).
-        x = x.reshape(x.shape[0], -1)
+        x = x.astype(cd).reshape(x.shape[0], -1)
         logits = jnp.dot(x, params["head"]["kernel"].astype(cd))
         logits = logits.astype(jnp.float32) \
             + params["head"]["bias"].astype(jnp.float32)

@@ -49,10 +49,28 @@ class ViTModel:
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     use_flash: bool = False
-    # Rematerialize each block in the backward pass (jax.checkpoint).
+    # Memory policy (tpu_ddp/memory/policy.py): "blocks" remats each
+    # transformer block, "dots" saves matmul outputs only
+    # ("conv_stages" degrades to "blocks" — no conv stages); act_dtype
+    # is the saved dtype of the inter-block residual stream.
+    remat: str = "none"
+    act_dtype: str = "compute"
+    # DEPRECATED alias for remat="blocks" (the pre-policy field); kept
+    # functional for back-compat, ignored when ``remat`` is set.
     remat_blocks: bool = False
 
+    @property
+    def remat_policy(self) -> str:
+        """Effective remat mode, honoring the deprecated
+        ``remat_blocks`` alias (``remat`` wins when set)."""
+        if self.remat != "none":
+            return self.remat
+        return "blocks" if self.remat_blocks else "none"
+
     def __post_init__(self):
+        from tpu_ddp.memory import validate_act_dtype, validate_remat
+        validate_remat(self.remat)
+        validate_act_dtype(self.act_dtype)
         if self.image_size % self.patch_size:
             raise ValueError(
                 f"image_size={self.image_size} not divisible by "
@@ -124,6 +142,12 @@ class ViTModel:
         x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, gh, gw, p, p, C)
         return x.reshape(b, g * g, p * p * self.in_channels)
 
+    def _block_entry(self, blk, x):
+        """:meth:`_block` with the residual stream re-entering
+        ``compute_dtype`` — the checkpoint-region entry point under a
+        memory policy."""
+        return self._block(blk, x.astype(self.compute_dtype))
+
     def _block(self, blk, x):
         cd = self.compute_dtype
         b, n = x.shape[0], x.shape[1]
@@ -158,11 +182,16 @@ class ViTModel:
                       preferred_element_type=jnp.float32)
         tok = (tok + params["patch"]["bias"]).astype(cd)
         tok = tok + params["pos"].astype(cd)
-        blk_fn = self._block
-        if self.remat_blocks:
-            blk_fn = jax.checkpoint(blk_fn)
+        from tpu_ddp.memory import cast_saved, effective_remat, wrap_stage
+        remat = effective_remat(self.remat_policy, "attn")
+        if remat == "none" and self.act_dtype == "compute":
+            blk_fn = self._block
+        else:
+            # _block_entry re-enters compute_dtype, so the boundary
+            # cast below only changes what autodiff SAVES.
+            blk_fn = wrap_stage(self._block_entry, remat)
         for blk in params["blocks"]:
-            tok = blk_fn(blk, tok)
+            tok = blk_fn(blk, cast_saved(tok, self.act_dtype, cd))
         tok = layer_norm(tok, params["ln_f"]["scale"],
                          params["ln_f"]["bias"])
         pooled = jnp.mean(tok.astype(jnp.float32), axis=1)  # GAP

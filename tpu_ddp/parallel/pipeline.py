@@ -107,8 +107,9 @@ def _make_run_stage(model, blocks, pos, rng, pp_axis: str):
     GLOBAL layer index) — global = stage * layers_per_stage + local — so
     every microbatch sees exactly the dense model's per-layer key
     sequence regardless of how layers shard over stages (tested:
-    pp=1 == pp=2 gradients with dropout on). With ``remat_blocks`` each
-    layer recomputes in the backward pass — essential under GPipe, whose
+    pp=1 == pp=2 gradients with dropout on). Under the model's remat
+    policy (``remat``/deprecated ``remat_blocks``, tpu_ddp/memory/)
+    each layer recomputes in the backward pass — essential under GPipe, whose
     T = M + pp - 1 ticks would otherwise stash every tick's activations.
     """
     layers_per_stage = jax.tree.leaves(blocks)[0].shape[0]
@@ -123,10 +124,12 @@ def _make_run_stage(model, blocks, pos, rng, pp_axis: str):
                                        stage_base + local_i)
             h, _ = model.block_apply_aux(layer, h, pos, r)
             return h, None
-        if model.remat_blocks:
+        from tpu_ddp.memory import effective_remat, wrap_stage
+        remat = effective_remat(model.remat_policy, "attn")
+        if remat != "none":
             # prevent_cse=False: scan's loop structure already prevents
             # the problematic CSE, so keep XLA free to fuse.
-            body = jax.checkpoint(body, prevent_cse=False)
+            body = wrap_stage(body, remat, prevent_cse=False)
         h, _ = lax.scan(body, x, (blocks, jnp.arange(layers_per_stage)))
         return h
 

@@ -148,6 +148,18 @@ class Trainer:
             from tpu_ddp import tune
             self.config = tune.resolve(self.config, strategy=strategy,
                                        mesh=mesh, model_built=True)
+        # Memory policy (tpu_ddp/memory/): imprint the config's remat /
+        # act_dtype onto the model. Models carry the policy as STATIC
+        # dataclass fields and apply it inside their own ``apply``, so
+        # every jit surface below — plain jit, shard_map, the K-step
+        # scan, FSDP, the comp_state carry — traces the policied
+        # program with no per-surface wiring. Runs AFTER the autotune
+        # resolve so tuned remat values reach the model.
+        from tpu_ddp.memory import apply_policy
+        self.model = apply_policy(
+            self.model,
+            remat=getattr(self.config, "remat", "none"),
+            act_dtype=getattr(self.config, "act_dtype", "compute"))
         # Global-norm gradient clipping (round-3 verdict item 6):
         # torch.nn.utils.clip_grad_norm_ semantics. Applied to the
         # SYNCED gradients, so every rung clips by the same global norm:

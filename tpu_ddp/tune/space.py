@@ -21,7 +21,13 @@ on a cell whose measurement would be a lie:
   position on every process — DESIGN.md §13 guard (e));
 - ``steps_per_dispatch > 1`` falls back to the per-step path under
   in-loop cadences or ``device_prefetch > 0`` (engine.py), so those
-  cells duplicate their per-step twins.
+  cells duplicate their per-step twins;
+- ``remat`` cells that the memory policy resolves to another cell's
+  program are skipped as duplicates: ``conv_stages`` on a transformer
+  family degrades to ``blocks``, ``dots`` on a conv family compiles to
+  the ``conv_stages`` program (no dot_general inside conv stages), and
+  ``act_dtype`` equal to the compute dtype is a no-op cast
+  (tpu_ddp/memory/policy.py).
 
 ``semantic=True`` marks knobs whose value changes the training
 computation itself (dtype, batch size), not just its schedule; the
@@ -88,6 +94,20 @@ KNOBS: tuple[Knob, ...] = (
          values=(False, True),
          doc="fused Pallas BatchNorm+ReLU kernel (TPU only; model-"
              "level — must be applied before get_model)"),
+    Knob("remat", "remat", "TPU_DDP_REMAT",
+         values=("none", "blocks", "conv_stages", "dots"), flag="--remat",
+         doc="activation rematerialization policy (tpu_ddp/memory/): "
+             "recompute stages in the backward pass instead of saving "
+             "activations — bytes-for-FLOPs on the HBM wall "
+             "(EXPERIMENTS.md §14); numerics-preserving (same ops "
+             "re-executed), so searchable by default"),
+    Knob("act_dtype", "act_dtype", "TPU_DDP_ACT_DTYPE",
+         values=("compute", "bf16", "f32"), flag="--act-dtype",
+         semantic=True,
+         doc="saved-residual dtype at stage boundaries "
+             "(tpu_ddp/memory/); boundaries round-trip through this "
+             "dtype, so it changes numerics — searched only with "
+             "TPU_DDP_TUNE_SEMANTIC=1"),
     Knob("compute_dtype", "compute_dtype", "TPU_DDP_COMPUTE_DTYPE",
          values=("bfloat16", "float32"), semantic=True,
          doc="matmul/conv dtype; changes the training numerics, so "
@@ -130,6 +150,9 @@ class Workload:
     processes: int = 1             # jax.process_count()
     strategy: str = "none"         # canonical sync rung
     collective_cadence: bool = False  # in-loop ckpt/replica cadence
+    # Model family ("conv" | "attn" | "" unknown): the remat policy's
+    # degrade/duplicate rules are family-shaped (tpu_ddp/memory/).
+    model_family: str = ""
 
 
 def workload_for(cfg, strategy: str = "none", mesh=None) -> Workload:
@@ -145,6 +168,8 @@ def workload_for(cfg, strategy: str = "none", mesh=None) -> Workload:
             dp = int(mesh.shape.get("dp", 1))
         except Exception:  # noqa: BLE001 — a mesh without named axes
             dp = 1
+    from tpu_ddp.memory import family_for_model
+
     return Workload(
         platform=jax.devices()[0].platform,
         dp=dp,
@@ -152,6 +177,7 @@ def workload_for(cfg, strategy: str = "none", mesh=None) -> Workload:
         strategy=canonical_strategy(strategy),
         collective_cadence=bool(cfg.ckpt_every_iters
                                 or cfg.check_replicas_every),
+        model_family=family_for_model(cfg.model),
     )
 
 
@@ -180,6 +206,23 @@ def violations(assignment: Mapping, ctx: Workload) -> list[str]:
             "dispatch_depth>0 with a multi-process collective-bearing "
             "cadence — the streaming loop forces depth 0 "
             "(DESIGN.md §13 guard (e))")
+    remat = get("remat", "none")
+    if remat == "conv_stages" and ctx.model_family == "attn":
+        bad.append(
+            "remat='conv_stages' on a transformer family — the model "
+            "degrades it to 'blocks' with a warning (tpu_ddp/memory/), "
+            "so this cell duplicates the 'blocks' cell")
+    if remat == "dots" and ctx.model_family == "conv":
+        bad.append(
+            "remat='dots' on a conv family — conv stages contain no "
+            "dot_general (convs are conv_general_dilated), so the "
+            "program is identical to 'conv_stages' (duplicate cell)")
+    act = get("act_dtype", "compute")
+    cdty = str(get("compute_dtype", "bfloat16"))
+    if (act, cdty) in (("bf16", "bfloat16"), ("f32", "float32")):
+        bad.append(
+            f"act_dtype={act!r} with compute_dtype={cdty!r} — the "
+            "boundary cast is a no-op, duplicate of 'compute'")
     if get("steps_per_dispatch", 1) > 1:
         if get("device_prefetch", 0):
             bad.append("steps_per_dispatch>1 with device_prefetch>0 — "

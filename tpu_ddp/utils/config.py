@@ -93,6 +93,23 @@ class TrainConfig:
     # strategy; degrades to "none" with a warning otherwise.
     grad_compress: str = "none"
 
+    # Memory policy (tpu_ddp/memory/): activation rematerialization.
+    # Which model stages recompute in the backward pass instead of
+    # saving their interior activations to HBM — "none" (save
+    # everything), "blocks" (per residual/transformer block),
+    # "conv_stages" (coarser: per resolution stage; conv families
+    # only, transformers degrade to "blocks" with a warning) or "dots"
+    # (jax.checkpoint_policies.dots_saveable: matmul outputs saved,
+    # elementwise recomputed). Env: TPU_DDP_REMAT; launch flag --remat.
+    remat: str = "none"
+    # Saved-residual dtype at stage boundaries: "compute" (no cast),
+    # "bf16" or "f32". Changes what autodiff SAVES, not the arithmetic
+    # inside stages (regions cast back to compute_dtype on entry) —
+    # semantic when it differs from compute_dtype, so the autotuner
+    # treats it like compute_dtype (TPU_DDP_TUNE_SEMANTIC gate).
+    # Env: TPU_DDP_ACT_DTYPE; launch flag --act-dtype.
+    act_dtype: str = "compute"
+
     # Autotuning (tpu_ddp/tune/): "off" (default), "cached" (apply a
     # previously searched tuning for this workload fingerprint when the
     # cache has one; defaults-with-warning otherwise — safe to leave on
@@ -189,6 +206,23 @@ class TrainConfig:
         env_gb = os.environ.get("TPU_DDP_GUARD_MAX_BAD")
         if env_gb:
             self.guard_max_bad_steps = int(env_gb)
+        env_rm = os.environ.get("TPU_DDP_REMAT")
+        if env_rm:
+            self.remat = env_rm
+        env_ad = os.environ.get("TPU_DDP_ACT_DTYPE")
+        if env_ad:
+            self.act_dtype = env_ad
+        # Mirrors tpu_ddp/memory/policy.py (the source of truth, which
+        # re-validates at model construction); duplicated so a bad
+        # env/config fails HERE with the env-var name.
+        if self.remat not in ("none", "blocks", "conv_stages", "dots"):
+            raise ValueError(
+                f"remat={self.remat!r}: expected "
+                "none|blocks|conv_stages|dots (TPU_DDP_REMAT)")
+        if self.act_dtype not in ("compute", "bf16", "f32"):
+            raise ValueError(
+                f"act_dtype={self.act_dtype!r}: expected "
+                "compute|bf16|f32 (TPU_DDP_ACT_DTYPE)")
         env_at = os.environ.get("TPU_DDP_AUTOTUNE")
         if env_at:
             self.autotune = env_at
