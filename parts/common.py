@@ -112,13 +112,49 @@ def run_part(part: str, argv=None):
     else:
         rank = get_rank_from_hostname()
 
-    ctx = init_distributed_setup(args.master_ip, args.master_port, rank,
-                                 world_size)
-    if distributed:
-        test_distributed_setup(ctx)
+    # Elastic membership (resilience/elastic.py). A JOINING process
+    # rendezvouses via the launcher's membership record — the original
+    # coordinator world no longer exists — and restores its state from
+    # the beacon the surviving rank 0 wrote.
+    from tpu_ddp.resilience import elastic as _elastic
+    join_epoch = _elastic.join_epoch_from_env()
+    elastic_ctl = _elastic.ElasticController.from_env()
+    beacon = None
+    base_world = world_size
+    if join_epoch is not None:
+        if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower().split(","):
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except (AttributeError, ValueError):
+                pass
+        membership = _elastic.join_world(elastic_ctl, join_epoch)
+        rank = int(membership["assignments"][str(elastic_ctl.worker_id)])
+        world_size = int(membership["world"])
+        base_world = int(membership.get("base_world", world_size))
+        beacon = _elastic.beacon_dir(elastic_ctl.directory,
+                                     int(membership["epoch"]))
+        from tpu_ddp.parallel.bootstrap import DistributedContext
+        ctx = DistributedContext(
+            rank=rank, world_size=world_size,
+            num_devices=len(jax.devices()),
+            local_devices=tuple(jax.local_devices()),
+            coordinator=membership["coordinator"],
+            backend=jax.devices()[0].platform)
+        print(f"[{part}] joined elastic epoch {membership['epoch']} as "
+              f"rank {rank}/{world_size}")
+    else:
+        ctx = init_distributed_setup(args.master_ip, args.master_port,
+                                     rank, world_size)
+        if distributed:
+            test_distributed_setup(ctx)
 
     cfg = TrainConfig.preset(args.config, epochs=args.epochs)
-    batch_size = cfg.per_node_batch_size(world_size)
+    # Per-node batch follows the LAUNCH world (base_world): elastic
+    # membership changes keep each survivor's per-node batch fixed, so
+    # the global batch scales with the live world — the standard
+    # elastic-DDP contract (a joiner computes from base_world too).
+    batch_size = cfg.per_node_batch_size(base_world)
 
     # Replicas on the mesh = data-parallel slots. One process with D local
     # devices contributes D slots; N single-device processes contribute N.
@@ -165,7 +201,19 @@ def run_part(part: str, argv=None):
                       metrics=metrics_from_env(rank=rank))
     start_epoch = 0
     start_iter = 0
-    if args.resume:
+    if beacon is not None:
+        # The joiner's initial state is the canonical host tree the
+        # surviving rank 0 beaconed at the membership epoch — a live
+        # handoff, not a checkpoint-interval-old restore.
+        import json as _json
+        state = trainer.restore_checkpoint(beacon)
+        with open(os.path.join(beacon, "beacon_meta.json")) as f:
+            meta = _json.load(f)
+        start_epoch = int(meta["epoch"])
+        start_iter = int(meta["next_iter"])
+        print(f"[{part}] joined with beaconed state at step {state.step} "
+              f"(epoch {start_epoch}, iter {start_iter})")
+    elif args.resume:
         state = trainer.restore_checkpoint(args.ckpt_dir)
         # Derive where to pick up from the restored step: completed
         # epochs = step // iters-per-epoch, and a MID-epoch checkpoint
@@ -187,14 +235,42 @@ def run_part(part: str, argv=None):
           f"rank={rank} dp_slots={dp_size} per-node batch={batch_size} "
           f"platform={jax.devices()[0].platform}")
 
-    for epoch in range(start_epoch, cfg.epochs):
+    epoch = start_epoch
+    pending_iter = start_iter
+    while epoch < cfg.epochs:
         # Per-epoch reshuffle hook (reference part2/part2b/main.py:189).
         train_loader.set_epoch(epoch)
-        # Deep profiling (TPU_DDP_PROFILE_DIR): trace the first epoch.
-        with profile_trace(profile_dir_from_env() if epoch == 0 else None):
-            state, stats = trainer.train_epoch(
-                state, train_loader, epoch=epoch, ckpt_dir=args.ckpt_dir,
-                start_iter=start_iter if epoch == start_epoch else 0)
+        try:
+            # Deep profiling (TPU_DDP_PROFILE_DIR): trace the first epoch.
+            with profile_trace(
+                    profile_dir_from_env() if epoch == 0 else None):
+                state, stats = trainer.train_epoch(
+                    state, train_loader, epoch=epoch,
+                    ckpt_dir=args.ckpt_dir, start_iter=pending_iter)
+        except _elastic.MembershipChange as chg:
+            # A peer left (or is rejoining): reshard the LIVE state
+            # onto the new world and resume this epoch where it
+            # stopped — no checkpoint restore, no restart.
+            res = _elastic.apply_membership(trainer, chg, elastic_ctl)
+            if res is None:
+                return 0  # this worker is not in the new world
+            state = res.state
+            rank, world_size = res.rank, res.world
+            # Data shards follow the new world; per-node batch stays.
+            if cfg.dataset == "imagenet":
+                train_loader, test_loader = create_imagenet_loaders(
+                    rank=rank, world_size=world_size,
+                    batch_size=batch_size, root=args.data_root,
+                    seed=cfg.seed, image_size=cfg.image_size,
+                    num_classes=cfg.num_classes)
+            else:
+                train_loader, test_loader = create_data_loaders(
+                    rank=rank, world_size=world_size,
+                    batch_size=batch_size, root=args.data_root,
+                    seed=cfg.seed, shard_eval=shard_eval)
+            pending_iter = res.next_iter
+            continue  # same epoch, from the first untrained batch
+        pending_iter = 0
         # Epoch-end checkpoint — unless the in-loop cadence just wrote
         # this exact step (avoids a duplicate write and, under ZeRO, a
         # duplicate optimizer-state gather collective).
@@ -207,6 +283,7 @@ def run_part(part: str, argv=None):
         print(f"[{part}] epoch {epoch}: avg iter "
               f"{stats['avg_iter_s']:.4f}s over {stats['timed_iters']} timed "
               f"iters; {stats['iters']} iters total")
+        epoch += 1
 
     shutdown(ctx)
     return 0

@@ -21,6 +21,14 @@ corrupt-ckpt   (+ hard-exit) the restarted run quarantines the
                truncated newest checkpoint to ``*.corrupt`` and
                resumes from the previous verified step
 slow-rank      the run completes despite a persistent straggler rank
+host-loss      under ``--elastic-reshard`` the survivor absorbs the
+               departed rank into a shrunken membership epoch and
+               carries its LIVE TrainState across — no restart, no
+               checkpoint restore
+host-join      shrink epoch as above, then the departed worker
+               rejoins a regrown epoch and restores from the
+               survivors' state beacon (two reshard epochs, zero
+               restarts)
 =============  ======================================================
 
 Writes ``experiments/chaos_sweep.json`` — one cell per drill with
@@ -173,12 +181,72 @@ def drill_slow_rank(work: Path, cell: dict) -> bool:
     return ok
 
 
+def drill_host_loss(work: Path, cell: dict) -> bool:
+    """Gracefully preempt rank 1 after step 2 under elastic_reshard;
+    recovery = LIVE reshard. The survivor must republish as a shrunken
+    epoch and keep training on its in-memory TrainState — the pass
+    criteria explicitly require NO checkpoint restore."""
+    from tpu_ddp.resilience.elastic import HOST_LOSS_EXIT
+    env = dict(SMOKE_ENV,
+               TPU_DDP_CHAOS_FAULTS="host-loss@2:rank=1",
+               TPU_DDP_CHAOS_SENTINEL=str(work / "sentinels"),
+               TPU_DDP_ELASTIC_RESHARD="1")
+    res = launch(PART, nproc=2, env=env, echo=False, timeout=TIMEOUT,
+                 elastic_reshard=True)
+    out0 = res.output_of(0)
+    ok = _check(cell, "run_ok", res.ok, res.returncode)
+    ok &= _check(cell, "reshard_epoch_published", res.reshards == 1,
+                 res.reshards)
+    ok &= _check(cell, "departure_absorbed_not_failed",
+                 any(w.rank == 1 and w.absorbed
+                     and w.returncode == HOST_LOSS_EXIT
+                     for w in res.workers),
+                 [(w.rank, w.returncode, w.absorbed)
+                  for w in res.workers])
+    ok &= _check(cell, "survivor_resharded_live",
+                 "resharded in" in out0)
+    ok &= _check(cell, "no_checkpoint_restore",
+                 "resumed from" not in out0)
+    return ok
+
+
+def drill_host_join(work: Path, cell: dict) -> bool:
+    """Rank 1 leaves gracefully at step 2 and rejoins: two membership
+    epochs (shrink, regrow) with the joiner restoring from the
+    survivors' state beacon — zero cluster restarts. Needs more steps
+    than the other drills so the survivor is still training when the
+    regrown epoch lands."""
+    env = dict(SMOKE_ENV,
+               TPU_DDP_MAX_ITERS="8",
+               TPU_DDP_CHAOS_FAULTS="host-join@2:rank=1",
+               TPU_DDP_CHAOS_SENTINEL=str(work / "sentinels"),
+               TPU_DDP_ELASTIC_RESHARD="1")
+    res = launch(PART, nproc=2, env=env, echo=False, timeout=TIMEOUT,
+                 elastic_reshard=True)
+    out0 = res.output_of(0)
+    ok = _check(cell, "run_ok", res.ok, res.returncode)
+    ok &= _check(cell, "shrank_then_regrew", res.reshards == 2,
+                 res.reshards)
+    ok &= _check(cell, "survivor_resharded_twice",
+                 out0.count("resharded in") >= 2,
+                 out0.count("resharded in"))
+    ok &= _check(cell, "joiner_restored_from_beacon",
+                 any("joined with beaconed state" in w.output
+                     for w in res.workers))
+    ok &= _check(cell, "no_checkpoint_restore",
+                 all("resumed from" not in w.output
+                     for w in res.workers))
+    return ok
+
+
 DRILLS = {
     "hard-exit": drill_hard_exit,
     "nan-grad": drill_nan_grad,
     "stalled-step": drill_stalled_step,
     "corrupt-ckpt": drill_corrupt_ckpt,
     "slow-rank": drill_slow_rank,
+    "host-loss": drill_host_loss,
+    "host-join": drill_host_join,
 }
 assert set(DRILLS) == set(FAULT_KINDS), \
     "a fault kind exists without a sweep drill"

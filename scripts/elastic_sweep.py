@@ -1,0 +1,143 @@
+"""Elastic sweep — reshard-vs-restart recovery latency, measured.
+
+The elastic tentpole's whole claim is a NUMBER: surviving a membership
+change by resharding the live TrainState must be faster than the old
+recovery path (kill everyone, restart from the newest checkpoint). This
+sweep runs the same workload three ways on a real 2-process cluster and
+commits the comparison as an artifact:
+
+- ``clean``    — no fault; the baseline wall time of the run.
+- ``reshard``  — rank 1 departs at step 2 under ``--elastic-reshard``;
+                 the survivor reshards its LIVE state and finishes.
+                 Recovery latency is the survivor's own measurement
+                 (the ``resharded in X.XXs`` line covers state
+                 snapshot -> world rebuild -> re-placement) plus the
+                 run's wall-time overhead over clean.
+- ``restart``  — the same departure without elastic reshard:
+                 ``launch_elastic`` kills the cluster and restarts both
+                 ranks from the step-2 checkpoint (full process boot,
+                 JAX import, recompile, rendezvous).
+
+Pass criterion (enforced, exit 1): the reshard run's wall-clock
+overhead over clean is STRICTLY below the restart run's — otherwise the
+elastic machinery is costing more than the restart it replaces.
+
+Writes ``experiments/elastic_sweep.json``.
+
+Usage::
+
+    python scripts/elastic_sweep.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tpu_ddp.launch import launch, launch_elastic  # noqa: E402
+
+SMOKE_ENV = {
+    "TPU_DDP_SYNTH_SIZE": "64",
+    "TPU_DDP_MAX_ITERS": "3",
+    "TPU_DDP_GLOBAL_BATCH": "16",
+    "CIFAR10_DIR": "/nonexistent-so-synthetic",
+}
+PART = "part3"
+TIMEOUT = 600.0
+
+
+def _run_clean(work: Path) -> dict:
+    t0 = time.monotonic()
+    res = launch(PART, nproc=2, env=dict(SMOKE_ENV), echo=False,
+                 timeout=TIMEOUT)
+    return {"ok": res.ok, "wall_s": round(time.monotonic() - t0, 2)}
+
+
+def _run_reshard(work: Path) -> dict:
+    env = dict(SMOKE_ENV,
+               TPU_DDP_CHAOS_FAULTS="host-loss@2:rank=1",
+               TPU_DDP_CHAOS_SENTINEL=str(work / "sentinels"),
+               TPU_DDP_ELASTIC_RESHARD="1")
+    t0 = time.monotonic()
+    res = launch(PART, nproc=2, env=env, echo=False, timeout=TIMEOUT,
+                 elastic_reshard=True)
+    wall = round(time.monotonic() - t0, 2)
+    m = re.search(r"resharded in ([0-9.]+)s", res.output_of(0))
+    return {
+        "ok": res.ok and res.reshards == 1,
+        "wall_s": wall,
+        "reshards": res.reshards,
+        # The survivor's own clock over snapshot -> rebuild -> replace.
+        "reshard_latency_s": float(m.group(1)) if m else None,
+    }
+
+
+def _run_restart(work: Path) -> dict:
+    env = dict(SMOKE_ENV,
+               TPU_DDP_CHAOS_FAULTS="hard-exit@2:rank=1",
+               TPU_DDP_CHAOS_SENTINEL=str(work / "sentinels"),
+               TPU_DDP_CKPT_EVERY="1")
+    t0 = time.monotonic()
+    res = launch_elastic(PART, nproc=2, max_restarts=1,
+                         min_restart_interval=0.0, echo=False,
+                         timeout=TIMEOUT, env=env,
+                         extra_args=["--ckpt-dir", str(work / "ckpt")])
+    wall = round(time.monotonic() - t0, 2)
+    return {
+        "ok": res.ok and res.restarts == 1,
+        "wall_s": wall,
+        "restarts": res.restarts,
+        "resumed_from_checkpoint": "resumed from" in res.output_of(0),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default=str(REPO / "experiments"
+                                         / "elastic_sweep.json"))
+    args = ap.parse_args(argv)
+
+    results = {"part": PART, "nproc": 2, "env": SMOKE_ENV, "cells": {}}
+    for name, fn in (("clean", _run_clean), ("reshard", _run_reshard),
+                     ("restart", _run_restart)):
+        work = Path(tempfile.mkdtemp(prefix=f"elastic_{name}_"))
+        print(f"[elastic-sweep] {name}...", flush=True)
+        cell = fn(work)
+        results["cells"][name] = cell
+        print(f"[elastic-sweep] {name}: "
+              f"{'PASS' if cell['ok'] else 'FAIL'} ({cell['wall_s']}s)",
+              flush=True)
+
+    clean = results["cells"]["clean"]["wall_s"]
+    reshard = results["cells"]["reshard"]
+    restart = results["cells"]["restart"]
+    reshard["recovery_overhead_s"] = round(reshard["wall_s"] - clean, 2)
+    restart["recovery_overhead_s"] = round(restart["wall_s"] - clean, 2)
+    results["reshard_beats_restart"] = (
+        reshard["recovery_overhead_s"] < restart["recovery_overhead_s"])
+    results["all_passed"] = (
+        all(c["ok"] for c in results["cells"].values())
+        and results["reshard_beats_restart"])
+
+    out = Path(args.out)
+    out.parent.mkdir(exist_ok=True)
+    out.write_text(json.dumps(results, indent=1))
+    print(f"[elastic-sweep] reshard overhead "
+          f"{reshard['recovery_overhead_s']}s vs restart "
+          f"{restart['recovery_overhead_s']}s -> "
+          f"{'reshard wins' if results['reshard_beats_restart'] else 'RESTART WINS (FAIL)'}")
+    print(f"[elastic-sweep] wrote {out} "
+          f"(all_passed={results['all_passed']})")
+    return 0 if results["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,11 @@ NONPERF_ENV = {
     "TPU_DDP_MAX_ITERS", "TPU_DDP_LR", "TPU_DDP_CKPT_EVERY",
     "TPU_DDP_CHECK_REPLICAS_EVERY", "TPU_DDP_GUARD",
     "TPU_DDP_GUARD_MAX_BAD", "TPU_DDP_AUTOTUNE",
+    # Elastic-membership infrastructure (resilience/elastic.py): the
+    # launcher<->worker protocol plumbing, not knobs — only the mode
+    # switch TPU_DDP_ELASTIC_RESHARD is a registry entry.
+    "TPU_DDP_ELASTIC_DIR", "TPU_DDP_ELASTIC_RANK",
+    "TPU_DDP_ELASTIC_JOIN",
 }
 
 

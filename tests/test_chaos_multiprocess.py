@@ -13,6 +13,12 @@ asserts the matching recovery mechanism engages:
   restarts it to completion.
 - ``corrupt-ckpt`` + ``hard-exit`` → the restarted run quarantines the
   damaged newest checkpoint and resumes from the previous verified one.
+- ``host-loss`` / ``hard-exit`` under ``elastic_reshard`` → the
+  SURVIVOR reshards its live TrainState onto the shrunken world (no
+  restart, no checkpoint restore), for both the announced and the
+  unannounced death.
+- ``host-join`` under ``elastic_reshard`` → the departed worker rejoins
+  a regrown epoch and restores from the survivors' state beacon.
 """
 
 from __future__ import annotations
@@ -123,3 +129,70 @@ def test_corrupt_checkpoint_falls_back_on_restart(tmp_path):
     quarantined = [d for d in os.listdir(ckpt_dir) if ".corrupt" in d]
     assert any(d.startswith("step_00000002") for d in quarantined), \
         sorted(os.listdir(ckpt_dir))
+
+
+def test_elastic_reshard_survives_host_loss(tmp_path):
+    """The tentpole drill: rank 1 is gracefully preempted at step 2
+    under elastic_reshard. The SURVIVOR must pull its live TrainState
+    to host, rebuild the one-process world, reshard, and finish the
+    run — zero restarts, zero checkpoint restores."""
+    env = dict(SMOKE_ENV)
+    env.update({
+        "TPU_DDP_CHAOS_FAULTS": "host-loss@2:rank=1",
+        "TPU_DDP_CHAOS_SENTINEL": str(tmp_path / "sentinels"),
+        "TPU_DDP_ELASTIC_RESHARD": "1",
+    })
+    res = launch("part3", nproc=2, env=env, echo=False, timeout=600,
+                 elastic_reshard=True)
+    assert res.ok, "\n".join(w.output for w in res.workers)
+    assert res.reshards == 1
+    # The departed rank's exit was absorbed, not counted as a failure.
+    assert [(w.rank, w.absorbed) for w in res.workers
+            if w.returncode != 0] == [(1, True)]
+    out0 = res.output_of(0)
+    assert "resharded in" in out0
+    assert "resumed from" not in out0        # live carry, no checkpoint
+    assert "Test set: average loss" in out0  # training went on to eval
+
+
+def test_elastic_reshard_absorbs_unannounced_crash(tmp_path):
+    """The UNANNOUNCED death: hard-exit leaves no departure note, so
+    the survivor first hits the failed gloo collective, then must wait
+    for the launcher to publish the shrunken epoch and convert the
+    wreckage into a membership change (engine._raise_membership_change)
+    instead of dying on the XlaRuntimeError."""
+    env = dict(SMOKE_ENV)
+    env.update({
+        "TPU_DDP_CHAOS_FAULTS": "hard-exit@2:rank=1",
+        "TPU_DDP_CHAOS_SENTINEL": str(tmp_path / "sentinels"),
+        "TPU_DDP_ELASTIC_RESHARD": "1",
+    })
+    res = launch("part3", nproc=2, env=env, echo=False, timeout=600,
+                 elastic_reshard=True)
+    assert res.ok, "\n".join(w.output for w in res.workers)
+    assert res.reshards == 1
+    out0 = res.output_of(0)
+    assert "resharded in" in out0
+    assert "resumed from" not in out0
+    assert "Test set: average loss" in out0
+
+
+def test_elastic_rejoin_restores_from_beacon(tmp_path):
+    """host-join: the worker leaves at step 2 and rejoins — a shrink
+    epoch then a regrow epoch, with the joiner restoring the LIVE state
+    from the survivors' beacon instead of a checkpoint."""
+    env = dict(SMOKE_ENV)
+    env.update({
+        "TPU_DDP_MAX_ITERS": "8",  # survivor must outlive the rejoin
+        "TPU_DDP_CHAOS_FAULTS": "host-join@2:rank=1",
+        "TPU_DDP_CHAOS_SENTINEL": str(tmp_path / "sentinels"),
+        "TPU_DDP_ELASTIC_RESHARD": "1",
+    })
+    res = launch("part3", nproc=2, env=env, echo=False, timeout=600,
+                 elastic_reshard=True)
+    assert res.ok, "\n".join(w.output for w in res.workers)
+    assert res.reshards == 2
+    assert res.output_of(0).count("resharded in") >= 2
+    assert any("joined with beaconed state" in w.output
+               for w in res.workers)
+    assert all("resumed from" not in w.output for w in res.workers)

@@ -1,0 +1,308 @@
+"""Live TrainState redistribution (tpu_ddp/parallel/redistribute.py).
+
+The elastic-membership contract, pinned leaf by leaf:
+
+- the PartitionSpec JSON codec round-trips every shape of spec tree the
+  strategies produce (prefix specs, per-leaf trees, tuple axes);
+- every strategy rung's ``sharding_plan()`` survives serialize ->
+  deserialize -> ``==`` (the plan IS the layout contract, so a lossy
+  codec would silently re-shard state wrong after a membership change);
+- re-resolving a plan against a different world moves ONLY the data
+  axis, and refuses worlds the model axes don't divide;
+- a state redistributed across a dp change is BITWISE the state that a
+  fresh shard of the same canonical bytes produces — f32 params, opt
+  state, step, and (same-dp) the int8 error-feedback residual;
+- a checkpoint written at one dp restores at another dp with an
+  identical sha256 over its canonical host bytes, for the flat-layout
+  strategies where dp actually changes the device bytes.
+"""
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_ddp.parallel.mesh import DATA_AXIS, SEQ_AXIS, make_mesh
+from tpu_ddp.parallel.redistribute import (ShardingPlan,
+                                           broadcast_shardings,
+                                           decode_spec_tree,
+                                           encode_spec_tree,
+                                           redistribute_state)
+from tpu_ddp.train.engine import Trainer
+from tpu_ddp.utils.config import TrainConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class TinyNoBN:
+    """Per-example-decoupled conv model (same rationale as
+    test_sync.TinyNoBN: no batch statistics, so distributed forwards
+    match the single-device pass exactly)."""
+
+    def init(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        return {
+            "conv": 0.3 * jax.random.normal(k1, (3, 3, 3, 8)),
+            "bias": jnp.zeros((8,)),
+            "head": 0.3 * jax.random.normal(k2, (2 * 2 * 8, 10)),
+            "head_b": 0.01 * jax.random.normal(k3, (10,)),
+        }
+
+    def apply(self, params, x):
+        y = lax.conv_general_dilated(
+            x, params["conv"], (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = jnp.maximum(y + params["bias"], 0)
+        y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 2, 2, 1),
+                              (1, 2, 2, 1), "VALID")
+        return y.reshape(y.shape[0], -1) @ params["head"] + params["head_b"]
+
+
+def _batch(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4, 4, 3)).astype(np.float32)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    return x, y
+
+
+def _trainer(devices, strategy, dp=4, **cfg):
+    mesh = make_mesh(devices[:dp]) if strategy != "none" else None
+    return Trainer(TinyNoBN(), TrainConfig(**cfg), strategy=strategy,
+                   mesh=mesh)
+
+
+def _advance(tr, state, steps=2):
+    for s in range(steps):
+        state, _ = tr.train_step(state, *tr.put_batch(*_batch(seed=s)))
+    return state
+
+
+def _assert_host_trees_bitwise(a, b):
+    al, ad = jax.tree.flatten(a)
+    bl, bd = jax.tree.flatten(b)
+    assert ad == bd
+    for x, y in zip(al, bl):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _sha256(tree) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(tree):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Spec-tree codec
+
+
+class TestSpecCodec:
+    def test_round_trip_nested(self):
+        tree = {
+            "prefix": P(DATA_AXIS),
+            "replicated": P(),
+            "tuple_axes": P((DATA_AXIS, "ep"), SEQ_AXIS),
+            "with_none": P(None, DATA_AXIS),
+            "stages": (P("pp"), [P(), P(DATA_AXIS)]),
+            "scalar": 3,
+            "none": None,
+        }
+        assert decode_spec_tree(encode_spec_tree(tree)) == tree
+
+    def test_tuples_survive_as_tuples(self):
+        # JSON has no tuples; the codec must not flatten them to lists
+        # (tree structures would stop matching the live spec trees).
+        got = decode_spec_tree(encode_spec_tree((P(), P(DATA_AXIS))))
+        assert isinstance(got, tuple)
+        spec = decode_spec_tree(encode_spec_tree(P((DATA_AXIS, "ep"))))
+        assert spec == P((DATA_AXIS, "ep"))
+        assert isinstance(spec[0], tuple)
+
+    def test_unserializable_leaf_raises(self):
+        with pytest.raises(TypeError, match="spec tree"):
+            encode_spec_tree({"bad": object()})
+
+
+# ---------------------------------------------------------------------------
+# Plan round-trip, every strategy rung
+
+
+class TestPlanRoundTrip:
+    @pytest.mark.parametrize("strategy", [
+        "none", "gather_scatter", "all_reduce", "fused", "zero", "fsdp",
+    ])
+    def test_engine_strategies(self, devices, strategy):
+        plan = _trainer(devices, strategy).sharding_plan()
+        back = ShardingPlan.from_json(plan.to_json())
+        assert back == plan
+        assert back.strategy == plan.strategy
+
+    def test_int8_compression_carries_comp_specs(self, devices):
+        plan = _trainer(devices, "fused",
+                        grad_compress="int8").sharding_plan()
+        assert plan.comp_specs is not None
+        back = ShardingPlan.from_json(plan.to_json())
+        assert back == plan
+
+    def test_save_load(self, devices, tmp_path):
+        plan = _trainer(devices, "zero").sharding_plan()
+        plan.save(str(tmp_path))
+        assert ShardingPlan.load(str(tmp_path)) == plan
+        assert ShardingPlan.load(str(tmp_path / "missing")) is None
+
+    def test_lm_trainer_rungs(self, devices):
+        # tp and sp shard the PROGRAM: their specs must survive the
+        # round-trip exactly (a dropped mp axis would re-place tensor-
+        # parallel weights replicated after a membership change).
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.train.lm import LMTrainer
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        for kw in ({"dp": 2, "mp": 2}, {"dp": 2, "sp": 2}):
+            mesh = make_mesh(devices[:4], **kw)
+            plan = LMTrainer(model, mesh).sharding_plan()
+            back = ShardingPlan.from_json(plan.to_json())
+            assert back == plan
+
+    def test_pipeline_trainer_rung(self, devices):
+        from tpu_ddp.models.transformer import make_transformer
+        from tpu_ddp.train.lm import PipelineLMTrainer
+        model = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                 compute_dtype=jnp.float32)
+        mesh = make_mesh(devices[:4], dp=2, pp=2)
+        plan = PipelineLMTrainer(model, mesh,
+                                 num_micro=2).sharding_plan()
+        back = ShardingPlan.from_json(plan.to_json())
+        assert back == plan
+
+    def test_version_gate(self):
+        with pytest.raises(ValueError, match="version"):
+            ShardingPlan.from_json('{"version": 99}')
+
+
+# ---------------------------------------------------------------------------
+# Re-resolution against a different world
+
+
+class TestResolveAxes:
+    def _plan(self, axes):
+        return ShardingPlan(strategy="fused", mesh_axes=axes,
+                            param_specs=P(), opt_specs=P())
+
+    def test_data_axis_absorbs_world_change(self):
+        plan = self._plan(((DATA_AXIS, 4), ("mp", 2)))
+        assert plan.resolve_axes(4) == {DATA_AXIS: 2, "mp": 2}
+        assert plan.resolve_axes(16) == {DATA_AXIS: 8, "mp": 2}
+
+    def test_model_axes_are_rigid(self):
+        plan = self._plan(((DATA_AXIS, 2), ("mp", 2), ("pp", 2)))
+        with pytest.raises(ValueError, match="model axes"):
+            plan.resolve_axes(6)
+
+    def test_compatible_with_ignores_world_size(self, devices):
+        p4 = _trainer(devices, "zero", dp=4).sharding_plan()
+        p2 = _trainer(devices, "zero", dp=2).sharding_plan()
+        assert p4.compatible_with(p2)
+        assert p4 != p2  # mesh_axes differ
+
+
+# ---------------------------------------------------------------------------
+# Redistribution: bitwise vs a fresh shard of the same canonical bytes
+
+
+class TestRedistribute:
+    def test_same_plan_same_mesh_is_identity(self, devices):
+        tr = _trainer(devices, "fused")
+        state = tr.init_state()
+        assert redistribute_state(state, tr, tr) is state
+
+    def test_fused_dp4_to_dp2_bitwise(self, devices):
+        src = _trainer(devices, "fused", dp=4)
+        state = _advance(src, src.init_state())
+        canonical = src.state_to_host(state)
+        dst = _trainer(devices, "fused", dp=2)
+        redist = redistribute_state(state, src, dst)
+        assert redist.step == state.step
+        _assert_host_trees_bitwise(dst.state_to_host(redist), canonical)
+        # Placement matches the destination plan, not just the bytes.
+        want = broadcast_shardings(dst.mesh, dst.sharding_plan()
+                                   .param_specs, redist.params)
+        got_spec = jax.tree.leaves(redist.params)[0].sharding.spec
+        assert got_spec == jax.tree.leaves(want)[0].spec
+
+    @pytest.mark.parametrize("strategy", ["zero", "fsdp"])
+    def test_flat_layouts_repartition_bitwise(self, devices, strategy):
+        # ZeRO/FSDP hold dp-PADDED flat shards on device: dp=4 and dp=2
+        # bytes differ on device but must agree canonically.
+        src = _trainer(devices, strategy, dp=4)
+        state = _advance(src, src.init_state())
+        canonical = src.state_to_host(state)
+        dst = _trainer(devices, strategy, dp=2)
+        redist = redistribute_state(state, src, dst)
+        _assert_host_trees_bitwise(dst.state_to_host(redist), canonical)
+
+    def test_int8_residual_same_dp_bitwise(self, devices):
+        src = _trainer(devices, "fused", dp=4, grad_compress="int8")
+        state = _advance(src, src.init_state())
+        assert state.comp_state is not None
+        canonical = src.state_to_host(state)
+        dst = _trainer(devices, "fused", dp=4, grad_compress="int8")
+        redist = redistribute_state(state, src, dst)
+        _assert_host_trees_bitwise(dst.state_to_host(redist), canonical)
+
+    def test_int8_residual_resets_on_dp_change(self, devices):
+        # The error-feedback residual is dp-sharded by construction;
+        # a dp change reshapes it, so the move must warn + reset — and
+        # params/opt must still carry bitwise.
+        src = _trainer(devices, "fused", dp=4, grad_compress="int8")
+        state = _advance(src, src.init_state())
+        canonical = src.state_to_host(state)
+        dst = _trainer(devices, "fused", dp=2, grad_compress="int8")
+        with pytest.warns(UserWarning, match="resetting"):
+            redist = redistribute_state(state, src, dst)
+        fresh = dst.compressor.init_state(dst._params_template(), 2,
+                                          seed=dst.config.seed)
+        _assert_host_trees_bitwise(
+            jax.device_get(redist.comp_state), jax.device_get(fresh))
+        got = dst.state_to_host(redist)
+        for part in ("params", "opt_state"):
+            _assert_host_trees_bitwise(got[part], canonical[part])
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint restore across world sizes, routed through the saved plan
+
+
+class TestCrossWorldCheckpoint:
+    @pytest.mark.parametrize("save_dp,restore_dp", [
+        (4, 2), (4, 8), (2, 4),
+    ])
+    def test_sha256_identical_across_dp(self, devices, tmp_path,
+                                        save_dp, restore_dp):
+        # "zero" is the strategy where dp changes the DEVICE bytes
+        # (flat dp-padded opt shards) — the cell that would catch a
+        # restore that forgot to re-partition.
+        src = _trainer(devices, "zero", dp=save_dp)
+        state = _advance(src, src.init_state())
+        src.save_checkpoint(str(tmp_path), state)
+        assert (tmp_path / "sharding_plan.json").exists()
+        digest = _sha256(src.state_to_host(state))
+
+        dst = _trainer(devices, "zero", dp=restore_dp)
+        restored = dst.restore_checkpoint(str(tmp_path))
+        assert restored.step == state.step
+        assert _sha256(dst.state_to_host(restored)) == digest
+
+    def test_cross_strategy_restore_warns(self, devices, tmp_path):
+        src = _trainer(devices, "fused", dp=2)
+        state = src.init_state()
+        src.save_checkpoint(str(tmp_path), state)
+        dst = _trainer(devices, "zero", dp=2)
+        with pytest.warns(UserWarning, match="layout"):
+            restored = dst.restore_checkpoint(str(tmp_path))
+        assert _sha256(dst.state_to_host(restored)) == \
+            _sha256(src.state_to_host(state))

@@ -496,6 +496,55 @@ class TestHeartbeat:
         with pytest.raises(ValueError):
             HeartbeatMonitor(str(tmp_path), nproc=1, timeout=0)
 
+    def test_stalled_ranks_names_the_wedged_rank(self, tmp_path):
+        # The pre-elastic monitor only compared the NEWEST beat to the
+        # deadline: one wedged rank among beating peers was invisible.
+        # Per-rank detection must name exactly the silent rank — and
+        # one wedged rank now trips the boolean summary too.
+        from tpu_ddp.resilience.watchdog import heartbeat_path
+        mon = HeartbeatMonitor(str(tmp_path), nproc=3, timeout=10.0)
+        for r in range(3):
+            touch_heartbeat(str(tmp_path), r, step=1)
+        base = mon.newest_beat()
+        p1 = heartbeat_path(str(tmp_path), 1)
+        os.utime(p1, (base - 60.0, base - 60.0))
+        assert mon.stalled_ranks(now=base + 5.0) == [1]
+        assert mon.stalled(now=base + 5.0)
+
+    def test_never_beaten_rank_measured_from_first_beat(self, tmp_path):
+        mon = HeartbeatMonitor(str(tmp_path), nproc=2, timeout=10.0)
+        touch_heartbeat(str(tmp_path), 0, step=1)
+        first = mon.newest_beat()
+        # Rank 1 never beat: it gets one full timeout of compile skew
+        # from the cluster's first beat, then both ranks are stale.
+        assert mon.stalled_ranks(now=first + 9.0) == []
+        assert mon.stalled_ranks(now=first + 10.5) == [0, 1]
+
+    def test_ranks_filter_ignores_departed(self, tmp_path):
+        # The elastic launcher restricts the check to live membership:
+        # a departed rank's stale heartbeat file must not re-trip.
+        from tpu_ddp.resilience.watchdog import heartbeat_path
+        mon = HeartbeatMonitor(str(tmp_path), nproc=2, timeout=10.0)
+        for r in (0, 1):
+            touch_heartbeat(str(tmp_path), r, step=3)
+        base = mon.newest_beat()
+        os.utime(heartbeat_path(str(tmp_path), 1),
+                 (base - 60.0, base - 60.0))
+        assert mon.stalled_ranks(now=base + 1.0) == [1]
+        assert mon.stalled_ranks(now=base + 1.0, ranks=[0]) == []
+
+    def test_reset_grace_covers_reshard_recompile(self, tmp_path):
+        # After a membership epoch every survivor legitimately pauses
+        # beating to recompile; reset_grace restarts all clocks.
+        mon = HeartbeatMonitor(str(tmp_path), nproc=2, timeout=10.0)
+        for r in (0, 1):
+            touch_heartbeat(str(tmp_path), r, step=3)
+        base = mon.newest_beat()
+        assert mon.stalled_ranks(now=base + 60.0) == [0, 1]
+        mon.reset_grace(now=base + 60.0)
+        assert mon.stalled_ranks(now=base + 65.0) == []
+        assert mon.stalled_ranks(now=base + 71.0) == [0, 1]
+
     def test_exit_codes_distinct(self):
         from tpu_ddp.resilience.chaos import FAULT_EXIT_CODE
         assert STALL_EXIT_CODE != FAULT_EXIT_CODE

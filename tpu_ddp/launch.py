@@ -54,6 +54,9 @@ class WorkerResult:
     rank: int
     returncode: int
     output: str = ""
+    # True when this worker's (nonzero) exit was handled by a live
+    # reshard — the survivors carried on, so it does not fail the launch.
+    absorbed: bool = False
 
 
 @dataclass
@@ -65,6 +68,10 @@ class LaunchResult:
     # Number of cluster restarts performed before this (final) attempt —
     # nonzero only for launch_elastic.
     restarts: int = 0
+    # Number of live membership epochs (reshard-arounds) this attempt
+    # performed instead of restarting — nonzero only under
+    # elastic_reshard.
+    reshards: int = 0
     # True when the heartbeat watchdog killed this attempt: every rank
     # was alive but none had completed a step within heartbeat_timeout
     # (the hung-collective failure mode — see resilience/watchdog.py).
@@ -75,9 +82,10 @@ class LaunchResult:
         if self.first_failure:
             return self.first_failure
         # Fallback (e.g. hand-built results): any nonzero rank fails the
-        # launch, including negative signal-kill codes.
+        # launch, including negative signal-kill codes — except workers
+        # whose departure a reshard absorbed.
         return next((w.returncode for w in self.workers
-                     if w.returncode != 0), 0)
+                     if w.returncode != 0 and not w.absorbed), 0)
 
     @property
     def ok(self) -> bool:
@@ -112,6 +120,9 @@ def launch(
     timeout: float | None = None,
     heartbeat_timeout: float | None = None,
     heartbeat_dir: str | None = None,
+    elastic_reshard: bool = False,
+    ack_timeout: float = 120.0,
+    rejoin_delay: float = 1.0,
 ) -> LaunchResult:
     """Run ``nproc`` rank processes of ``parts/<part>/main.py`` and wait.
 
@@ -127,6 +138,19 @@ def launch(
     reported with ``stalled=True`` / exit :data:`STALL_EXIT_CODE` —
     catching hung collectives in seconds instead of waiting out
     ``timeout`` (which still bounds never-started clusters).
+
+    ``elastic_reshard`` turns a lost rank from a cluster-wide failure
+    into a membership epoch: the launcher writes a ``membership.json``
+    protocol directory (resilience/elastic.py), workers join via the
+    non-fatal elastic bootstrap, and when a rank dies or stalls while
+    others survive, the launcher publishes a shrunken epoch and waits
+    for the survivors to reshard their LIVE TrainState around the hole
+    (acks within ``ack_timeout``) instead of killing everyone. A rank
+    exiting ``HOST_JOIN_EXIT`` is respawned after ``rejoin_delay`` as a
+    joiner of a regrown epoch. When a reshard cannot converge (acks
+    time out, a survivor exits ``RESHARD_FALLBACK_EXIT``), the attempt
+    fails with that code so :func:`launch_elastic` falls back to
+    restart-from-checkpoint.
     """
     if nproc < 1:
         raise ValueError("nproc must be >= 1")
@@ -153,11 +177,21 @@ def launch(
     if heartbeat_timeout is not None:
         hb_dir = heartbeat_dir or tempfile.mkdtemp(prefix="tpu_ddp_hb_")
         monitor = HeartbeatMonitor(hb_dir, nproc, heartbeat_timeout)
+    control_dir = None
+    if elastic_reshard and nproc > 1:
+        from tpu_ddp.resilience import elastic as _el
+        # The heartbeat dir doubles as the protocol dir when armed —
+        # one place to look at in a post-mortem.
+        control_dir = (monitor.directory if monitor is not None
+                       else tempfile.mkdtemp(prefix="tpu_ddp_elastic_"))
+        _el.reset_control_dir(control_dir)
+        _el.write_membership(control_dir, {
+            "epoch": 0, "world": nproc, "base_world": nproc,
+            "assignments": {str(i): i for i in range(nproc)},
+            "coordinator": f"127.0.0.1:{port}",
+            "joiners": [], "dropped": []})
 
-    procs = []
-    sinks = []
-    threads = []
-    for rank in range(nproc):
+    def spawn(rank: int, join_epoch: int | None = None):
         child_env = dict(os.environ)
         child_env["JAX_PLATFORMS"] = platform
         if monitor is not None:
@@ -169,6 +203,13 @@ def launch(
             flags.append("--xla_force_host_platform_device_count="
                          f"{devices_per_proc}")
             child_env["XLA_FLAGS"] = " ".join(flags)
+        if control_dir is not None:
+            from tpu_ddp.resilience import elastic as _el
+            child_env[_el.ELASTIC_ENV] = "1"
+            child_env[_el.ELASTIC_DIR_ENV] = control_dir
+            child_env[_el.ELASTIC_RANK_ENV] = str(rank)
+            if join_epoch is not None:
+                child_env[_el.ELASTIC_JOIN_ENV] = str(join_epoch)
         if env:
             child_env.update(env)
         cmd = [sys.executable, str(script),
@@ -184,6 +225,17 @@ def launch(
         t = threading.Thread(target=_drain, args=(proc, rank, sink, echo),
                              daemon=True)
         t.start()
+        return proc, sink, t
+
+    if control_dir is not None:
+        return _run_elastic(spawn, nproc, control_dir, monitor, timeout,
+                            ack_timeout, rejoin_delay)
+
+    procs = []
+    sinks = []
+    threads = []
+    for rank in range(nproc):
+        proc, sink, t = spawn(rank)
         procs.append(proc)
         sinks.append(sink)
         threads.append(t)
@@ -252,6 +304,185 @@ def launch(
         t.join(timeout=5)
     for w, sink in zip(result.workers, sinks):
         w.output = "\n".join(sink)
+    return result
+
+
+def _run_elastic(spawn, nproc: int, control_dir: str,
+                 monitor: HeartbeatMonitor | None, timeout: float | None,
+                 ack_timeout: float, rejoin_delay: float) -> LaunchResult:
+    """The elastic poll loop: absorb rank departures into membership
+    epochs instead of killing the cluster.
+
+    State machine per event:
+    - worker exits 0            -> done (success once all members do)
+    - worker exits nonzero,
+      survivors remain          -> departure note on its behalf, write
+                                   epoch+1 (survivors keep low ranks,
+                                   fresh coordinator port), wait for
+                                   every survivor's ack
+    - exit was HOST_JOIN_EXIT   -> additionally respawn it after
+                                   ``rejoin_delay`` as the highest rank
+                                   of a regrown epoch (it restores from
+                                   the survivors' state beacon)
+    - RESHARD_FALLBACK_EXIT, no
+      survivors, or acks time
+      out                       -> kill everyone, fail the attempt so
+                                   launch_elastic restarts from ckpt
+    - a rank's heartbeat stalls -> kill THAT rank; its -9 is absorbed
+                                   like any other departure (all ranks
+                                   stalled -> whole-cluster stall, the
+                                   plain watchdog path)
+    """
+    from tpu_ddp.resilience import elastic as _el
+
+    live = {wid: spawn(wid) for wid in range(nproc)}
+    epoch = 0
+    reshards = 0
+    dropped: list = []
+    done: list = []  # (WorkerResult, sink, thread)
+    pending_join: list = []  # (due_monotonic, wid)
+    deadline = None if timeout is None else time.monotonic() + timeout
+    first_failure = 0
+    stalled_flag = False
+
+    def record(wid, rc, sink, thread, absorbed=False):
+        done.append((WorkerResult(rank=wid, returncode=rc,
+                                  absorbed=absorbed), sink, thread))
+
+    def kill_all():
+        for wid, (proc, sink, t) in list(live.items()):
+            if proc.poll() is None:
+                proc.kill()
+            record(wid, proc.wait(), sink, t)
+            del live[wid]
+
+    def write_epoch(joiner=None):
+        nonlocal epoch, reshards
+        epoch += 1
+        reshards += 1
+        # Survivors keep the low ranks; a joiner takes the highest —
+        # rank 0 (coordination service host + beacon writer) is always
+        # an already-running survivor.
+        order = sorted(live)
+        if joiner is not None and joiner not in live:
+            order.append(joiner)
+        _el.write_membership(control_dir, {
+            "epoch": epoch, "world": len(order), "base_world": nproc,
+            "assignments": {str(w): i for i, w in enumerate(order)},
+            "coordinator": f"127.0.0.1:{find_free_port()}",
+            "joiners": [] if joiner is None else [joiner],
+            "dropped": sorted(dropped)})
+        return order
+
+    def await_acks(members):
+        stop = time.monotonic() + ack_timeout
+        while time.monotonic() < stop:
+            if all(os.path.exists(_el.ack_path(control_dir, epoch, w))
+                   for w in members):
+                if monitor is not None:
+                    # Survivors paused beating to recompile; fresh grace.
+                    monitor.reset_grace()
+                return True
+            # A member dying mid-reshard (cascade) fails the epoch.
+            if any(w in live and live[w][0].poll() is not None
+                   for w in members):
+                return False
+            time.sleep(0.05)
+        return False
+
+    while (live or pending_join) and not first_failure:
+        now = time.monotonic()
+        # 1. Respawn due joiners into a regrown epoch.
+        for item in [x for x in pending_join if x[0] <= now]:
+            pending_join.remove(item)
+            wid = item[1]
+            if not live:
+                first_failure = _el.HOST_JOIN_EXIT
+                break
+            _el.clear_departure(control_dir, wid)
+            if wid in dropped:
+                dropped.remove(wid)
+            members = write_epoch(joiner=wid)
+            live[wid] = spawn(wid, join_epoch=epoch)
+            print(f"[launch] epoch {epoch}: worker {wid} rejoining, "
+                  f"world={len(members)}", flush=True)
+            if not await_acks(members):
+                print("[launch] rejoin epoch failed to converge; "
+                      "falling back to restart", flush=True)
+                first_failure = _el.RESHARD_FALLBACK_EXIT
+                kill_all()
+                break
+        if first_failure:
+            break
+        # 2. Reap exits.
+        for wid in sorted(live):
+            proc, sink, t = live[wid]
+            rc = proc.poll()
+            if rc is None:
+                continue
+            del live[wid]
+            if rc == 0:
+                record(wid, 0, sink, t)
+                continue
+            if rc == _el.RESHARD_FALLBACK_EXIT or not live:
+                # A survivor that cannot carry its live state, or the
+                # last member dying: nothing to reshard around.
+                record(wid, rc, sink, t)
+                first_failure = rc
+                kill_all()
+                break
+            reason = {_el.HOST_LOSS_EXIT: "host-loss",
+                      _el.HOST_JOIN_EXIT: "host-join"}.get(
+                          rc, f"rc={rc}")
+            _el.announce_departure(control_dir, wid, reason)
+            record(wid, rc, sink, t, absorbed=True)
+            dropped.append(wid)
+            members = write_epoch()
+            print(f"[launch] epoch {epoch}: worker {wid} left "
+                  f"({reason}); resharding onto {len(members)} "
+                  f"survivor(s)", flush=True)
+            if not await_acks(members):
+                print("[launch] reshard failed to converge; falling "
+                      "back to restart", flush=True)
+                first_failure = _el.RESHARD_FALLBACK_EXIT
+                kill_all()
+                break
+            if rc == _el.HOST_JOIN_EXIT:
+                pending_join.append((time.monotonic() + rejoin_delay,
+                                     wid))
+        if first_failure:
+            break
+        # 3. Per-rank stalls: kill the wedged rank, absorb it above.
+        if monitor is not None and live:
+            stalled = monitor.stalled_ranks(ranks=sorted(live))
+            if stalled and len(stalled) == len(live):
+                print(f"[launch] heartbeat stall on every live rank "
+                      f"({monitor.timeout:.0f}s) — killing the cluster",
+                      flush=True)
+                first_failure = STALL_EXIT_CODE
+                stalled_flag = True
+                kill_all()
+                break
+            for wid in stalled:
+                print(f"[launch] rank {wid} heartbeat stalled "
+                      f"({monitor.timeout:.0f}s); killing it and "
+                      f"resharding around it", flush=True)
+                live[wid][0].kill()
+        # 4. Overall deadline still bounds the attempt.
+        if deadline is not None and now > deadline:
+            first_failure = -9
+            kill_all()
+            break
+        if live or pending_join:
+            time.sleep(0.05)
+
+    result = LaunchResult(first_failure=first_failure,
+                          reshards=reshards, stalled=stalled_flag)
+    for w, sink, t in done:
+        t.join(timeout=5)
+        w.output = "\n".join(sink)
+        result.workers.append(w)
+    result.workers.sort(key=lambda w: w.rank)
     return result
 
 
@@ -409,6 +640,16 @@ def main(argv=None) -> int:
                         "stores between forward and backward; stage "
                         "arithmetic stays in compute_dtype. Sets "
                         "TPU_DDP_ACT_DTYPE for every rank")
+    p.add_argument("--elastic-reshard", action="store_true",
+                   help="on membership change (a rank lost, stalled, "
+                        "or rejoining) reshard the survivors' LIVE "
+                        "TrainState onto a rebuilt mesh instead of "
+                        "killing the cluster "
+                        "(tpu_ddp/resilience/elastic.py + "
+                        "parallel/redistribute.py); failed reshards "
+                        "still fall back to --max-restarts checkpoint "
+                        "recovery. Sets TPU_DDP_ELASTIC_RESHARD for "
+                        "every rank")
     p.add_argument("--autotune", default=None,
                    choices=("off", "cached", "search"),
                    help="perf-knob autotuning (tpu_ddp/tune/): 'cached' "
@@ -433,6 +674,8 @@ def main(argv=None) -> int:
         env["TPU_DDP_ACT_DTYPE"] = args.act_dtype
     if args.autotune is not None:
         env["TPU_DDP_AUTOTUNE"] = args.autotune
+    if args.elastic_reshard:
+        env["TPU_DDP_ELASTIC_RESHARD"] = "1"
     env = env or None
     try:
         res = launch_elastic(args.part, args.nproc,
@@ -441,6 +684,7 @@ def main(argv=None) -> int:
                              min_restart_interval=args.min_restart_interval,
                              restart_window=args.restart_window,
                              heartbeat_timeout=args.heartbeat_timeout,
+                             elastic_reshard=args.elastic_reshard,
                              platform=args.platform,
                              devices_per_proc=args.devices_per_proc,
                              port=args.port)
@@ -450,6 +694,9 @@ def main(argv=None) -> int:
         print(f"[launch] rank {w.rank} exited {w.returncode}")
     if res.stalled:
         print("[launch] final attempt killed by the heartbeat watchdog")
+    if res.reshards:
+        print(f"[launch] absorbed {res.reshards} membership epoch(s) "
+              "by live resharding")
     if res.restarts:
         print(f"[launch] recovered after {res.restarts} restart(s)")
     return res.returncode

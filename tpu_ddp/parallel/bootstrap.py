@@ -88,13 +88,24 @@ def init_distributed_setup(
                     "jax_cpu_collectives_implementation", "gloo")
             except (AttributeError, ValueError):
                 pass  # flag renamed/absent: that jax works by default
-        # Blocks until all `world_size` processes join, like the gloo TCP
-        # rendezvous at reference part2/part2a/main.py:56-58.
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=world_size,
-            process_id=rank,
-        )
+        from tpu_ddp.resilience.elastic import (bootstrap as
+                                                elastic_bootstrap,
+                                                elastic_env_active)
+        if elastic_env_active():
+            # Elastic worlds must survive peer death: the stock
+            # initialize installs a missed-heartbeat callback that
+            # LOG(FATAL)s the survivors and a shutdown barrier a dead
+            # peer fails fatally (resilience/elastic.py). Same
+            # rendezvous semantics, non-fatal failure modes.
+            elastic_bootstrap(coordinator, world_size, rank)
+        else:
+            # Blocks until all `world_size` processes join, like the
+            # gloo TCP rendezvous at reference part2/part2a/main.py:56-58.
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=world_size,
+                process_id=rank,
+            )
     devices = jax.devices()
     return DistributedContext(
         rank=jax.process_index() if world_size > 1 else rank,
@@ -127,4 +138,11 @@ def shutdown(ctx: DistributedContext) -> None:
     """Teardown, mirroring ``dist.destroy_process_group()``
     (reference part2/part2a/main.py:207)."""
     if ctx.coordinator is not None:
+        from tpu_ddp.resilience.elastic import elastic_env_active
+        if elastic_env_active():
+            # The elastic client never enters the shutdown barrier (a
+            # departed peer fails it fatally, and our non-fatal client
+            # hangs in it); processes just exit — the coordination
+            # stubs are leaked by design (resilience/elastic.py).
+            return
         jax.distributed.shutdown()

@@ -3,7 +3,7 @@
 Generalizes the original single-knob ``TPU_DDP_FAIL_AT_STEP`` hard-exit
 (kept, verbatim, as :func:`maybe_inject_failure` — ``utils/invariants``
 re-exports it for back-compat) into a pluggable :class:`FaultInjector`
-with five fault kinds, each exercising one recovery mechanism:
+with seven fault kinds, each exercising one recovery mechanism:
 
 ========================  =============================================
 fault kind                recovery path it drills
@@ -13,7 +13,19 @@ fault kind                recovery path it drills
 ``stalled-step``          heartbeat watchdog kill + elastic restart
 ``corrupt-ckpt``          digest verification + quarantine + fallback
 ``slow-rank``             straggler tolerance (run completes, slower)
+``host-loss``             live reshard: survivors shrink the world and
+                          carry their in-memory state (or checkpoint
+                          restart when resharding is off/impossible)
+``host-join``             live reshard both ways: shrink, then regrow
+                          when the host returns and joins mid-run
 ========================  =============================================
+
+``host-loss`` and ``host-join`` are *graceful* preemptions: when the
+elastic protocol is active they write a departure notice
+(resilience/elastic.py) before dying, which is exactly what a real
+preemption signal handler would do — survivors stop dispatching doomed
+collectives at the next step boundary. Without the protocol they are
+indistinguishable from ``hard-exit`` with a different exit code.
 
 Faults are configured by env so they reach launcher-spawned worker
 processes unchanged:
@@ -46,7 +58,7 @@ import numpy as np
 FAULT_EXIT_CODE = 13
 
 FAULT_KINDS = ("hard-exit", "nan-grad", "stalled-step", "corrupt-ckpt",
-               "slow-rank")
+               "slow-rank", "host-loss", "host-join")
 
 CHAOS_ENV = "TPU_DDP_CHAOS_FAULTS"
 
@@ -246,8 +258,27 @@ class FaultInjector:
                 self._announce(spec, step)
                 self._mark_sentinel(spec, step)
                 os._exit(FAULT_EXIT_CODE)
+        for spec in self.specs:
+            if spec.kind in ("host-loss", "host-join") \
+                    and self._fires(spec, step):
+                self._announce(spec, step)
+                self._mark_sentinel(spec, step)
+                self._graceful_preemption(spec)
         # Legacy knob (TPU_DDP_FAIL_AT_STEP) rides the same hook.
         maybe_inject_failure(step)
+
+    def _graceful_preemption(self, spec: FaultSpec) -> None:
+        """Die like a preempted host: departure notice first (when the
+        elastic protocol is armed), then a hard exit with the code that
+        tells the launcher whether this host ever comes back."""
+        from tpu_ddp.resilience import elastic
+        if elastic.elastic_env_active():
+            elastic.announce_departure(
+                os.environ[elastic.ELASTIC_DIR_ENV],
+                int(os.environ.get(elastic.ELASTIC_RANK_ENV, "0")),
+                reason=spec.kind)
+        os._exit(elastic.HOST_LOSS_EXIT if spec.kind == "host-loss"
+                 else elastic.HOST_JOIN_EXIT)
 
     @staticmethod
     def poison_images(images):

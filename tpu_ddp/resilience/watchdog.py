@@ -17,9 +17,10 @@ unaffected (the pipeline force-drains whenever ``dispatch_depth``
 results are outstanding, so a healthy loop beats at least once per
 ``dispatch_depth`` steps — far inside any sane stall deadline, and the
 watchdog only reads mtimes anyway). The launcher polls the directory;
-when the NEWEST heartbeat across all ranks is older than the deadline,
-the whole cluster is declared stalled, killed, and (under
-``launch_elastic``) restarted with backoff. Files-and-mtimes survive any
+any rank whose heartbeat is older than the deadline is reported by
+``stalled_ranks()`` — the elastic launcher reshards around it, the
+plain one declares the cluster stalled, kills it, and (under
+``launch_elastic``) restarts with backoff. Files-and-mtimes survive any
 IPC weirdness: a worker wedged inside a C++ collective cannot answer an
 RPC, but its last heartbeat is still on disk telling us when it wedged.
 
@@ -73,11 +74,23 @@ def heartbeat_from_env():
 class HeartbeatMonitor:
     """Launcher-side stall detector over a heartbeat directory.
 
-    ``stalled()`` is True iff at least one heartbeat exists (grace —
-    see module docstring) and the newest one across ALL ranks is older
-    than ``timeout`` seconds. One slow rank does not trip it; the
-    cluster as a whole going silent does — which is exactly what a hung
-    collective looks like from the host.
+    ``stalled_ranks()`` names every rank whose heartbeat has gone
+    silent for longer than ``timeout``; ``stalled()`` is its boolean
+    summary. The original monitor only compared the NEWEST beat across
+    ranks against the deadline — a blind spot: one wedged rank while
+    the others keep beating (they will, for up to ``dispatch_depth``
+    steps, before blocking in the next collective) left ``stalled()``
+    False until the whole cluster went quiet. Per-rank mtimes close
+    that gap and, just as importantly, tell the elastic launcher
+    *which* rank to reshard around instead of killing everyone.
+
+    Grace: until the first beat exists the monitor is silent (compile
+    time, see module docstring). A rank that has never beaten is
+    measured from the cluster's FIRST beat — it gets one full
+    ``timeout`` of private compile skew before being called stalled.
+    ``reset_grace()`` restarts the clock for every rank; the elastic
+    launcher calls it after a membership epoch, when all survivors
+    legitimately paused beating to recompile against the new mesh.
     """
 
     def __init__(self, directory: str, nproc: int, timeout: float):
@@ -86,22 +99,51 @@ class HeartbeatMonitor:
         self.directory = directory
         self.nproc = nproc
         self.timeout = timeout
+        self._grace: float | None = None
+
+    def beats(self) -> dict:
+        """{rank: mtime} for every rank with a heartbeat file."""
+        out = {}
+        for rank in range(self.nproc):
+            try:
+                out[rank] = os.path.getmtime(
+                    heartbeat_path(self.directory, rank))
+            except OSError:
+                continue
+        return out
 
     def newest_beat(self) -> float | None:
         """mtime of the newest heartbeat, or None before the first."""
-        newest = None
-        for rank in range(self.nproc):
-            try:
-                m = os.path.getmtime(heartbeat_path(self.directory, rank))
-            except OSError:
-                continue
-            if newest is None or m > newest:
-                newest = m
-        return newest
+        beats = self.beats()
+        return max(beats.values()) if beats else None
+
+    def reset_grace(self, now: float | None = None) -> None:
+        """Give every rank a fresh ``timeout`` before it can stall."""
+        self._grace = time.time() if now is None else now
+
+    def stalled_ranks(self, now: float | None = None,
+                      ranks=None) -> list:
+        """Ranks silent for > ``timeout``, oldest-silence first order.
+
+        ``ranks`` restricts the check (the elastic launcher passes its
+        live membership so departed ranks' stale files don't re-trip).
+        """
+        beats = self.beats()
+        if not beats and self._grace is None:
+            return []  # grace: nobody has ever beaten
+        now = time.time() if now is None else now
+        anchors = list(beats.values())
+        if self._grace is not None:
+            anchors.append(self._grace)
+        first = min(anchors)
+        out = []
+        for rank in (range(self.nproc) if ranks is None else ranks):
+            beat = beats.get(rank, first)
+            if self._grace is not None and self._grace > beat:
+                beat = self._grace
+            if now - beat > self.timeout:
+                out.append(rank)
+        return sorted(out)
 
     def stalled(self, now: float | None = None) -> bool:
-        newest = self.newest_beat()
-        if newest is None:
-            return False
-        now = time.time() if now is None else now
-        return now - newest > self.timeout
+        return bool(self.stalled_ranks(now))

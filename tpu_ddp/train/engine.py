@@ -19,6 +19,7 @@ iteration-1..39 ns timer (reference part1/main.py:82-91) both survive, with
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable
 
 import jax
@@ -324,6 +325,195 @@ class Trainer:
 
     # ---- checkpoint / resume (no reference equivalent, SURVEY.md §5) ---
 
+    def sharding_plan(self):
+        """This trainer's layout contract as a serializable
+        :class:`~tpu_ddp.parallel.redistribute.ShardingPlan` — the same
+        spec trees the shard_map surfaces close over, lifted out so a
+        checkpoint, a membership epoch, or a test can re-resolve them
+        against a different mesh."""
+        from tpu_ddp.parallel.redistribute import ShardingPlan
+        if self.mesh is not None:
+            mesh_axes = tuple((str(n), int(s))
+                              for n, s in self.mesh.shape.items())
+        else:
+            mesh_axes = ((DATA_AXIS, 1),)
+        return ShardingPlan(
+            strategy=self.strategy_name,
+            mesh_axes=mesh_axes,
+            param_specs=self._param_spec(),
+            opt_specs=self._opt_spec(),
+            comp_specs=self._comp_specs,
+            batch_spec=P(DATA_AXIS),
+        )
+
+    def state_to_host(self, state: TrainState,
+                      local_only: bool = False) -> dict:
+        """Pull ``state`` to CANONICAL host numpy form on every process.
+
+        The gather runs LEAF BY LEAF (the bounded decomposition of
+        arxiv 2112.01075): the device-memory peak is one replicated
+        leaf, never the whole tree. Both checkpointing and live
+        resharding feed off this one path, so a canonical host tree is
+        *the* portable representation of training state.
+
+        ``local_only=True`` is the membership-change path: a peer may
+        already be dead, so no cross-process collective may run. State
+        sharded across processes (ZeRO/FSDP at process_count > 1)
+        cannot be pulled locally — that raises, and the elastic loop
+        falls back to restart-from-checkpoint. The dp-sharded
+        compression residual is likewise skipped (reset after the
+        reshard; it is an accelerator, not model state)."""
+        multiproc = jax.process_count() > 1
+        params = state.params
+        opt_state = state.opt_state
+        comp_state = state.comp_state
+        if local_only and multiproc and (self.is_zero or self.is_fsdp):
+            raise RuntimeError(
+                "live state of a cross-process ZeRO/FSDP run cannot be "
+                "snapshotted without the lost peer's shards; this "
+                "membership change needs a checkpoint restart")
+        if comp_state is not None and self.mesh is not None:
+            if local_only and multiproc:
+                comp_state = None
+            else:
+                # The error-feedback residual is dp-sharded (each
+                # device's own quantization error); gather it whole.
+                from tpu_ddp.utils.checkpoint import gather_tree_to_host
+                comp_state = gather_tree_to_host(comp_state,
+                                                 self._repl_sharding)
+        if self.mesh is not None and (self.is_zero or self.is_fsdp):
+            from tpu_ddp.utils.checkpoint import gather_tree_to_host
+            opt_state = gather_tree_to_host(opt_state,
+                                            self._repl_sharding)
+            if self.is_fsdp:
+                params = gather_tree_to_host(params, self._repl_sharding)
+        # Flat dp-padded layouts -> canonical shapes (host-side numpy).
+        if self.is_zero:
+            opt_state = self.optimizer.canonicalize_opt_host(opt_state)
+        if self.is_fsdp:
+            params = self.zero3.unshard_host(params)
+            opt_state = self.zero3.canonicalize_opt_host(opt_state)
+        to_np = lambda t: jax.tree.map(np.asarray, t)
+        tree = {"params": to_np(params), "opt_state": to_np(opt_state),
+                "step": np.int64(state.step)}
+        if comp_state is not None:
+            tree["comp_state"] = to_np(comp_state)
+        return tree
+
+    def state_from_host(self, host: dict) -> TrainState:
+        """Place a canonical host tree onto THIS trainer's mesh, laid
+        out by its :meth:`sharding_plan` — the other half of
+        :meth:`state_to_host`, shared by checkpoint restore and live
+        resharding. The source's world size is irrelevant: flat layouts
+        re-partition for this trainer's dp from canonical shapes."""
+        from tpu_ddp.parallel.redistribute import broadcast_shardings
+        plan = self.sharding_plan()
+        params = host["params"]
+        opt_state = host["opt_state"]
+        if self.is_zero:
+            opt_state = self.optimizer.flatten_opt(opt_state)
+        if self.is_fsdp:
+            params = self.zero3.shard_params(params)
+            opt_state = self.zero3.flatten_opt(opt_state)
+        if self.mesh is not None:
+            params = jax.device_put(
+                params,
+                broadcast_shardings(self.mesh, plan.param_specs, params))
+            opt_state = jax.device_put(
+                opt_state,
+                broadcast_shardings(self.mesh, plan.opt_specs, opt_state))
+        comp_state = (self._adopt_comp_host(host.get("comp_state"))
+                      if self._comp_stateful else None)
+        return TrainState(params=params, opt_state=opt_state,
+                          step=int(host.get("step", 0)),
+                          comp_state=comp_state)
+
+    def _adopt_comp_host(self, comp_host):
+        """Adopt a host-form compression carry if its layout matches
+        this trainer's template; otherwise reset it (zero residual,
+        fresh seed) — the residual is an optimization accelerator, so a
+        reset costs a few re-absorbed quantization errors, never
+        correctness."""
+        template = self._comp_template
+        ok = comp_host is not None
+        if ok:
+            try:
+                t_leaves, t_def = jax.tree.flatten(template)
+                h_leaves, h_def = jax.tree.flatten(comp_host)
+                ok = (t_def == h_def
+                      and all(tuple(t.shape) == tuple(np.shape(h))
+                              and t.dtype == np.asarray(h).dtype
+                              for t, h in zip(t_leaves, h_leaves)))
+            except (TypeError, ValueError):
+                ok = False
+        if not ok:
+            if comp_host is not None:
+                import warnings
+                warnings.warn(
+                    "compression carry does not match this trainer's "
+                    "layout (different dp or residual shape); resetting "
+                    "the error-feedback residual.", stacklevel=3)
+            comp_host = self.compressor.init_state(
+                self._params_template(), self._dp, seed=self.config.seed)
+        if self.mesh is not None:
+            comp_host = jax.device_put(comp_host, self._comp_shardings())
+        return comp_host
+
+    def rebind_mesh(self, mesh: Mesh) -> None:
+        """Re-resolve every mesh-derived surface against a NEW mesh —
+        the trainer half of a membership change. The flat ZeRO/FSDP
+        layouts, the compression carry template, the batch/replicated
+        shardings, the jitted train/eval steps, and the memoized
+        K-step / eval closures are all functions of the mesh; rebuild
+        or drop each so the next dispatch traces against the new world.
+        State placement is NOT done here — pull it through
+        :meth:`state_to_host` before the old mesh dies and
+        :meth:`state_from_host` after this rebind."""
+        self.mesh = mesh
+        self._dp = mesh.shape[DATA_AXIS] if mesh is not None else 1
+        self._guard_axis = (
+            DATA_AXIS if mesh is not None
+            and canonical_strategy(self.strategy_name) != "none" else None)
+        if self.is_zero:
+            from tpu_ddp.parallel.zero import ZeRO1
+            self.optimizer = ZeRO1(self.optimizer.inner, DATA_AXIS,
+                                   self._dp,
+                                   template=self._params_template())
+        if self.is_fsdp:
+            from tpu_ddp.parallel.zero import ZeRO3
+            self.zero3 = ZeRO3(self.zero3.inner, DATA_AXIS, self._dp,
+                               template=self._params_template())
+        if self._comp_active and self._dp < 2:
+            # Compression needs a dp>1 collective to compress; a world
+            # shrunk to one data shard degrades to the no-op (same
+            # contract as construction-time).
+            import warnings
+            warnings.warn(
+                "mesh rebind left dp=1; gradient compression disabled.",
+                stacklevel=2)
+            from tpu_ddp.parallel.compress import get_compressor
+            self.compressor = get_compressor("none")
+            self._comp_active = self._comp_stateful = False
+            self._comp_template = self._comp_specs = None
+        elif self._comp_stateful:
+            self._comp_template = self.compressor.init_state(
+                self._params_template(), self._dp, abstract=True)
+            self._comp_specs = self.compressor.state_specs(
+                self._comp_template)
+        if mesh is not None:
+            self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+            self._repl_sharding = NamedSharding(mesh, P())
+            self._param_put_sharding = (
+                NamedSharding(mesh, P(DATA_AXIS)) if self.is_fsdp
+                else self._repl_sharding)
+        self._train_step = self._build_train_step()
+        self._eval_step = jax.jit(self._eval_step_impl)
+        # Memoized mesh-bound closures: stale against the new world.
+        for attr in ("_multi_step_cache", "_sharded_eval",
+                     "_materialize_fn"):
+            if hasattr(self, attr):
+                delattr(self, attr)
+
     def save_checkpoint(self, directory: str, state: TrainState,
                         keep_last: int | None = None,
                         background: bool = False) -> str | None:
@@ -335,43 +525,17 @@ class Trainer:
         (utils/checkpoint.py:AsyncCheckpointWriter) — call
         :meth:`wait_for_checkpoints` before reading the file back or
         exiting. Any gather collectives for sharded state still run
-        synchronously on every process."""
-        params = state.params
-        opt_state = state.opt_state
-        comp_state = state.comp_state
-        if comp_state is not None and self.mesh is not None:
-            # The error-feedback residual is dp-sharded (each device's
-            # own quantization error); gather before the process-0 gate.
-            from tpu_ddp.utils.checkpoint import gather_tree_to_host
-            comp_state = gather_tree_to_host(comp_state,
-                                             self._repl_sharding)
-        if self.mesh is not None and (self.is_zero or self.is_fsdp):
-            # ZeRO/FSDP shard state over dp; gather to host LEAF BY LEAF
-            # before the process-0 gate (each gather is a collective
-            # every process must enter; per-leaf keeps the device-memory
-            # peak at one replicated leaf, not the whole state tree).
-            from tpu_ddp.utils.checkpoint import gather_tree_to_host
-            opt_state = gather_tree_to_host(opt_state,
-                                            self._repl_sharding)
-            if self.is_fsdp:
-                params = gather_tree_to_host(params, self._repl_sharding)
-        if jax.process_index() != 0:
-            return None
+        synchronously on every process (inside state_to_host)."""
         # Checkpoints hold CANONICAL shapes, never the flat dp-padded
         # layout — so they restore at any dp size or into any strategy.
-        if self.is_zero:
-            opt_state = self.optimizer.canonicalize_opt_host(opt_state)
-        if self.is_fsdp:
-            params = self.zero3.unshard_host(params)
-            opt_state = self.zero3.canonicalize_opt_host(opt_state)
+        tree = self.state_to_host(state)
+        if jax.process_index() != 0:
+            return None
+        # The layout contract rides next to the checkpoints, so a
+        # restoring trainer of a different world size can check
+        # compatibility before touching the tensors.
+        self.sharding_plan().save(directory)
         from tpu_ddp.utils import checkpoint as ckpt
-        tree = {"params": params, "opt_state": opt_state,
-                "step": np.int64(state.step)}
-        if comp_state is not None:
-            # Saved ONLY when the compressor is stateful, so the plain
-            # layout stays byte-compatible with pre-compression
-            # checkpoints; restore tolerates either (reset on mismatch).
-            tree["comp_state"] = comp_state
         if background:
             if not hasattr(self, "_async_writer"):
                 self._async_writer = ckpt.AsyncCheckpointWriter()
@@ -399,8 +563,27 @@ class Trainer:
         (resilience/integrity.py) — so a host preempted mid-fsync costs
         one checkpoint interval, not the run. An explicit ``step``
         bypasses the fallback (you asked for THAT checkpoint; restore
-        still digest-verifies it and raises CheckpointCorruptError)."""
+        still digest-verifies it and raises CheckpointCorruptError).
+
+        Restore is routed through the saved :class:`ShardingPlan` when
+        one rides next to the checkpoints: the saving world's layout is
+        checked against this trainer's, and a strategy mismatch is
+        surfaced as an informational warning (canonical shapes restore
+        across strategies by design; the warning flags that the move
+        was cross-layout, not accidental)."""
         from tpu_ddp.utils import checkpoint as ckpt
+        from tpu_ddp.parallel.redistribute import ShardingPlan
+        saved_plan = ShardingPlan.load(directory)
+        if saved_plan is not None:
+            mine = self.sharding_plan()
+            if not saved_plan.compatible_with(mine):
+                import warnings
+                warnings.warn(
+                    f"checkpoint was written by layout "
+                    f"{saved_plan.strategy!r} {dict(saved_plan.mesh_axes)}"
+                    f"; restoring into {mine.strategy!r} "
+                    f"{dict(mine.mesh_axes)} via canonical shapes.",
+                    stacklevel=2)
         params_t = self._params_template()
         if self.is_zero:
             inner = self.optimizer.inner
@@ -456,22 +639,12 @@ class Trainer:
             except (KeyError, ValueError):
                 restored = _restore(template,
                                     drop_extra=("comp_state",))
-        params, opt_state = restored["params"], restored["opt_state"]
-        if self.is_zero:
-            opt_state = self.optimizer.flatten_opt(opt_state)
-        if self.is_fsdp:
-            params = self.zero3.shard_params(params)
-            opt_state = self.zero3.flatten_opt(opt_state)
-        if self.mesh is not None:
-            params = jax.device_put(params, self._param_put_sharding)
-            opt_state = jax.device_put(opt_state,
-                                       self._opt_shardings(opt_state))
+        host = {"params": restored["params"],
+                "opt_state": restored["opt_state"],
+                "step": restored["step"]}
         if comp_state is not None:
-            comp_state = jax.device_put(comp_state,
-                                        self._comp_shardings())
-        return TrainState(params=params, opt_state=opt_state,
-                          step=int(restored["step"]),
-                          comp_state=comp_state)
+            host["comp_state"] = comp_state
+        return self.state_from_host(host)
 
     # ---- train step ----------------------------------------------------
 
@@ -680,6 +853,17 @@ class Trainer:
         # (the pre-round-6 loop fetched loss and the skip flag
         # separately, two round-trips per iteration). ``loss`` keeps
         # its public per-replica shape for train_step's callers.
+        #
+        # Elastic runs give up input donation: when a peer dies
+        # mid-collective the step's OUTPUT buffers hold error events,
+        # so the only live state a survivor can carry across the
+        # membership change is the step's INPUT — which donation would
+        # have invalidated. One transient extra params+opt copy is the
+        # price of restart-free resharding (docs/DESIGN.md §17).
+        from tpu_ddp.resilience.elastic import elastic_env_active
+        keep_inputs = elastic_env_active()
+        don2 = () if keep_inputs else (0, 1)
+        don3 = () if keep_inputs else (0, 1, 2)
         if self.mesh is None:
             def base(params, opt_state, images, labels, weights):
                 params, opt_state, loss, skipped, _ = self._base_step(
@@ -687,7 +871,7 @@ class Trainer:
                 fused = jnp.stack([loss.astype(jnp.float32), skipped])
                 return params, opt_state, loss, fused
 
-            return jax.jit(base, donate_argnums=(0, 1))
+            return jax.jit(base, donate_argnums=don2)
 
         opt_spec = self._opt_spec()
         param_spec = self._param_spec()
@@ -713,7 +897,7 @@ class Trainer:
                            P(DATA_AXIS), P(DATA_AXIS)),
                 check_vma=False,
             )
-            return jax.jit(mapped, donate_argnums=(0, 1, 2))
+            return jax.jit(mapped, donate_argnums=don3)
 
         def sharded_body(params, opt_state, images, labels, weights):
             params, opt_state, loss, skipped, _ = self._base_step(
@@ -736,7 +920,7 @@ class Trainer:
             out_specs=(param_spec, opt_spec, P(DATA_AXIS), P(DATA_AXIS)),
             check_vma=False,
         )
-        return jax.jit(mapped, donate_argnums=(0, 1))
+        return jax.jit(mapped, donate_argnums=don2)
 
     def lower_train_step(self, state: TrainState, images, labels,
                          weights):
@@ -1003,6 +1187,39 @@ class Trainer:
 
     # ---- epoch loop (reference train_model, part1/main.py:52-93) -------
 
+    def _raise_membership_change(self, exc, elastic, state, epoch, it,
+                                 heartbeat, wait_s: float = 60.0):
+        """When a step died because a PEER died, convert the wreckage
+        into a :class:`~tpu_ddp.resilience.elastic.MembershipChange`.
+
+        A lost rank surfaces on survivors as an ``XlaRuntimeError`` from
+        the in-flight collective (gloo: "Connection closed by peer").
+        That alone does not prove a membership change — a genuinely
+        broken network should still crash — so this waits up to
+        ``wait_s`` for the launcher (or the departing rank itself) to
+        confirm one via the protocol directory, beating the heartbeat
+        meanwhile so the watchdog knows the survivor is alive. Confirmed
+        -> raise MembershipChange carrying ``state`` (the failed step's
+        INPUT, the last fully-materialized tree — see the no-donation
+        note in _build_train_step); unconfirmed -> return, and the
+        caller re-raises the original error."""
+        if elastic is None:
+            return
+        from jaxlib.xla_extension import XlaRuntimeError
+        if not isinstance(exc, XlaRuntimeError):
+            return
+        from tpu_ddp.resilience.elastic import MembershipChange
+        from tpu_ddp.resilience.watchdog import touch_heartbeat
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            if elastic.changed():
+                raise MembershipChange(
+                    membership=elastic.read(), state=state,
+                    epoch=epoch, next_iter=it) from exc
+            if heartbeat is not None:
+                touch_heartbeat(heartbeat[0], heartbeat[1], state.step)
+            time.sleep(0.1)
+
     def train_epoch(
         self,
         state: TrainState,
@@ -1030,8 +1247,15 @@ class Trainer:
                                               chaos_env_active)
         from tpu_ddp.resilience.watchdog import (heartbeat_from_env,
                                                  touch_heartbeat)
+        from tpu_ddp.resilience.elastic import ElasticController
         injector = FaultInjector.from_env()
         heartbeat = heartbeat_from_env()
+        # Elastic membership watch (resilience/elastic.py): a cheap
+        # mtime poll per iteration; on a membership epoch bump the loop
+        # drains its in-flight window and hands the LIVE state up via
+        # MembershipChange — parts/common.py rebuilds the world and
+        # resumes this epoch at ``next_iter``.
+        elastic = ElasticController.from_env()
         # K-steps-per-dispatch path (cfg.steps_per_dispatch > 1): groups
         # of K uniform batches run as ONE jitted scan (build_multi_step).
         # Anything that needs per-step host control forces the per-step
@@ -1042,7 +1266,8 @@ class Trainer:
         if (cfg.steps_per_dispatch > 1 and not cfg.ckpt_every_iters
                 and not cfg.check_replicas_every
                 and not cfg.device_prefetch
-                and not chaos_env_active()):
+                and not chaos_env_active()
+                and elastic is None):
             return self._train_epoch_multi(state, batches, timer,
                                            window, start_iter=start_iter,
                                            heartbeat=heartbeat)
@@ -1083,8 +1308,14 @@ class Trainer:
         collective_cadence = bool(
             (ckpt_dir and cfg.ckpt_every_iters)
             or (cfg.check_replicas_every and self.mesh is not None))
+        # Elastic membership also forces the synchronous window: a
+        # survivor of a mid-collective peer death can only carry the
+        # last FULLY-MATERIALIZED state across the reshard, and at
+        # depth 0 that is exactly the previous iteration's output
+        # (kept live by the no-donation elastic step build).
         depth = (0 if chaos_env_active()
                  or (collective_cadence and jax.process_count() > 1)
+                 or elastic is not None
                  else cfg.dispatch_depth)
         pipe = DispatchPipeline(depth)
 
@@ -1140,6 +1371,16 @@ class Trainer:
         for it, item in enumerate(stream, start=start_iter):
             if cfg.max_iters is not None and it >= cfg.max_iters:
                 break
+            if elastic is not None and elastic.changed():
+                # Batch `it` has been pulled but NOT trained on; the
+                # resumed epoch replays exactly from here. Drain first:
+                # every dispatched step must land in `state` (and the
+                # guard/loss window) before the world is torn down.
+                pipe.drain()
+                from tpu_ddp.resilience.elastic import MembershipChange
+                raise MembershipChange(
+                    membership=elastic.read(), state=state,
+                    epoch=epoch, next_iter=it)
             if injector.active:
                 # Pre-step faults for the step producing state.step + 1:
                 # nan-grad poisons THIS rank's shard of the batch (sync
@@ -1159,19 +1400,35 @@ class Trainer:
             # the synchronous baseline the depth sweep measures.
             sync_iter = depth == 0 or it <= cfg.timing_last_iter
             timer.start()
-            x, y, w = item if use_prefetch else self.put_batch(*item)
-            state, fused = self.train_step_async(state, x, y, w)
-            if sync_iter:
-                # Force completion before stopping the clock — the
-                # JAX-correct analogue of the reference's synchronous
-                # CPU timing.
-                jax.block_until_ready(fused)
-            timer.stop(it)
-            pipe.submit(
-                fused,
-                lambda f, i=it, s=state.step: on_harvest(
-                    i, s, self._materialize_fused(f)),
-                sync=sync_iter)
+            # A second live reference to the step's input would defeat
+            # buffer donation (the runtime copies a donated buffer that
+            # is still referenced elsewhere); only the elastic path —
+            # whose steps are built non-donating — carries it.
+            prev_state = state if elastic is not None else None
+            try:
+                x, y, w = item if use_prefetch else self.put_batch(*item)
+                state, fused = self.train_step_async(state, x, y, w)
+                if sync_iter:
+                    # Force completion before stopping the clock — the
+                    # JAX-correct analogue of the reference's synchronous
+                    # CPU timing.
+                    jax.block_until_ready(fused)
+                timer.stop(it)
+                pipe.submit(
+                    fused,
+                    lambda f, i=it, s=state.step: on_harvest(
+                        i, s, self._materialize_fused(f)),
+                    sync=sync_iter)
+            except Exception as e:  # noqa: BLE001 — filtered below
+                # A peer dying mid-collective surfaces HERE (the gloo
+                # all-reduce fails on the survivor), usually before the
+                # loop-top membership poll can see the departure note.
+                # If the launcher confirms a membership change, this
+                # step never happened: hand up the last materialized
+                # state and replay batch `it` after the reshard.
+                self._raise_membership_change(
+                    e, elastic, prev_state, epoch, it, heartbeat)
+                raise
         pipe.drain()
         return state, window.epoch_stats(pipeline=pipe.stats())
 

@@ -166,6 +166,30 @@ class _MeshTrainer:
         p = jax.process_count()
         return local_b * (min(p, shard_ways) if shard_ways else p)
 
+    def sharding_plan(self):
+        """The serializable layout contract of this trainer — per-tree
+        PartitionSpecs plus the mesh axis sizes they were built against
+        (tpu_ddp/parallel/redistribute.py). The strategy string encodes
+        the layout-changing switches (fsdp/zero) so two trainers whose
+        flat layouts differ can never be declared compatible by spec
+        coincidence."""
+        from tpu_ddp.parallel.redistribute import ShardingPlan
+        strategy = type(self).__name__.lower()
+        if getattr(self, "is_fsdp", False):
+            strategy += "+fsdp"
+        if getattr(self, "opt_zero2", False):
+            strategy += "+zero2"
+        elif getattr(self, "opt_zero1", False):
+            strategy += "+zero1"
+        return ShardingPlan(
+            strategy=strategy,
+            mesh_axes=tuple((str(n), int(s))
+                            for n, s in self.mesh.shape.items()),
+            param_specs=self._param_specs,
+            opt_specs=self._opt_specs,
+            comp_specs=None,
+            batch_spec=P((DATA_AXIS, EXPERT_AXIS), SEQ_AXIS))
+
     # ---- checkpoint / resume (no reference equivalent, SURVEY.md §5) ---
 
     def save_checkpoint(self, directory: str, state: LMTrainState,
@@ -191,6 +215,9 @@ class _MeshTrainer:
             opt_state = self.optimizer.canonicalize_opt_host(opt_state)
         tree = {"params": params, "opt_state": opt_state,
                 "step": np.int64(state.step)}
+        # The layout contract rides next to the steps: a restore onto a
+        # different world can check compatibility before touching bytes.
+        self.sharding_plan().save(directory)
         if background:
             # Gathers above already ran synchronously (collectives);
             # only serialization + I/O move off-thread.

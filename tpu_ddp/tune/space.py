@@ -117,6 +117,14 @@ KNOBS: tuple[Knob, ...] = (
          doc="registered for the audit (field<->env agreement) but "
              "never searched: batch size is a training hyperparameter, "
              "not a schedule knob"),
+    Knob("elastic_reshard", "elastic_reshard",
+         "TPU_DDP_ELASTIC_RESHARD", values=(),
+         flag="--elastic-reshard",
+         doc="registered for the audit (field<->env<->flag agreement) "
+             "but never searched: live membership resharding "
+             "(resilience/elastic.py) is a robustness mode, not a "
+             "schedule knob — turning it on cannot change steady-state "
+             "step time"),
 )
 
 # Model-level knobs are baked into get_model() before the Trainer ever

@@ -138,6 +138,14 @@ class TrainConfig:
     # TrainingDivergedError (the elastic layer then rolls back to the
     # last checkpoint); env TPU_DDP_GUARD_MAX_BAD.
     guard_max_bad_steps: int = 3
+    # Elastic membership (tpu_ddp/resilience/elastic.py): on a rank
+    # loss/stall/rejoin, survivors reshard their LIVE TrainState onto a
+    # rebuilt mesh (parallel/redistribute.py) instead of the cluster
+    # dying into restart-from-checkpoint. Workers only act on it when
+    # the launcher also provides the protocol directory
+    # (TPU_DDP_ELASTIC_DIR). Env: TPU_DDP_ELASTIC_RESHARD; launch flag
+    # --elastic-reshard.
+    elastic_reshard: bool = False
 
     def __post_init__(self):
         if self.max_iters is None:
@@ -206,6 +214,8 @@ class TrainConfig:
         env_gb = os.environ.get("TPU_DDP_GUARD_MAX_BAD")
         if env_gb:
             self.guard_max_bad_steps = int(env_gb)
+        self.elastic_reshard = _env_bool("TPU_DDP_ELASTIC_RESHARD",
+                                         self.elastic_reshard)
         env_rm = os.environ.get("TPU_DDP_REMAT")
         if env_rm:
             self.remat = env_rm
