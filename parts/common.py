@@ -18,6 +18,10 @@ matmul dtype (f32 runs for drift measurement),
 ``TPU_DDP_STEPS_PER_DISPATCH`` groups K optimizer steps per dispatch,
 ``TPU_DDP_DISPATCH_DEPTH`` sizes the engine's async dispatch window
 (0 = fully synchronous loop; docs/DESIGN.md §13),
+``TPU_DDP_OVERLAP=1`` buckets the gradients (``TPU_DDP_BUCKET_MB`` MiB
+per bucket) and issues each bucket's collective from inside the
+backward pass with the sharded weight update on the all_reduce/fused
+rungs (tpu_ddp/parallel/overlap.py; docs/DESIGN.md §18),
 and ``TPU_DDP_SHARD_EVAL=1`` opts into the process-sharded dp-psum'd
 evaluation (CIFAR path).
 """
@@ -231,9 +235,14 @@ def run_part(part: str, argv=None):
     else:
         state = trainer.init_state()
 
+    overlap_note = ""
+    if getattr(trainer, "_overlap_active", False):
+        d = trainer._overlap.describe()
+        overlap_note = (f" overlap={d['n_buckets']}x{cfg.bucket_mb}MiB"
+                        f"{'+sharded-update' if d['sharded_update'] else ''}")
     print(f"[{part}] strategy={PART_TO_STRATEGY[part]} world_size={world_size} "
           f"rank={rank} dp_slots={dp_size} per-node batch={batch_size} "
-          f"platform={jax.devices()[0].platform}")
+          f"platform={jax.devices()[0].platform}{overlap_note}")
 
     epoch = start_epoch
     pending_iter = start_iter

@@ -640,6 +640,17 @@ def main(argv=None) -> int:
                         "stores between forward and backward; stage "
                         "arithmetic stays in compute_dtype. Sets "
                         "TPU_DDP_ACT_DTYPE for every rank")
+    p.add_argument("--overlap", action="store_true",
+                   help="bucketize gradients in reverse-autodiff order "
+                        "and issue each bucket's collective from inside "
+                        "the backward pass (torch DDP's reducer; "
+                        "tpu_ddp/parallel/overlap.py), with the sharded "
+                        "weight update on the all_reduce/fused rungs. "
+                        "Sets TPU_DDP_OVERLAP for every rank")
+    p.add_argument("--bucket-mb", type=int, default=None,
+                   help="bucket payload target in MiB for --overlap "
+                        "(torch DDP's bucket_cap_mb; default 25). Sets "
+                        "TPU_DDP_BUCKET_MB for every rank")
     p.add_argument("--elastic-reshard", action="store_true",
                    help="on membership change (a rank lost, stalled, "
                         "or rejoining) reshard the survivors' LIVE "
@@ -674,6 +685,12 @@ def main(argv=None) -> int:
         env["TPU_DDP_ACT_DTYPE"] = args.act_dtype
     if args.autotune is not None:
         env["TPU_DDP_AUTOTUNE"] = args.autotune
+    if args.overlap:
+        env["TPU_DDP_OVERLAP"] = "1"
+    if args.bucket_mb is not None:
+        if args.bucket_mb <= 0:
+            p.error(f"--bucket-mb must be > 0, got {args.bucket_mb}")
+        env["TPU_DDP_BUCKET_MB"] = str(args.bucket_mb)
     if args.elastic_reshard:
         env["TPU_DDP_ELASTIC_RESHARD"] = "1"
     env = env or None

@@ -22,7 +22,16 @@ fused      part3 ``DDP(model)`` (part3/main.py:174): bucketed     one tree-level
                                                                   collective with the rest of
                                                                   the backward pass (the
                                                                   idiomatic analogue of
-                                                                  bucketing, SURVEY §2 N2)
+                                                                  bucketing, SURVEY §2 N2).
+                                                                  With ``--overlap`` the
+                                                                  reducer's mechanics are
+                                                                  *reproduced* explicitly:
+                                                                  ``parallel/overlap.py``
+                                                                  builds size-targeted
+                                                                  buckets in reverse-autodiff
+                                                                  order and issues one
+                                                                  collective per bucket
+                                                                  mid-backward (DESIGN §18)
 =========  =====================================================  ==========================
 
 All strategies are pure functions ``(grads, axis_name) -> grads`` applied
@@ -87,7 +96,13 @@ def sync_fused(grads, axis_name):
     step. XLA sees the whole backward + collective dataflow and overlaps the
     ICI all-reduce with remaining backward compute, which is what torch DDP's
     25 MB bucketing + autograd hooks achieve by hand (reference
-    part3/main.py:13,174; SURVEY.md §2 row N2)."""
+    part3/main.py:13,174; SURVEY.md §2 row N2).
+
+    When the ``overlap`` knob is on, the engine bypasses this hook entirely:
+    ``parallel/overlap.py`` reproduces the reducer literally — 25 MB (default)
+    size-targeted buckets in reverse-autodiff order, one collective per bucket
+    issued mid-backward via custom_vjp taps — instead of delegating the
+    overlap to XLA's scheduler (DESIGN.md §18)."""
     return lax.pmean(grads, axis_name)
 
 

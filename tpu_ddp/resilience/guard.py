@@ -38,7 +38,8 @@ class TrainingDivergedError(RuntimeError):
     """
 
 
-def nonfinite_flag(loss, grads, axis_name: str | None = None):
+def nonfinite_flag(loss, grads, axis_name: str | None = None,
+                   extra_bad=None):
     """jit-side: True iff this step's update must be skipped.
 
     Checks the (local) loss and the summed squared gradient norm — an
@@ -47,11 +48,21 @@ def nonfinite_flag(loss, grads, axis_name: str | None = None):
     scalar psum) so every replica takes the same branch; without it the
     decision is local (single device, or the 'none' rung whose semantics
     are no cross-replica communication).
+
+    ``extra_bad`` is an optional local scalar count of badness observed
+    UPSTREAM of ``grads`` — the overlapped int8 path passes its
+    raw-gradient nonfinite count here, because a NaN can vanish through
+    the int8 cast before the synced grads this function sees
+    (parallel/overlap.py; same reason engine.py's unbucketed compressed
+    path guards pre-compression gradients).
     """
     gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
               for g in jax.tree.leaves(grads))
     bad = jnp.logical_not(jnp.isfinite(jnp.asarray(loss, jnp.float32))
                           & jnp.isfinite(gsq))
+    if extra_bad is not None:
+        bad = jnp.logical_or(
+            bad, jnp.asarray(extra_bad, jnp.float32) > 0.0)
     if axis_name is not None:
         bad = lax.psum(bad.astype(jnp.float32), axis_name) > 0.0
     return bad

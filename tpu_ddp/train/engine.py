@@ -254,6 +254,29 @@ class Trainer:
         else:
             self._comp_template = None
             self._comp_specs = None
+        # Overlapped bucketized collectives (parallel/overlap.py):
+        # torch DDP's reducer — per-bucket collectives issued from
+        # inside the backward — plus the 2004.13336 sharded weight
+        # update on the all_reduce/fused rungs. Needs a dp>1 mesh and a
+        # replicated syncing rung (ZeRO/FSDP already interleave their
+        # collectives naturally; 'none' has nothing to overlap), so the
+        # knob degrades with a warning otherwise — the compression
+        # contract above.
+        self._overlap_active = (
+            getattr(self.config, "overlap", False) and mesh is not None
+            and self._dp > 1 and canon in REPLICATED_KINDS)
+        if getattr(self.config, "overlap", False) \
+                and not self._overlap_active:
+            import warnings
+            warnings.warn(
+                "overlap=True needs a dp>1 mesh and a replicated "
+                f"syncing rung (got strategy={strategy!r}, "
+                f"dp={self._dp}); bucketed overlap disabled.",
+                stacklevel=2)
+        self._overlap = None
+        self._sharded_update = None
+        if self._overlap_active:
+            self._build_overlap()
         if mesh is not None:
             self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
             self._repl_sharding = NamedSharding(mesh, P())
@@ -269,12 +292,32 @@ class Trainer:
         """Abstract canonical-shape params tree (no compute)."""
         return jax.eval_shape(lambda: self.model.init(jax.random.key(0)))
 
+    def _build_overlap(self):
+        """(Re)build the bucket plan + overlap/sharded-update wrappers
+        against the current mesh size (construction and rebind_mesh)."""
+        from tpu_ddp.parallel.overlap import (SCATTER_KINDS, BucketPlan,
+                                              OverlapSync, ShardedUpdate)
+        canon = canonical_strategy(self.strategy_name)
+        plan = BucketPlan(self._params_template(),
+                          self.config.bucket_mb)
+        self._overlap = OverlapSync(
+            plan, canon, DATA_AXIS, self._dp,
+            compressor=self.compressor if self._comp_active else None)
+        # all_reduce/fused produce a scattered reduction, so the
+        # optimizer runs on 1/N payload shards; gather_scatter keeps
+        # its root-mean semantics and a replicated update.
+        self._sharded_update = (
+            ShardedUpdate(self.optimizer, plan, DATA_AXIS, self._dp)
+            if canon in SCATTER_KINDS else None)
+
     def _opt_spec(self):
         """shard_map prefix spec for the optimizer state: replicated for
-        the replicated strategies, dp-sharded flat leaves under ZeRO and
-        FSDP."""
+        the replicated strategies, dp-sharded flat leaves under ZeRO,
+        FSDP and the overlapped sharded update."""
         if self.is_fsdp:
             return self.zero3.state_specs()
+        if self._sharded_update is not None:
+            return self._sharded_update.state_specs()
         return self.optimizer.state_specs(P())
 
     def _param_spec(self):
@@ -301,6 +344,8 @@ class Trainer:
         if self.is_fsdp:
             params = self.zero3.shard_params(params)
             opt_state = self.zero3.init(params)
+        elif self._sharded_update is not None:
+            opt_state = self._sharded_update.init(params)
         else:
             opt_state = self.optimizer.init(params)
         if self.mesh is not None:
@@ -367,11 +412,14 @@ class Trainer:
         params = state.params
         opt_state = state.opt_state
         comp_state = state.comp_state
-        if local_only and multiproc and (self.is_zero or self.is_fsdp):
+        if local_only and multiproc and (self.is_zero or self.is_fsdp
+                                         or self._sharded_update
+                                         is not None):
             raise RuntimeError(
-                "live state of a cross-process ZeRO/FSDP run cannot be "
-                "snapshotted without the lost peer's shards; this "
-                "membership change needs a checkpoint restart")
+                "live state of a cross-process ZeRO/FSDP/sharded-update "
+                "run cannot be snapshotted without the lost peer's "
+                "shards; this membership change needs a checkpoint "
+                "restart")
         if comp_state is not None and self.mesh is not None:
             if local_only and multiproc:
                 comp_state = None
@@ -381,7 +429,9 @@ class Trainer:
                 from tpu_ddp.utils.checkpoint import gather_tree_to_host
                 comp_state = gather_tree_to_host(comp_state,
                                                  self._repl_sharding)
-        if self.mesh is not None and (self.is_zero or self.is_fsdp):
+        if self.mesh is not None and (self.is_zero or self.is_fsdp
+                                      or self._sharded_update
+                                      is not None):
             from tpu_ddp.utils.checkpoint import gather_tree_to_host
             opt_state = gather_tree_to_host(opt_state,
                                             self._repl_sharding)
@@ -393,6 +443,9 @@ class Trainer:
         if self.is_fsdp:
             params = self.zero3.unshard_host(params)
             opt_state = self.zero3.canonicalize_opt_host(opt_state)
+        if self._sharded_update is not None:
+            opt_state = self._sharded_update.canonicalize_opt_host(
+                opt_state)
         to_np = lambda t: jax.tree.map(np.asarray, t)
         tree = {"params": to_np(params), "opt_state": to_np(opt_state),
                 "step": np.int64(state.step)}
@@ -415,6 +468,8 @@ class Trainer:
         if self.is_fsdp:
             params = self.zero3.shard_params(params)
             opt_state = self.zero3.flatten_opt(opt_state)
+        if self._sharded_update is not None:
+            opt_state = self._sharded_update.flatten_opt(opt_state)
         if self.mesh is not None:
             params = jax.device_put(
                 params,
@@ -500,6 +555,20 @@ class Trainer:
                 self._params_template(), self._dp, abstract=True)
             self._comp_specs = self.compressor.state_specs(
                 self._comp_template)
+        if self._overlap_active and (mesh is None or self._dp < 2):
+            # Bucketed overlap needs a dp>1 collective; a world shrunk
+            # to one data shard degrades to the unbucketed path (same
+            # contract as construction-time). Safe mid-run: rebinds are
+            # bracketed by the state_to_host/state_from_host canonical
+            # round-trip, which re-lays-out the optimizer state.
+            import warnings
+            warnings.warn(
+                "mesh rebind left dp=1; bucketed overlap disabled.",
+                stacklevel=2)
+            self._overlap_active = False
+            self._overlap = self._sharded_update = None
+        elif self._overlap_active:
+            self._build_overlap()
         if mesh is not None:
             self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
             self._repl_sharding = NamedSharding(mesh, P())
@@ -688,17 +757,22 @@ class Trainer:
         local_mean = wsum / jnp.maximum(n_local, 1.0)
         return loss_for_grad, local_mean
 
-    def _guarded_apply(self, params, opt_state, loss, grads, apply_fn):
+    def _guarded_apply(self, params, opt_state, loss, grads, apply_fn,
+                       extra_bad=None):
         """Run ``apply_fn() -> (new_params, new_opt)`` under the step
         guard: a non-finite loss/grad-norm selects the OLD state back
         (momentum included — the bad step is an exact no-op) and raises
         the jit-side ``skipped`` flag. A healthy step is bit-identical
         to an unguarded one (``where`` on a false predicate is the
-        identity). With the guard disabled, just applies."""
+        identity). With the guard disabled, just applies. ``extra_bad``
+        forwards an upstream badness count to the flag (the overlapped
+        int8 path's raw-gradient nonfinite count — see
+        resilience/guard.py:nonfinite_flag)."""
         if self.guard is None:
             new_params, new_opt = apply_fn()
             return new_params, new_opt, jnp.zeros((), jnp.float32)
-        bad = nonfinite_flag(loss, grads, self._guard_axis)
+        bad = nonfinite_flag(loss, grads, self._guard_axis,
+                             extra_bad=extra_bad)
         new_params, new_opt = apply_fn()
         return (select_update(bad, params, new_params),
                 select_update(bad, opt_state, new_opt),
@@ -707,6 +781,10 @@ class Trainer:
     def _base_step(self, params, opt_state, images, labels, weights,
                    comp=None):
         images = self._maybe_normalize(images)
+
+        if self._overlap_active:
+            return self._overlap_step(params, opt_state, images, labels,
+                                      weights, comp)
 
         if self.is_fsdp:
             if self._comp_active:
@@ -801,6 +879,61 @@ class Trainer:
         params, opt_state, skipped = self._guarded_apply(
             params, opt_state, loss, guard_grads,
             lambda: self.optimizer.apply(params, grads, opt_state))
+        new_comp = self._comp_rollback(skipped, comp, new_comp)
+        return params, opt_state, loss, skipped, new_comp
+
+    def _overlap_step(self, params, opt_state, images, labels, weights,
+                      comp):
+        """Replicated rungs with bucketed in-backward sync
+        (parallel/overlap.py): the taps' backward rules ARE the sync, so
+        no sync_fn runs here. gather_scatter yields full root-mean
+        grads and a replicated update; all_reduce/fused yield a
+        scattered reduction finished by the sharded update (their
+        distinction — per-leaf vs tree-level all-reduce — is about HOW
+        the unbucketed collective is issued, which bucketing replaces,
+        so under overlap the two rungs compile to the same program).
+
+        Guard semantics: the flag psum (nonfinite_flag) sees every
+        device's slice of the synced grads, so a NaN anywhere raises it
+        on all replicas even though the scattered layout gives each
+        device only its chunk; ``extra_bad`` carries the int8 path's
+        raw-gradient nonfinite count, which the quantization cast would
+        otherwise hide. A skipped step rolls back the compression carry
+        exactly like the unbucketed path."""
+
+        def loss_fn(p):
+            return self._loss_terms(self.model.apply(p, images),
+                                    labels, weights)
+
+        loss, grads, new_comp, extra_bad = self._overlap.value_and_grad(
+            loss_fn, params, comp)
+        if self._sharded_update is not None:
+            # Clip (if any) happens on the update's payload slices —
+            # the chunks tile the mean exactly once across devices, so
+            # a psum of slice squared-sums is the exact global norm
+            # (ZeRO-1's argument).
+            params, opt_state, skipped = self._guarded_apply(
+                params, opt_state, loss, grads,
+                lambda: self._sharded_update.apply_scattered(
+                    params, grads, opt_state,
+                    clip_norm=self.clip_grad_norm),
+                extra_bad=extra_bad)
+        else:
+            # The guard must see PRE-clip grads: an inf norm clips the
+            # gradient to zeros, hiding itself from the post-clip check.
+            guard_grads = grads
+            if self.clip_grad_norm is not None:
+                # Root-mean grads are replicated: local norm == global.
+                from tpu_ddp.ops.optim import (clip_scale_from_sq,
+                                               clip_tree)
+                sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in jax.tree.leaves(grads))
+                grads = clip_tree(
+                    grads, clip_scale_from_sq(sq, self.clip_grad_norm))
+            params, opt_state, skipped = self._guarded_apply(
+                params, opt_state, loss, guard_grads,
+                lambda: self.optimizer.apply(params, grads, opt_state),
+                extra_bad=extra_bad)
         new_comp = self._comp_rollback(skipped, comp, new_comp)
         return params, opt_state, loss, skipped, new_comp
 

@@ -87,6 +87,17 @@ KNOBS: tuple[Knob, ...] = (
          doc="gradient wire format on the sync collectives "
              "(parallel/compress.py; int8-noef is an ablation, not a "
              "candidate)"),
+    Knob("overlap", "overlap", "TPU_DDP_OVERLAP",
+         values=(False, True), flag="--overlap",
+         doc="bucketed in-backward gradient collectives + sharded "
+             "weight update (parallel/overlap.py); numerics equivalent "
+             "to the unbucketed rung up to reduction order, so "
+             "searchable by default"),
+    Knob("bucket_mb", "bucket_mb", "TPU_DDP_BUCKET_MB",
+         values=(1, 4, 25), flag="--bucket-mb",
+         doc="bucket payload target in MiB for overlap (torch DDP's "
+             "bucket_cap_mb=25 default); smaller buckets start "
+             "communicating earlier but amortize less per collective"),
     Knob("pallas_sgd", "pallas_sgd", "TPU_DDP_PALLAS_SGD",
          values=(False, True),
          doc="fused Pallas SGD momentum update kernel (TPU only)"),
@@ -208,6 +219,18 @@ def violations(assignment: Mapping, ctx: Workload) -> list[str]:
                 f"dp>1 mesh and a syncing rung (dp={ctx.dp}, "
                 f"strategy={ctx.strategy!r}) — Trainer degrades it to "
                 "'none' (DESIGN.md §14)")
+    if get("overlap", False) and (ctx.dp <= 1 or ctx.strategy not in
+                                  ("gather_scatter", "all_reduce",
+                                   "fused")):
+        bad.append(
+            f"overlap=True requires a dp>1 mesh and a replicated "
+            f"syncing rung (dp={ctx.dp}, strategy={ctx.strategy!r}) — "
+            "Trainer degrades it to the unbucketed path "
+            "(train/engine.py)")
+    if get("bucket_mb", 25) != 25 and not get("overlap", False):
+        bad.append(
+            "bucket_mb is only read by the overlapped path — without "
+            "overlap=True this cell duplicates the default")
     if get("dispatch_depth", 0) and ctx.processes > 1 \
             and ctx.collective_cadence:
         bad.append(

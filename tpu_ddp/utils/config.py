@@ -93,6 +93,21 @@ class TrainConfig:
     # strategy; degrades to "none" with a warning otherwise.
     grad_compress: str = "none"
 
+    # Overlapped bucketized gradient collectives
+    # (tpu_ddp/parallel/overlap.py): partition the gradient pytree into
+    # ~bucket_mb-MiB buckets in reverse-autodiff order and issue each
+    # bucket's collective from INSIDE the backward pass (torch DDP's
+    # reducer, reference part3/main.py:174), with the 2004.13336-style
+    # sharded weight update on the all_reduce/fused rungs. Requires a
+    # dp>1 mesh and a replicated syncing rung; degrades to the
+    # unbucketed path with a warning otherwise. Env: TPU_DDP_OVERLAP;
+    # launch flag --overlap.
+    overlap: bool = False
+    # Bucket payload target in MiB (torch DDP's bucket_cap_mb; default
+    # matches its 25). Only meaningful with overlap on. Env:
+    # TPU_DDP_BUCKET_MB; launch flag --bucket-mb.
+    bucket_mb: int = 25
+
     # Memory policy (tpu_ddp/memory/): activation rematerialization.
     # Which model stages recompute in the backward pass instead of
     # saving their interior activations to HBM — "none" (save
@@ -216,6 +231,14 @@ class TrainConfig:
             self.guard_max_bad_steps = int(env_gb)
         self.elastic_reshard = _env_bool("TPU_DDP_ELASTIC_RESHARD",
                                          self.elastic_reshard)
+        self.overlap = _env_bool("TPU_DDP_OVERLAP", self.overlap)
+        env_bm = os.environ.get("TPU_DDP_BUCKET_MB")
+        if env_bm:
+            self.bucket_mb = int(env_bm)
+        if self.bucket_mb <= 0:
+            raise ValueError(
+                f"bucket_mb must be > 0, got {self.bucket_mb} "
+                "(TPU_DDP_BUCKET_MB)")
         env_rm = os.environ.get("TPU_DDP_REMAT")
         if env_rm:
             self.remat = env_rm
