@@ -86,6 +86,34 @@ def test_memory_policy_knobs_registered():
     assert set(act.values) == {"compute", "bf16", "f32"}
 
 
+def test_serve_knobs_registered_under_goodput_objective():
+    # The serving knobs (tpu_ddp/serve/) carry the same 4-surface
+    # contract minus the launch flag (serving is not a launch.py
+    # concern), and live under objective="goodput" so the training
+    # autotuner's step_time search never wanders into them — and the
+    # serve sweep's goodput search gets exactly them.
+    from tpu_ddp.tune.space import Workload, searchable_knobs
+    from tpu_ddp.utils.config import TrainConfig
+
+    fields = {"serve_slots", "serve_block_size", "serve_prefill_chunk",
+              "serve_cache_dtype"}
+    for f in fields:
+        k = knob_by_field(f)
+        assert k is not None and k.objective == "goodput", f
+    assert knob_by_field("serve_block_size").env == "TPU_DDP_SERVE_BLOCK"
+    # Cache dtype changes numerics -> semantic, like act_dtype; the
+    # pure-scheduling knobs must not be.
+    assert knob_by_field("serve_cache_dtype").semantic
+    assert not knob_by_field("serve_slots").semantic
+    cfg, ctx = TrainConfig(), Workload(platform="cpu")
+    good = {k.field for k, _ in
+            searchable_knobs(cfg, ctx, objective="goodput",
+                             include_semantic=True)}
+    assert good == fields
+    step = {k.field for k, _ in searchable_knobs(cfg, ctx)}
+    assert not (step & fields)
+
+
 def test_reverse_check_catches_unregistered_remat_env():
     # Drop the remat entry: config.py still parses TPU_DDP_REMAT, so
     # the reverse sweep must flag the knob living outside the space.

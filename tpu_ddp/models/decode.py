@@ -1,0 +1,189 @@
+"""Shared KV-cache decode core — ONE home for the incremental-attention
+math, used by both :func:`tpu_ddp.models.generate.generate` (batch
+offline sampling) and the continuous-batching serving engine
+(tpu_ddp/serve/). The two callers differ only in cache LAYOUT (one
+contiguous ``(B, max_len, KV, hd)`` buffer per block vs the serve
+engine's block-paged pool, tpu_ddp/serve/kv_pool.py); the projection,
+attention, and MLP math is these functions, so "the engine decodes the
+same distribution the trainer optimized" is a property of one module,
+tested once (tests/test_generate.py exactness vs ``apply``,
+tests/test_serve.py engine-vs-generate parity).
+
+Position handling is the one generalization over the original
+``generate.py`` internals: :func:`attend_cached` accepts per-batch-row
+query positions ``(B, Lq)`` in addition to the shared ``(Lq,)`` form,
+because under continuous batching every live sequence sits at its own
+offset (models/transformer.py ``rope`` accepts the same two forms).
+The ``(Lq,)`` path traces the exact pre-refactor program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_ddp.models.transformer import layer_norm
+
+_NEG_INF = -1e30
+
+
+def check_decodable(model) -> None:
+    """Refuse model configs the decode path cannot serve. Sharded
+    (sp/tp/ep) configs hold parameters in training layouts — the
+    checkpoint is canonical, so materialize dense serving params first
+    (:func:`dense_params_from_checkpoint` is the one-call path)."""
+    if model.sp_axis is not None or model.tp_axis is not None \
+            or model.ep_axis is not None:
+        raise ValueError(
+            "decode runs dense single-device models; drop the sp/tp/ep "
+            "configuration and load the training checkpoint into a "
+            "dense model — dense_params_from_checkpoint(model, ckpt_dir)"
+            " (tpu_ddp/models/decode.py) does exactly that via the "
+            "canonical checkpoint path")
+    if model.moe_experts:
+        # Incremental decode cannot reproduce training-time MoE routing:
+        # capacity competition is over ALL positions in apply() but only
+        # over the new tokens per decode step, so the distributions
+        # diverge. Refusing keeps the exactness guarantee honest.
+        raise ValueError("decode does not support MoE models: "
+                         "per-step expert capacity cannot match "
+                         "apply()'s whole-sequence slot competition")
+
+
+def mlp(model, blk, y):
+    cd = model.compute_dtype
+    y = jnp.dot(y, blk["w1"].astype(cd),
+                preferred_element_type=jnp.float32)
+    y = jax.nn.gelu(y.astype(jnp.float32)).astype(cd)
+    return jnp.dot(y, blk["w2"].astype(cd),
+                   preferred_element_type=jnp.float32).astype(cd)
+
+
+def attend_cached(model, q, ck, cv, q_pos):
+    """q: (B, Lq, H, hd) at absolute positions ``q_pos`` — (Lq,) shared
+    across the batch, or (B, Lq) per row (continuous batching); ck/cv:
+    full (B, S, KV, hd) cache views. Attends each query over cache
+    positions <= its own — the causal mask also covers not-yet-written
+    (or stale, for the paged pool) slots: their positions exceed every
+    live query's, and the masked ``exp(-1e30 - max)`` underflows to an
+    exact 0 weight, so garbage beyond the live length can never leak
+    into the output. Under GQA the grouped einsum contracts Q heads
+    (B, Lq, KV, G, hd) directly against the KV-width cache — the
+    expansion is never materialized, preserving the smaller cache's
+    bandwidth win (decode is KV-read-bound)."""
+    scale = 1.0 / (model.head_dim ** 0.5)
+    b, lq, h, hd = q.shape
+    kv = ck.shape[2]
+    qg = q.reshape(b, lq, kv, h // kv, hd)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, ck,
+                        preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(ck.shape[1])
+    q_pos = jnp.asarray(q_pos)
+    qp = q_pos if q_pos.ndim == 2 else q_pos[None]
+    mask = k_pos[None, None, None, None, :] \
+        > qp[:, None, None, :, None]
+    scores = jnp.where(mask, _NEG_INF, scores)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", p, cv.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, lq, h, hd).astype(q.dtype)
+
+
+def project_qkv(model, blk, x, pos):
+    """Pre-attention half of a block: LN1 + the training-path QKV
+    projection with RoPE at ``pos`` ((L,) or (B, L)). The caller owns
+    writing k/v into ITS cache layout before attending."""
+    y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+    return model.qkv_proj(blk, y, pos)
+
+
+def block_finish(model, blk, x, o):
+    """Post-attention half of a block: output projection + residual,
+    LN2 + MLP + residual. (B, L, dm) -> (B, L, dm)."""
+    cd = model.compute_dtype
+    b, L = x.shape[0], x.shape[1]
+    wo = blk["wo"].astype(cd).reshape(-1, model.d_model)
+    o = jnp.dot(o.reshape(b, L, -1), wo,
+                preferred_element_type=jnp.float32).astype(cd)
+    x = x + o
+    y = layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+    return x + mlp(model, blk, y)
+
+
+def forward_cached(model, params, tokens, caches, start: int):
+    """Run ``tokens`` (B, L) occupying absolute positions
+    ``start..start+L-1`` against (and updating) contiguous
+    (B, max_len, KV, hd) caches. Returns (last-position logits (B, V),
+    new caches). The ``generate()`` path; the serve engine's paged
+    twin (tpu_ddp/serve/engine.py) is the same project/attend/finish
+    sequence over pool-gathered cache views."""
+    cd = model.compute_dtype
+    b, L = tokens.shape
+    pos = start + jnp.arange(L)
+    x = params["embed"][tokens].astype(cd)
+    new_caches = []
+    for blk, (ck, cv) in zip(params["blocks"], caches):
+        q, k, v = project_qkv(model, blk, x, pos)
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (0, start, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (0, start, 0, 0))
+        o = attend_cached(model, q, ck, cv, pos)
+        x = block_finish(model, blk, x, o)
+        new_caches.append((ck, cv))
+    logits = model.head_apply(params, x[:, -1:])[:, 0]
+    return logits, tuple(new_caches)
+
+
+def init_cache(model, batch: int, max_len: int):
+    """Per-block (K, V) buffers: (B, max_len, KV, hd) each — under GQA
+    the cache is num_heads/num_kv_heads times smaller than MHA's, the
+    scheme's reason to exist (decode is KV-cache-bandwidth-bound)."""
+    shape = (batch, max_len, model.kv_heads, model.head_dim)
+    zeros = jnp.zeros(shape, model.compute_dtype)
+    return tuple((zeros, zeros) for _ in range(model.num_layers))
+
+
+def sample_token(model, logits, temperature, seed, position):
+    """The ONE sampling rule for serving: greedy argmax at
+    ``temperature == 0``, else categorical at the given temperature,
+    keyed deterministically by (per-request ``seed``, the sequence
+    ``position`` the sampled token will occupy) — stateless, so a
+    retried or resumed request re-samples identically. Returns
+    (token, logprob-of-token), both scalars; vmap over the live batch
+    for the continuous-batching step."""
+    key = jax.random.fold_in(jax.random.key(seed), position)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    tok = jnp.where(temperature > 0, sampled, greedy)
+    logprob = jax.nn.log_softmax(logits.astype(jnp.float32))[tok]
+    return tok, logprob
+
+
+def dense_params_from_checkpoint(model, directory: str,
+                                 step: int | None = None):
+    """Sharded-training-checkpoint -> dense serving params, one call.
+
+    Checkpoints are written in CANONICAL (dense, global) shapes by
+    every trainer — the vision engine routes through
+    ``Trainer.state_to_host`` and the LM trainers through their
+    gather + canonicalize path — precisely so any strategy's artifact
+    restores anywhere. This helper reads ONLY the ``params`` subtree
+    against the dense model's template (optimizer state, step counter
+    and any compression carry are dropped), digest-verifying each leaf
+    (utils/checkpoint.py), and returns a pytree :func:`generate`'s /
+    the serve engine's dense math accepts directly. ``model`` must be
+    the dense config (no sp/tp/ep axes; drop them with
+    ``dataclasses.replace`` if you hold the training-time config —
+    the parameter TREE is identical, only the runtime layout differs).
+    """
+    check_decodable(model)
+    from tpu_ddp.utils.checkpoint import restore_checkpoint
+    template = {"params": jax.eval_shape(
+        lambda: model.init(jax.random.key(0)))}
+    restored, _ = restore_checkpoint(
+        directory, template, step,
+        drop_extra=("opt_state", "step", "comp_state"))
+    return jax.tree.map(jnp.asarray, restored["params"])

@@ -16,10 +16,16 @@ Design, TPU-first:
   is tested in tests/test_generate.py), so generation continues exactly
   the distribution the trainer optimized.
 
+The cache math itself lives in tpu_ddp/models/decode.py — ONE shared
+decode core, so this offline batch sampler and the continuous-batching
+serving engine (tpu_ddp/serve/) provably run the same projection/
+attention/MLP program; this module owns only the scan-shaped loop.
+
 Single-device dense models only: generation is a serving concern and the
 sharded-training configs (sp/tp/ep) hold their parameters in training
-layouts; materialize full params first (the trainers' checkpoints are
-canonical, tpu_ddp/train/engine.py save_checkpoint).
+layouts; materialize full params first with
+:func:`dense_params_from_checkpoint` (re-exported here from the decode
+core — the trainers' checkpoints are canonical).
 """
 
 from __future__ import annotations
@@ -30,98 +36,23 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpu_ddp.models.transformer import layer_norm, rope
+from tpu_ddp.models.decode import (  # noqa: F401 — public re-exports
+    _NEG_INF,
+    attend_cached,
+    check_decodable,
+    dense_params_from_checkpoint,
+    forward_cached,
+    init_cache,
+    mlp,
+)
 
-_NEG_INF = -1e30
-
-
-def _check_dense(model):
-    if model.sp_axis is not None or model.tp_axis is not None \
-            or model.ep_axis is not None:
-        raise ValueError(
-            "generate() runs dense single-device models; drop the "
-            "sp/tp/ep configuration (training checkpoints are canonical "
-            "and load into a dense model)")
-    if model.moe_experts:
-        # Incremental decode cannot reproduce training-time MoE routing:
-        # capacity competition is over ALL positions in apply() but only
-        # over the new tokens per decode step, so the distributions
-        # diverge. Refusing keeps the exactness guarantee honest.
-        raise ValueError("generate() does not support MoE models: "
-                         "per-step expert capacity cannot match "
-                         "apply()'s whole-sequence slot competition")
-
-
-def _mlp(model, blk, y):
-    cd = model.compute_dtype
-    y = jnp.dot(y, blk["w1"].astype(cd),
-                preferred_element_type=jnp.float32)
-    y = jax.nn.gelu(y.astype(jnp.float32)).astype(cd)
-    return jnp.dot(y, blk["w2"].astype(cd),
-                   preferred_element_type=jnp.float32).astype(cd)
-
-
-def _attend_cached(model, q, ck, cv, q_pos):
-    """q: (B, Lq, H, hd) at absolute positions ``q_pos``; ck/cv: full
-    (B, max_len, KV, hd) caches. Attends each query over cache positions
-    <= its own — the causal mask also covers not-yet-written slots
-    (their positions exceed every live query's). Under GQA the grouped
-    einsum contracts Q heads (B, Lq, KV, G, hd) directly against the
-    KV-width cache — the expansion is never materialized, preserving the
-    smaller cache's bandwidth win (decode is KV-read-bound)."""
-    scale = 1.0 / (model.head_dim ** 0.5)
-    b, lq, h, hd = q.shape
-    kv = ck.shape[2]
-    qg = q.reshape(b, lq, kv, h // kv, hd)
-    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, ck,
-                        preferred_element_type=jnp.float32) * scale
-    k_pos = jnp.arange(ck.shape[1])
-    mask = k_pos[None, None, None, None, :] \
-        > q_pos[None, None, None, :, None]
-    scores = jnp.where(mask, _NEG_INF, scores)
-    p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgqs,bskd->bqkgd", p, cv.astype(jnp.float32),
-                     preferred_element_type=jnp.float32)
-    return out.reshape(b, lq, h, hd).astype(q.dtype)
-
-
-def _forward_cached(model, params, tokens, caches, start: int):
-    """Run ``tokens`` (B, L) occupying absolute positions
-    ``start..start+L-1`` against (and updating) the caches. Returns
-    (last-position logits (B, V), new caches)."""
-    cd = model.compute_dtype
-    b, L = tokens.shape
-    pos = start + jnp.arange(L)
-    x = params["embed"][tokens].astype(cd)
-    new_caches = []
-    for blk, (ck, cv) in zip(params["blocks"], caches):
-        y = layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
-        # Same projection as training: q at H heads, k/v at KV-head
-        # width, so the cache stores only the KV heads.
-        q, k, v = model.qkv_proj(blk, y, pos)
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                      (0, start, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                      (0, start, 0, 0))
-        o = _attend_cached(model, q, ck, cv, pos)
-        wo = blk["wo"].astype(cd).reshape(-1, model.d_model)
-        o = jnp.dot(o.reshape(b, L, -1), wo,
-                    preferred_element_type=jnp.float32).astype(cd)
-        x = x + o
-        y = layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
-        x = x + _mlp(model, blk, y)
-        new_caches.append((ck, cv))
-    logits = model.head_apply(params, x[:, -1:])[:, 0]
-    return logits, tuple(new_caches)
-
-
-def init_cache(model, batch: int, max_len: int):
-    """Per-block (K, V) buffers: (B, max_len, KV, hd) each — under GQA
-    the cache is num_heads/num_kv_heads times smaller than MHA's, the
-    scheme's reason to exist (decode is KV-cache-bandwidth-bound)."""
-    shape = (batch, max_len, model.kv_heads, model.head_dim)
-    zeros = jnp.zeros(shape, model.compute_dtype)
-    return tuple((zeros, zeros) for _ in range(model.num_layers))
+# Back-compat aliases: the underscored names were this module's
+# internals before the decode core was extracted; tests and downstream
+# callers may still import them from here.
+_check_dense = check_decodable
+_mlp = mlp
+_attend_cached = attend_cached
+_forward_cached = forward_cached
 
 
 @functools.partial(jax.jit,
@@ -131,7 +62,7 @@ def _generate_impl(model, params, prompt, max_new_tokens, temperature,
     b, p_len = prompt.shape
     total = p_len + max_new_tokens
     caches = init_cache(model, b, total)
-    logits, caches = _forward_cached(model, params, prompt, caches, 0)
+    logits, caches = forward_cached(model, params, prompt, caches, 0)
 
     def pick(logits, key):
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -145,8 +76,8 @@ def _generate_impl(model, params, prompt, max_new_tokens, temperature,
 
     def step(carry, i):
         caches, tok, key = carry
-        logits, caches = _forward_cached(model, params, tok[:, None],
-                                         caches, p_len + i)
+        logits, caches = forward_cached(model, params, tok[:, None],
+                                        caches, p_len + i)
         nxt, key = pick(logits, key)
         return (caches, nxt, key), tok
 
@@ -166,7 +97,7 @@ def generate(model, params, prompt, max_new_tokens: int,
     (B, max_new_tokens) generated tokens. The prompt plus generation
     must fit ``model.max_seq_len``.
     """
-    _check_dense(model)
+    check_decodable(model)
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.ndim != 2 or prompt.shape[1] < 1:
         raise ValueError("prompt must be (batch, prompt_len >= 1)")

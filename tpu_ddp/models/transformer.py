@@ -43,13 +43,19 @@ def _normal(key, shape, std, dtype):
 
 
 def rope(x, positions, base: float = 10000.0):
-    """Rotary position embedding. x: (B, L, H, D), positions: (L,)."""
+    """Rotary position embedding. x: (B, L, H, D); positions: (L,)
+    shared across the batch (training / offline decode), or (B, L)
+    per-row (continuous-batching decode, where every live sequence
+    sits at its own offset — tpu_ddp/serve/). The (L,) path is
+    bit-identical to the original shared-position formulation."""
     d = x.shape[-1]
     half = d // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]  # (1, L, 1, half)
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    cos = jnp.cos(angles)[..., None, :]  # (..., L, 1, half)
+    sin = jnp.sin(angles)[..., None, :]
+    if angles.ndim == 2:  # shared (L,) positions: add the batch dim
+        cos, sin = cos[None], sin[None]
     x1, x2 = x[..., :half], x[..., half:]
     x32_1, x32_2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
     return jnp.concatenate(

@@ -1,0 +1,29 @@
+"""Continuous-batching serving engine over the model zoo.
+
+The north star's second half: the training side of this repo makes the
+model; this package serves it — a paged KV cache (kv_pool.py),
+iteration-level continuous batching (scheduler.py), the request
+lifecycle (engine.py) and a Poisson latency-SLO load generator
+(loadgen.py). Entry point::
+
+    from tpu_ddp.serve import ServeEngine
+    engine = ServeEngine.from_checkpoint(model, ckpt_dir)
+    h = engine.submit(prompt, max_new_tokens=64)
+    engine.run()
+    print(h.tokens)
+"""
+
+from tpu_ddp.serve.engine import Request, ServeEngine
+from tpu_ddp.serve.kv_pool import PagedKVPool
+from tpu_ddp.serve.loadgen import (
+    RequestSpec,
+    calibrate_rate,
+    make_workload,
+    run_load,
+)
+from tpu_ddp.serve.scheduler import Scheduler
+
+__all__ = [
+    "PagedKVPool", "Request", "RequestSpec", "Scheduler", "ServeEngine",
+    "calibrate_rate", "make_workload", "run_load",
+]

@@ -1,0 +1,388 @@
+"""The serving engine: request lifecycle over the paged KV pool and the
+continuous-batching scheduler.
+
+One ``ServeEngine`` owns a dense model + params, a ``PagedKVPool``, a
+``Scheduler`` and exactly TWO jitted programs, compiled once each:
+
+- ``decode step`` — one token for the ENTIRE slot bank per call.
+  Static (num_slots, blocks_per_seq) shapes; idle slots ride along with
+  zeroed block tables, so their scatters land in the null block and
+  their sampled outputs are discarded host-side. Per layer it is the
+  shared decode core (tpu_ddp/models/decode.py project_qkv /
+  attend_cached / block_finish) over a pool-GATHERED cache view — the
+  same math ``generate()`` runs over contiguous buffers, which is what
+  makes the engine-vs-generate parity test meaningful.
+- ``prefill step`` — ONE ``prefill_chunk``-token slice of ONE prompt
+  per call, every chunk the same static shape (short chunks padded;
+  padded positions scatter to the null block and their outputs are
+  masked by the causal position test). Chunking bounds how long a
+  long prompt can stall the decode batch: one chunk per engine step.
+
+Token positions are written BEFORE they are attended (the new token's
+K/V is scattered, then the gathered view is attended), so a query never
+reads an unwritten slot of its own sequence; everything beyond a
+query's position is causally masked to an exact zero weight
+(decode.attend_cached).
+
+Sampling is per-request and stateless (decode.sample_token): keyed by
+(request seed, absolute position), so a request replayed after
+cancellation or across engines reproduces its tokens exactly.
+
+Checkpoints load via the canonical utils/checkpoint.py path —
+:meth:`ServeEngine.from_checkpoint` is
+``dense_params_from_checkpoint`` + construction, the train→serve
+round trip in one call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+import math
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_ddp.models.decode import (
+    attend_cached,
+    block_finish,
+    check_decodable,
+    dense_params_from_checkpoint,
+    project_qkv,
+    sample_token,
+)
+from tpu_ddp.serve.kv_pool import PagedKVPool
+from tpu_ddp.serve.scheduler import Scheduler
+from tpu_ddp.utils.metrics import MetricsLogger
+
+
+@dataclasses.dataclass
+class Request:
+    """One submitted request; doubles as the caller's streaming handle
+    (the engine appends into ``tokens``/``logprobs`` as they land)."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    eos_id: int | None = None
+    on_token: Callable[[int], None] | None = None
+    tokens: list = dataclasses.field(default_factory=list)
+    logprobs: list = dataclasses.field(default_factory=list)
+    done: bool = False
+    cancelled: bool = False
+    submitted_at: float = 0.0
+    first_token_at: float | None = None
+    finished_at: float | None = None
+
+    @property
+    def ttft_s(self) -> float | None:
+        """Time to first token (seconds since submit), once known."""
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+# Both step builders are memoized on (model, block_size, blocks_per_seq)
+# — model is a frozen dataclass, so the key is by-value. Every engine
+# with the same cache geometry shares ONE compiled program; sweep
+# scripts and tests construct engines freely without paying recompiles.
+@functools.lru_cache(maxsize=32)
+def _build_decode_step(model, block_size: int, blocks_per_seq: int):
+    """One jitted token step for the whole slot bank. ``tables``
+    (S, BPS) int32 block tables (zeros = null for idle slots),
+    ``lengths`` (S,) cache positions written so far, ``last_tokens``
+    (S,) the pending token each slot feeds at position ``lengths``."""
+
+    def step(params, pool_k, pool_v, tables, lengths, last_tokens,
+             temps, seeds):
+        S = tables.shape[0]
+        cd = model.compute_dtype
+        x = params["embed"][last_tokens[:, None]].astype(cd)  # (S, 1, dm)
+        pos = lengths[:, None]                                # (S, 1)
+        bidx = jnp.take_along_axis(
+            tables, (lengths // block_size)[:, None], axis=1)[:, 0]
+        off = lengths % block_size
+        for li, blk in enumerate(params["blocks"]):
+            q, k, v = project_qkv(model, blk, x, pos)
+            pool_k = pool_k.at[li, bidx, off].set(
+                k[:, 0].astype(pool_k.dtype))
+            pool_v = pool_v.at[li, bidx, off].set(
+                v[:, 0].astype(pool_v.dtype))
+            view = (S, blocks_per_seq * block_size) + pool_k.shape[3:]
+            ck = pool_k[li][tables].reshape(view)
+            cv = pool_v[li][tables].reshape(view)
+            o = attend_cached(model, q, ck, cv, pos)
+            x = block_finish(model, blk, x, o)
+        logits = model.head_apply(params, x)[:, 0]            # (S, V)
+        toks, lps = jax.vmap(
+            lambda lg, t, sd, p: sample_token(model, lg, t, sd, p))(
+                logits, temps, seeds, lengths + 1)
+        return pool_k, pool_v, toks, lps
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=32)
+def _build_prefill_step(model, block_size: int, blocks_per_seq: int):
+    """One jitted prefill chunk for ONE slot. ``tokens`` (1, C) is the
+    chunk (zero-padded past the prompt), occupying absolute positions
+    ``start..start+C-1``; positions >= ``prompt_len`` scatter to the
+    null block and never influence a valid query (causal mask). The
+    sampled (token, logprob) pair is meaningful only on the final
+    chunk (the one containing position ``prompt_len - 1``); earlier
+    chunks compute and discard it so every chunk is ONE program."""
+
+    def step(params, pool_k, pool_v, table, tokens, start, prompt_len,
+             temp, seed):
+        cd = model.compute_dtype
+        C = tokens.shape[1]
+        p = start + jnp.arange(C)                             # (C,)
+        valid = p < prompt_len
+        safe = jnp.clip(p // block_size, 0, blocks_per_seq - 1)
+        blk_idx = jnp.where(valid, table[safe], PagedKVPool.NULL_BLOCK)
+        off = p % block_size
+        x = params["embed"][tokens].astype(cd)                # (1, C, dm)
+        for li, blkp in enumerate(params["blocks"]):
+            q, k, v = project_qkv(model, blkp, x, p)
+            pool_k = pool_k.at[li, blk_idx, off].set(
+                k[0].astype(pool_k.dtype))
+            pool_v = pool_v.at[li, blk_idx, off].set(
+                v[0].astype(pool_v.dtype))
+            view = (1, blocks_per_seq * block_size) + pool_k.shape[3:]
+            ck = pool_k[li][table].reshape(view)
+            cv = pool_v[li][table].reshape(view)
+            o = attend_cached(model, q, ck, cv, p)
+            x = block_finish(model, blkp, x, o)
+        logits = model.head_apply(params, x)[0]               # (C, V)
+        last = jnp.clip(prompt_len - 1 - start, 0, C - 1)
+        tok, lp = sample_token(model, logits[last], temp, seed,
+                               prompt_len)
+        return pool_k, pool_v, tok, lp
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+class ServeEngine:
+    """Continuous-batching serving over one dense TransformerLM.
+
+    Knob defaults come from ``TrainConfig`` (``TPU_DDP_SERVE_SLOTS``,
+    ``TPU_DDP_SERVE_BLOCK``, ``TPU_DDP_SERVE_PREFILL_CHUNK``,
+    ``TPU_DDP_SERVE_CACHE_DTYPE`` — registered in tune/space.py under
+    the "goodput" objective); explicit arguments win. ``num_blocks``
+    defaults to a pool big enough that every slot can hold a
+    ``max_seq_len`` sequence (no paging pressure); size it smaller to
+    make admission control real.
+    """
+
+    def __init__(self, model, params, *, num_slots: int | None = None,
+                 block_size: int | None = None,
+                 prefill_chunk: int | None = None,
+                 num_blocks: int | None = None,
+                 cache_dtype: str | None = None,
+                 mode: str = "continuous",
+                 metrics: MetricsLogger | None = None,
+                 config=None):
+        check_decodable(model)
+        if config is None:
+            from tpu_ddp.utils.config import TrainConfig
+            config = TrainConfig()
+        self.model = model
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.num_slots = int(num_slots if num_slots is not None
+                             else config.serve_slots)
+        self.block_size = int(block_size if block_size is not None
+                              else config.serve_block_size)
+        self.prefill_chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else config.serve_prefill_chunk)
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.blocks_per_seq = math.ceil(model.max_seq_len
+                                        / self.block_size)
+        if num_blocks is None:
+            num_blocks = self.num_slots * self.blocks_per_seq + 1
+        cache_dtype = (cache_dtype if cache_dtype is not None
+                       else config.serve_cache_dtype)
+        self.pool = PagedKVPool(model, num_blocks, self.block_size,
+                                cache_dtype)
+        self.sched = Scheduler(self.pool, self.num_slots, mode)
+        self.metrics = metrics if metrics is not None \
+            else MetricsLogger(None)
+        self._decode = _build_decode_step(model, self.block_size,
+                                          self.blocks_per_seq)
+        self._prefill = _build_prefill_step(model, self.block_size,
+                                            self.blocks_per_seq)
+        self._rid = itertools.count()
+
+    @classmethod
+    def from_checkpoint(cls, model, directory: str,
+                        step: int | None = None, **kwargs):
+        """Load a trained checkpoint (any strategy — the artifact is
+        canonical) into a fresh engine: the train→serve round trip."""
+        params = dense_params_from_checkpoint(model, directory, step)
+        return cls(model, params, **kwargs)
+
+    # ---- request lifecycle ---------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: int | None = None,
+               on_token: Callable[[int], None] | None = None) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold >= 1 token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size + max_new_tokens
+        if total > self.model.max_seq_len:
+            raise ValueError(f"prompt + generation = {total} exceeds "
+                             f"max_seq_len={self.model.max_seq_len}")
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        req = Request(rid=next(self._rid), prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature), seed=int(seed),
+                      eos_id=eos_id, on_token=on_token,
+                      submitted_at=time.perf_counter())
+        self.sched.enqueue(req)
+        self.metrics.inc("serve_submitted")
+        return req
+
+    def cancel(self, req: Request) -> bool:
+        """Drop a queued or live request; frees its blocks. Returns
+        whether there was anything to cancel."""
+        if req.done:
+            return False
+        if req in self.sched.queue:
+            self.sched.queue.remove(req)
+        else:
+            for i, s in enumerate(self.sched.slots):
+                if s is not None and s.request is req:
+                    self.sched.retire(i)
+                    break
+            else:
+                return False
+        req.cancelled = True
+        req.done = True
+        req.finished_at = time.perf_counter()
+        self.metrics.inc("serve_cancelled")
+        return True
+
+    # ---- the iteration -------------------------------------------------
+
+    def step(self) -> bool:
+        """One engine iteration: admit, at most one prefill chunk, one
+        whole-batch decode step. Returns whether any work ran."""
+        admitted = self.sched.admit()
+        for _ in admitted:
+            self.metrics.inc("serve_admitted")
+        did = False
+
+        pi = self.sched.prefill_slot()
+        if pi is not None:
+            did = True
+            self._run_prefill_chunk(pi)
+
+        dslots = self.sched.decode_slots()
+        if dslots:
+            did = True
+            self._run_decode_step(dslots)
+
+        self.metrics.observe("serve_queue_depth",
+                             len(self.sched.queue))
+        self.metrics.observe("serve_slot_occupancy",
+                             self.sched.live / self.num_slots)
+        return did or bool(admitted)
+
+    def run(self, max_steps: int | None = None) -> int:
+        """Step until idle (queue drained, all slots free) or
+        ``max_steps``. Returns the number of steps taken."""
+        n = 0
+        while max_steps is None or n < max_steps:
+            if not self.step():
+                break
+            n += 1
+        return n
+
+    # ---- internals -----------------------------------------------------
+
+    def _table_for(self, slot) -> np.ndarray:
+        t = np.zeros(self.blocks_per_seq, np.int32)
+        t[:len(slot.blocks)] = slot.blocks
+        return t
+
+    def _run_prefill_chunk(self, pi: int) -> None:
+        s = self.sched.slots[pi]
+        req = s.request
+        start, C = s.prefill_done, self.prefill_chunk
+        chunk = np.zeros((1, C), np.int32)
+        piece = req.prompt[start:start + C]
+        chunk[0, :piece.size] = piece
+        k, v, tok, lp = self._prefill(
+            self.params, self.pool.k, self.pool.v,
+            jnp.asarray(self._table_for(s)), jnp.asarray(chunk),
+            jnp.int32(start), jnp.int32(req.prompt.size),
+            jnp.float32(req.temperature), jnp.int32(req.seed))
+        self.pool.commit(k, v)
+        s.prefill_done = min(start + C, int(req.prompt.size))
+        s.length = s.prefill_done
+        if s.prefill_done >= req.prompt.size:
+            s.phase = "decode"
+            self._emit(pi, int(tok), float(lp))  # the first token
+
+    def _run_decode_step(self, dslots: list[int]) -> None:
+        S, BPS = self.num_slots, self.blocks_per_seq
+        tables = np.zeros((S, BPS), np.int32)
+        lengths = np.zeros(S, np.int32)
+        last = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        seeds = np.zeros(S, np.int32)
+        for i in dslots:
+            self.sched.ensure_block(i)
+            s = self.sched.slots[i]
+            tables[i] = self._table_for(s)
+            lengths[i] = s.length
+            last[i] = s.pending_token
+            temps[i] = s.request.temperature
+            seeds[i] = s.request.seed
+        k, v, toks, lps = self._decode(
+            self.params, self.pool.k, self.pool.v,
+            jnp.asarray(tables), jnp.asarray(lengths),
+            jnp.asarray(last), jnp.asarray(temps), jnp.asarray(seeds))
+        self.pool.commit(k, v)
+        toks, lps = np.asarray(toks), np.asarray(lps)
+        for i in dslots:
+            self.sched.slots[i].length += 1
+            self._emit(i, int(toks[i]), float(lps[i]))
+
+    def _emit(self, idx: int, tok: int, logprob: float) -> None:
+        """Record one sampled token for slot ``idx``'s request: stream
+        it, stamp TTFT on the first, retire on max_new_tokens/EOS."""
+        s = self.sched.slots[idx]
+        req = s.request
+        s.generated += 1
+        s.pending_token = tok
+        req.tokens.append(tok)
+        req.logprobs.append(logprob)
+        now = time.perf_counter()
+        if req.first_token_at is None:
+            req.first_token_at = now
+            self.metrics.observe("serve_ttft_ms",
+                                 (now - req.submitted_at) * 1e3)
+        if req.on_token is not None:
+            req.on_token(tok)
+        if s.generated >= req.max_new_tokens \
+                or (req.eos_id is not None and tok == req.eos_id):
+            req.done = True
+            req.finished_at = now
+            self.sched.retire(idx)
+            self.metrics.inc("serve_retired")
+
+
+__all__ = ["Request", "ServeEngine"]

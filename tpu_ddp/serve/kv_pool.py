@@ -1,0 +1,118 @@
+"""Block-paged KV-cache pool — the serving-side replacement for
+``generate.py``'s per-request contiguous ``(B, max_len, KV, hd)``
+buffers.
+
+Why paging: a contiguous per-request cache must be sized for the WORST
+case (prompt + max_new_tokens), so a fleet of short requests strands
+almost all of it. The pool instead holds one device buffer of
+fixed-size blocks per layer — ``(num_layers, num_blocks, block_size,
+KV, hd)`` — and each live request owns a list of block ids (its "block
+table"). Blocks are allocated lazily as a sequence grows and returned
+on retirement, so cache memory tracks the LIVE token count, not the
+worst case, and the same HBM serves many more concurrent sequences
+(the vLLM PagedAttention argument).
+
+Accounting is host-side and exact, and deliberately simple: a free
+list of block ids. Block 0 is the NULL block — never allocated, never
+freed. It is where the jitted steps redirect every masked write
+(idle decode slots, prefill padding), so out-of-range scatters land in
+a sacrificial page instead of a page owned by another request; its
+contents are garbage by design and are never attended (the causal
+position mask in ``decode.attend_cached`` zeroes any read beyond a
+query's own length). The invariant the accounting test pins:
+``free_count + sum(live block-table lengths) == num_blocks - 1``
+at every step, and ``free_count`` returns to ``num_blocks - 1`` once
+all requests retire — no leaks, no double frees.
+
+Cache dtype rides the SAME policy vocabulary as training's saved
+activations (tpu_ddp/memory/policy.py): "compute" stores what the
+model computes in (exactness-preserving, the default), "bf16" halves
+cache bytes under an f32 compute model (decode is KV-read-bound, so
+this is a real knob), "f32" forces full precision.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from tpu_ddp.memory.policy import resolve_act_dtype
+
+
+class PagedKVPool:
+    """One paged K and V buffer covering every layer of one model.
+
+    The device arrays are FUNCTIONAL state: the engine passes
+    ``pool.k`` / ``pool.v`` into its jitted steps (donated) and stores
+    the returned buffers back via :meth:`commit`. The pool object owns
+    only the allocator — which block ids are free — so allocator bugs
+    are ordinary host Python, debuggable without a device.
+    """
+
+    NULL_BLOCK = 0
+
+    def __init__(self, model, num_blocks: int, block_size: int,
+                 cache_dtype: str = "compute"):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             f"reserved null block), got {num_blocks}")
+        self.model = model
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.dtype = resolve_act_dtype(cache_dtype, model.compute_dtype)
+        shape = (model.num_layers, num_blocks, block_size,
+                 model.kv_heads, model.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        # LIFO free list: recently-freed (still-hot) pages are reused
+        # first. Block 0 is never a member.
+        self._free = list(range(num_blocks - 1, 0, -1))
+
+    # ---- allocator -----------------------------------------------------
+
+    @property
+    def total_usable(self) -> int:
+        """Allocatable blocks (the null block is not one)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` cache slots."""
+        return math.ceil(n_tokens / self.block_size)
+
+    def alloc(self) -> int:
+        """Claim one free block id. The scheduler's reservation rule
+        (tpu_ddp/serve/scheduler.py) guarantees this never raises for
+        an admitted request; raising (not waiting) keeps the bug loud
+        if that invariant is ever broken."""
+        if not self._free:
+            raise RuntimeError(
+                "KV pool exhausted — the scheduler admitted more "
+                "worst-case tokens than the pool holds (reservation "
+                "accounting bug)")
+        return self._free.pop()
+
+    def free(self, blocks) -> None:
+        """Return a request's blocks. Double-free and null-free are
+        accounting corruption, not recoverable states — raise."""
+        for b in blocks:
+            if b == self.NULL_BLOCK:
+                raise ValueError("attempted to free the null block")
+            if not 0 < b < self.num_blocks:
+                raise ValueError(f"block id {b} out of range")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+    # ---- device state --------------------------------------------------
+
+    def commit(self, k, v) -> None:
+        """Store the jitted step's updated buffers (the old ones were
+        donated into the step)."""
+        self.k, self.v = k, v

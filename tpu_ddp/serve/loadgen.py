@@ -1,0 +1,135 @@
+"""Poisson-arrival load generator + latency/goodput measurement.
+
+The serving question is not "how fast is one decode step" but "what
+latency distribution do USERS see at a given request rate" — so the
+benchmark models an open system: requests arrive by a seeded Poisson
+process (exponential inter-arrival gaps at ``rate`` req/s), are
+submitted the moment their arrival time passes, and the engine steps
+continuously in between. Per run we report:
+
+- ``ttft_p50_ms`` / ``ttft_p99_ms`` — time from arrival to first
+  streamed token. The SLO metric: it is what queueing delay + prefill
+  chunking actually do to a user.
+- ``tokens_per_sec`` — completed generated tokens / makespan, the
+  throughput axis of the latency/throughput frontier.
+- ``goodput_tokens_per_sec`` — tokens from requests whose TTFT met
+  ``slo_ttft_ms`` only. This is the number continuous batching is
+  supposed to win: static batching can match raw throughput while
+  failing every latency target (tokens delivered after the deadline
+  are not good tokens).
+
+Arrival times and workloads are fully seeded (numpy Generator), so a
+sweep cell is reproducible; wall-clock measurements of course are not,
+which is why experiments/serve_sweep.json records host provenance the
+same way every other sweep artifact does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestSpec:
+    """One workload item (engine-independent, so the same workload
+    drives the continuous engine and the static baseline)."""
+
+    prompt: tuple
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+
+
+def make_workload(n: int, vocab_size: int, seed: int = 0,
+                  prompt_len: tuple[int, int] = (4, 17),
+                  max_new: tuple[int, int] = (4, 17),
+                  temperature: float = 0.0) -> list[RequestSpec]:
+    """``n`` seeded requests with uniformly varied prompt lengths and
+    generation budgets (half-open ranges). Varied ``max_new`` is what
+    separates the schedulers: under static batching the whole batch
+    waits for its slowest member."""
+    rng = np.random.default_rng(seed)
+    specs = []
+    for i in range(n):
+        p_len = int(rng.integers(*prompt_len))
+        prompt = tuple(int(t) for t in
+                       rng.integers(0, vocab_size, size=p_len))
+        specs.append(RequestSpec(
+            prompt=prompt,
+            max_new_tokens=int(rng.integers(*max_new)),
+            temperature=temperature, seed=i))
+    return specs
+
+
+def poisson_arrivals(n: int, rate: float, seed: int = 0) -> np.ndarray:
+    """``n`` arrival offsets (seconds from run start) at ``rate``
+    requests/second."""
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.exponential(1.0 / rate, size=n))
+
+
+def run_load(engine, specs: list[RequestSpec], rate: float,
+             seed: int = 0, slo_ttft_ms: float | None = None) -> dict:
+    """Drive ``engine`` with ``specs`` arriving Poisson at ``rate``;
+    block until every request completes; return the metrics dict."""
+    arrivals = poisson_arrivals(len(specs), rate, seed)
+    handles: list = [None] * len(specs)
+    t0 = time.perf_counter()
+    nxt = 0
+    while True:
+        now = time.perf_counter() - t0
+        while nxt < len(specs) and arrivals[nxt] <= now:
+            sp = specs[nxt]
+            handles[nxt] = engine.submit(
+                sp.prompt, sp.max_new_tokens,
+                temperature=sp.temperature, seed=sp.seed)
+            nxt += 1
+        worked = engine.step()
+        if not worked:
+            if nxt >= len(specs):
+                break  # idle and nothing left to arrive: all done
+            # Idle but ahead of the arrival process: sleep to the next
+            # arrival instead of spinning.
+            time.sleep(max(0.0, min(
+                arrivals[nxt] - (time.perf_counter() - t0), 0.05)))
+    t_end = time.perf_counter()
+
+    ttfts = np.array([h.ttft_s for h in handles]) * 1e3  # ms
+    n_tokens = np.array([len(h.tokens) for h in handles])
+    makespan = t_end - t0
+    if slo_ttft_ms is None:
+        good = n_tokens.sum()
+    else:
+        good = n_tokens[ttfts <= slo_ttft_ms].sum()
+    return {
+        "rate_rps": rate,
+        "n_requests": len(specs),
+        "total_tokens": int(n_tokens.sum()),
+        "makespan_s": round(makespan, 4),
+        "ttft_p50_ms": round(float(np.percentile(ttfts, 50)), 3),
+        "ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 3),
+        "ttft_mean_ms": round(float(ttfts.mean()), 3),
+        "tokens_per_sec": round(float(n_tokens.sum()) / makespan, 3),
+        "slo_ttft_ms": slo_ttft_ms,
+        "slo_attained": (None if slo_ttft_ms is None else
+                         round(float((ttfts <= slo_ttft_ms).mean()), 4)),
+        "goodput_tokens_per_sec": round(float(good) / makespan, 3),
+    }
+
+
+def calibrate_rate(engine_factory, specs: list[RequestSpec]) -> float:
+    """Measure this host's saturation throughput (requests/sec with
+    every request available at t=0) so sweep rates can be FRACTIONS of
+    capacity rather than absolute numbers — the same sweep script then
+    exercises under/at/over-saturation regimes on any host."""
+    engine = engine_factory()
+    t0 = time.perf_counter()
+    for sp in specs:
+        engine.submit(sp.prompt, sp.max_new_tokens,
+                      temperature=sp.temperature, seed=sp.seed)
+    engine.run()
+    elapsed = time.perf_counter() - t0
+    return len(specs) / elapsed

@@ -1,0 +1,165 @@
+"""Iteration-level (continuous-batching) scheduler.
+
+The Orca/vLLM scheduling idea: batch membership is re-decided EVERY
+model step, not per batch-of-requests. A fixed number of decode slots
+runs one jitted whole-batch decode step per iteration; finished
+sequences retire and their slot + KV blocks are reusable on the very
+next step, so a long request never holds short ones hostage and the
+batch stays full under load. Prefill is chunked (``prefill_chunk``
+tokens per step) and interleaved — at most ONE chunk per engine step —
+so a long prompt cannot head-of-line-block the live decode batch for
+more than one chunk's latency.
+
+Invariants (docs/DESIGN.md §19, pinned by tests/test_serve.py):
+
+- **FIFO admission / no starvation.** Requests admit strictly in
+  submit order; if the queue head does not fit, nothing behind it is
+  admitted either. Retirement monotonically frees blocks, so the head
+  always eventually fits (its feasibility was checked at submit) —
+  no request waits forever behind later arrivals.
+- **Admitted requests always finish.** Admission reserves the WORST
+  CASE block count ``ceil((prompt + max_new) / block_size)`` against
+  ``pool.free_count`` minus every live request's still-unallocated
+  reservation. Blocks are then allocated lazily as the sequence grows,
+  but the reservation means mid-flight allocation can never fail —
+  no deadlock where live requests starve each other out of pages.
+- **Page-pool accounting.** ``free + Σ live allocated == total
+  usable`` at every step; retirement returns exactly the allocated
+  blocks (pool raises on double free / null free).
+
+``mode="static"`` is the experiment baseline, NOT a production path:
+admission waits until EVERY slot is idle, fills all slots from the
+queue, then admits nothing until the whole batch drains — classic
+static batching, with all other machinery identical, so the serve
+sweep's continuous-vs-static comparison isolates exactly the
+scheduling policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any
+
+
+@dataclasses.dataclass
+class SlotState:
+    """Host bookkeeping for one decode slot's live request."""
+
+    request: Any
+    admit_seq: int
+    phase: str  # "prefill" -> "decode"
+    length: int = 0          # cache positions written (valid tokens)
+    prefill_done: int = 0    # prompt tokens already run
+    generated: int = 0       # tokens sampled so far
+    pending_token: int = 0   # sampled but not yet fed through the model
+    blocks: list = dataclasses.field(default_factory=list)
+    reserved: int = 0        # worst-case TOTAL blocks for this request
+
+
+class Scheduler:
+    def __init__(self, pool, num_slots: int, mode: str = "continuous"):
+        if mode not in ("continuous", "static"):
+            raise ValueError(f"unknown scheduler mode {mode!r}; "
+                             "expected 'continuous' or 'static'")
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.pool = pool
+        self.num_slots = num_slots
+        self.mode = mode
+        self.queue: deque = deque()
+        self.slots: list[SlotState | None] = [None] * num_slots
+        self._admit_seq = 0
+
+    # ---- queries -------------------------------------------------------
+
+    @property
+    def live(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    @property
+    def reserved_unallocated(self) -> int:
+        """Blocks promised to live requests but not yet allocated —
+        the amount the admission check must treat as already spent."""
+        return sum(s.reserved - len(s.blocks)
+                   for s in self.slots if s is not None)
+
+    def worst_case_blocks(self, request) -> int:
+        return self.pool.blocks_for(len(request.prompt)
+                                    + request.max_new_tokens)
+
+    def prefill_slot(self) -> int | None:
+        """The slot to run a prefill chunk for this step: the OLDEST
+        admitted request still prefilling (FIFO among prefills — the
+        fairness rule extends inside the engine)."""
+        best = None
+        for i, s in enumerate(self.slots):
+            if s is not None and s.phase == "prefill":
+                if best is None or s.admit_seq < self.slots[best].admit_seq:
+                    best = i
+        return best
+
+    def decode_slots(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots)
+                if s is not None and s.phase == "decode"]
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def enqueue(self, request) -> None:
+        """Validate feasibility and queue FIFO. An infeasible request
+        (worst case exceeds the whole pool) is rejected HERE, loudly —
+        admitting it would starve the queue forever."""
+        need = self.worst_case_blocks(request)
+        if need > self.pool.total_usable:
+            raise ValueError(
+                f"request needs up to {need} KV blocks "
+                f"({len(request.prompt)} prompt + "
+                f"{request.max_new_tokens} new tokens at block_size="
+                f"{self.pool.block_size}) but the pool holds only "
+                f"{self.pool.total_usable}")
+        self.queue.append(request)
+
+    def admit(self) -> list[int]:
+        """Move queued requests into free slots under the reservation
+        rule. Returns the newly filled slot indices."""
+        if self.mode == "static" and self.live:
+            return []  # static batching: drain fully before re-admitting
+        admitted = []
+        for i in range(self.num_slots):
+            if not self.queue or self.slots[i] is not None:
+                continue
+            req = self.queue[0]
+            need = self.worst_case_blocks(req)
+            if need > self.pool.free_count - self.reserved_unallocated:
+                break  # FIFO: never skip the head
+            self.queue.popleft()
+            slot = SlotState(request=req, admit_seq=self._admit_seq,
+                             phase="prefill", reserved=need)
+            self._admit_seq += 1
+            # Prompt blocks up front (prefill scatters into them this
+            # or next step); generation blocks arrive lazily.
+            for _ in range(self.pool.blocks_for(len(req.prompt))):
+                slot.blocks.append(self.pool.alloc())
+            self.slots[i] = slot
+            admitted.append(i)
+        return admitted
+
+    def ensure_block(self, idx: int) -> None:
+        """Grow slot ``idx``'s table to cover writing position
+        ``length`` (called before each decode step). Covered by the
+        reservation, so ``alloc`` cannot fail."""
+        s = self.slots[idx]
+        while s.length // self.pool.block_size >= len(s.blocks):
+            s.blocks.append(self.pool.alloc())
+
+    def retire(self, idx: int) -> None:
+        """Free slot ``idx``'s blocks and reservation."""
+        s = self.slots[idx]
+        self.pool.free(s.blocks)
+        self.slots[idx] = None
+
+    def accounting_ok(self) -> bool:
+        """The §19 page-pool invariant, checkable at any step."""
+        allocated = sum(len(s.blocks)
+                        for s in self.slots if s is not None)
+        return self.pool.free_count + allocated == self.pool.total_usable

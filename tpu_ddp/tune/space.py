@@ -58,6 +58,13 @@ class Knob:
     values: tuple          # candidate values (must include the default)
     flag: str | None = None  # tpu_ddp.launch flag, when one exists
     semantic: bool = False   # changes numerics, not just schedule
+    # What a trial measures to compare this knob's candidates:
+    # "step_time" (the training objective — every schedule knob) or
+    # "goodput" (tokens/sec under a latency SLO, the serving objective
+    # measured by tpu_ddp/serve/loadgen.py). The default search space
+    # is objective-scoped, so serving knobs never enter a training
+    # search and vice versa.
+    objective: str = "step_time"
     doc: str = ""
 
     def encode(self, value) -> str:
@@ -136,6 +143,32 @@ KNOBS: tuple[Knob, ...] = (
              "(resilience/elastic.py) is a robustness mode, not a "
              "schedule knob — turning it on cannot change steady-state "
              "step time"),
+    # Serving knobs (tpu_ddp/serve/): objective="goodput" scopes them
+    # out of the training (step_time) search space and into the
+    # serve-sweep/loadgen measurement loop.
+    Knob("serve_slots", "serve_slots", "TPU_DDP_SERVE_SLOTS",
+         values=(4, 8, 16), objective="goodput",
+         doc="continuous-batching decode slots — the live-batch width "
+             "of the jitted whole-bank decode step; more slots "
+             "amortize weight reads but grow per-step latency"),
+    Knob("serve_block_size", "serve_block_size", "TPU_DDP_SERVE_BLOCK",
+         values=(8, 16, 32), objective="goodput",
+         doc="paged KV-cache block size in tokens (serve/kv_pool.py): "
+             "small blocks waste less tail capacity per sequence, "
+             "large blocks shrink the table/gather overhead"),
+    Knob("serve_prefill_chunk", "serve_prefill_chunk",
+         "TPU_DDP_SERVE_PREFILL_CHUNK", values=(16, 32, 64),
+         objective="goodput",
+         doc="prompt tokens run per engine step: the knob trading "
+             "prefill throughput against how long one long prompt can "
+             "stall the live decode batch (TTFT tail)"),
+    Knob("serve_cache_dtype", "serve_cache_dtype",
+         "TPU_DDP_SERVE_CACHE_DTYPE", values=("compute", "bf16", "f32"),
+         semantic=True, objective="goodput",
+         doc="KV-cache storage dtype (memory-policy vocabulary, "
+             "tpu_ddp/memory/policy.py): 'bf16' under an f32 compute "
+             "model halves cache reads but rounds the attended "
+             "history — semantic, gated like act_dtype"),
 )
 
 # Model-level knobs are baked into get_model() before the Trainer ever
@@ -155,7 +188,7 @@ def space_version() -> str:
     knob's candidate values invalidates cached tunings via the
     fingerprint (stale overrides are a miss, never a surprise)."""
     payload = [(k.name, k.field, k.env, k.flag, list(map(str, k.values)),
-                k.semantic) for k in KNOBS]
+                k.semantic, k.objective) for k in KNOBS]
     return hashlib.sha256(
         json.dumps(payload, sort_keys=True).encode()).hexdigest()[:12]
 
@@ -254,6 +287,12 @@ def violations(assignment: Mapping, ctx: Workload) -> list[str]:
         bad.append(
             f"act_dtype={act!r} with compute_dtype={cdty!r} — the "
             "boundary cast is a no-op, duplicate of 'compute'")
+    scd = get("serve_cache_dtype", "compute")
+    if (scd, cdty) in (("bf16", "bfloat16"), ("f32", "float32")):
+        bad.append(
+            f"serve_cache_dtype={scd!r} with compute_dtype={cdty!r} — "
+            "the cache cast is a no-op, duplicate of 'compute' "
+            "(tpu_ddp/memory/policy.py resolve_act_dtype)")
     if get("steps_per_dispatch", 1) > 1:
         if get("device_prefetch", 0):
             bad.append("steps_per_dispatch>1 with device_prefetch>0 — "
@@ -304,15 +343,20 @@ def parse_knob_filter(spec: str | None) -> dict | None:
 
 def searchable_knobs(cfg, ctx: Workload,
                      include_semantic: bool | None = None,
-                     only: dict | None = None) -> list[tuple]:
+                     only: dict | None = None,
+                     objective: str = "step_time") -> list[tuple]:
     """The live search space for ``cfg`` under ``ctx``: a list of
     ``(knob, candidate_values)`` with the config's CURRENT value always
     first (the search must be able to keep it). Knobs are dropped when
     the constraint model leaves fewer than two candidates (e.g. the
     Pallas knobs off-TPU) or when ``only`` (the parsed
-    ``TPU_DDP_TUNE_KNOBS`` filter) excludes them. Per-value feasibility
-    is checked with the other knobs at their config values; the search
-    re-checks full assignments, so coupled constraints stay exact."""
+    ``TPU_DDP_TUNE_KNOBS`` filter) excludes them. The space is
+    ``objective``-scoped: the training search ("step_time", the
+    default every existing caller gets) never sees the serving knobs,
+    and a "goodput" search (scripts/serve_sweep.py's tuning section)
+    never sees the training schedule. Per-value feasibility is checked
+    with the other knobs at their config values; the search re-checks
+    full assignments, so coupled constraints stay exact."""
     if include_semantic is None:
         include_semantic = os.environ.get(
             "TPU_DDP_TUNE_SEMANTIC", "") in ("1", "true", "yes", "on")
@@ -321,6 +365,8 @@ def searchable_knobs(cfg, ctx: Workload,
     base = {k.field: getattr(cfg, k.field) for k in KNOBS}
     out = []
     for knob in KNOBS:
+        if knob.objective != objective:
+            continue
         if only is not None and knob.name not in only:
             continue
         if knob.semantic and not include_semantic:

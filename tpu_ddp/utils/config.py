@@ -134,6 +134,24 @@ class TrainConfig:
     # beat the tuner.
     autotune: str = "off"
 
+    # Serving (tpu_ddp/serve/): continuous-batching decode slots — the
+    # live-batch width of the jitted whole-bank decode step. Env:
+    # TPU_DDP_SERVE_SLOTS.
+    serve_slots: int = 8
+    # Paged KV-cache block size in tokens (tpu_ddp/serve/kv_pool.py).
+    # Env: TPU_DDP_SERVE_BLOCK.
+    serve_block_size: int = 16
+    # Prefill chunk in tokens: how much of a prompt runs per engine
+    # step, bounding how long one long prompt can stall the decode
+    # batch. Env: TPU_DDP_SERVE_PREFILL_CHUNK.
+    serve_prefill_chunk: int = 32
+    # KV-cache storage dtype — the memory-policy vocabulary
+    # (tpu_ddp/memory/policy.py ACT_DTYPES): "compute" (no cast),
+    # "bf16" or "f32". Semantic when it differs from compute_dtype
+    # (rounds the attended history), so the autotuner gates it like
+    # act_dtype. Env: TPU_DDP_SERVE_CACHE_DTYPE.
+    serve_cache_dtype: str = "compute"
+
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
     max_iters: int | None = None
@@ -263,6 +281,36 @@ class TrainConfig:
             raise ValueError(
                 f"autotune={self.autotune!r}: expected off|cached|search "
                 "(TPU_DDP_AUTOTUNE)")
+        env_ss = os.environ.get("TPU_DDP_SERVE_SLOTS")
+        if env_ss:
+            self.serve_slots = int(env_ss)
+        if self.serve_slots < 1:
+            raise ValueError(f"serve_slots must be >= 1, got "
+                             f"{self.serve_slots} (TPU_DDP_SERVE_SLOTS)")
+        env_sb = os.environ.get("TPU_DDP_SERVE_BLOCK")
+        if env_sb:
+            self.serve_block_size = int(env_sb)
+        if self.serve_block_size < 1:
+            raise ValueError(
+                f"serve_block_size must be >= 1, got "
+                f"{self.serve_block_size} (TPU_DDP_SERVE_BLOCK)")
+        env_sp = os.environ.get("TPU_DDP_SERVE_PREFILL_CHUNK")
+        if env_sp:
+            self.serve_prefill_chunk = int(env_sp)
+        if self.serve_prefill_chunk < 1:
+            raise ValueError(
+                f"serve_prefill_chunk must be >= 1, got "
+                f"{self.serve_prefill_chunk} "
+                "(TPU_DDP_SERVE_PREFILL_CHUNK)")
+        env_sc = os.environ.get("TPU_DDP_SERVE_CACHE_DTYPE")
+        if env_sc:
+            self.serve_cache_dtype = env_sc
+        # Mirrors tpu_ddp/memory/policy.py ACT_DTYPES (the source of
+        # truth, which re-validates at pool construction).
+        if self.serve_cache_dtype not in ("compute", "bf16", "f32"):
+            raise ValueError(
+                f"serve_cache_dtype={self.serve_cache_dtype!r}: expected "
+                "compute|bf16|f32 (TPU_DDP_SERVE_CACHE_DTYPE)")
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.
