@@ -48,7 +48,8 @@ def main(argv=None) -> int:
                                             shutdown,
                                             test_distributed_setup)
     from tpu_ddp.parallel.mesh import make_mesh
-    from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+    from tpu_ddp.train.lm import (LMTrainer, PipelineLMTrainer,
+                                  make_lm_batch)
 
     world = args.num_nodes or 1
     rank = (0 if world <= 1
@@ -77,6 +78,18 @@ def main(argv=None) -> int:
     tp = int(os.environ.get("TPU_DDP_LM_TP", "1"))
     if tp < 1:
         raise ValueError(f"TPU_DDP_LM_TP={tp}: must be >= 1")
+    # TPU_DDP_LM_PP>1 selects the pipeline rung; the schedule knobs
+    # (TPU_DDP_PP_SCHEDULE / TPU_DDP_PP_MICROBATCHES /
+    # TPU_DDP_PP_VIRTUAL) ride in through TrainConfig's env parsing so
+    # the launch flags (--pp-schedule etc.) reach this CLI unchanged.
+    pp = int(os.environ.get("TPU_DDP_LM_PP", "1"))
+    if pp < 1:
+        raise ValueError(f"TPU_DDP_LM_PP={pp}: must be >= 1")
+    from tpu_ddp.utils.config import TrainConfig
+    knobs = TrainConfig()
+    pp_schedule = knobs.pp_schedule
+    pp_micro = knobs.pp_microbatches or None   # 0 = auto (= pp)
+    pp_virtual = knobs.pp_virtual
     global_batch = int(os.environ.get("TPU_DDP_GLOBAL_BATCH", "8"))
     # The batch axis shards over dp PROCESS GROUPS (world // tp), not
     # over every process: tp-group members feed the same rows.
@@ -98,17 +111,31 @@ def main(argv=None) -> int:
     else:
         raise ValueError(f"TPU_DDP_LM_OPT={opt_name!r}: expected "
                          "'adamw' or 'adafactor'")
-    trainer = LMTrainer(
-        model, mesh,
-        param_sharding="fsdp" if fsdp else "replicated",
-        opt_sharding=opt_shard,
-        optimizer=optimizer,
-        grad_accum=accum, sp_mode=sp_mode, clip_grad_norm=clip)
+    if pp > 1:
+        mesh = make_mesh(mp=tp, pp=pp)
+        trainer = PipelineLMTrainer(
+            model, mesh,
+            num_micro=pp_micro,
+            schedule=pp_schedule,
+            pp_virtual=pp_virtual,
+            param_sharding="fsdp" if fsdp else "replicated",
+            opt_sharding=opt_shard,
+            optimizer=optimizer,
+            sp_mode=sp_mode, clip_grad_norm=clip)
+    else:
+        trainer = LMTrainer(
+            model, mesh,
+            param_sharding="fsdp" if fsdp else "replicated",
+            opt_sharding=opt_shard,
+            optimizer=optimizer,
+            grad_accum=accum, sp_mode=sp_mode, clip_grad_norm=clip)
     state = trainer.init_state(seed=0)
     print(f"[lm_train] rank={rank} world={world} dp={trainer.dp} "
-          f"sp={trainer.sp} tp={trainer.tp} fsdp={fsdp} "
+          f"sp={trainer.sp} tp={trainer.tp} pp={pp} fsdp={fsdp} "
           f"opt_shard={opt_shard} opt={opt_name} accum={accum} "
-          f"clip={clip} preset={preset}")
+          f"clip={clip} preset={preset}"
+          + (f" schedule={pp_schedule} micro={trainer.num_micro} "
+             f"virtual={pp_virtual}" if pp > 1 else ""))
 
     # Deterministic synthetic tokens, identical on every process; each
     # process feeds ITS contiguous shard of the global batch.

@@ -159,6 +159,24 @@ def audit(knobs=None) -> list[str]:
                     f"into TrainConfig.{knob.field} — the env surface "
                     "must validate (raise ValueError) on junk values")
 
+        # (6b) int-valued knobs likewise: junk in the env var must
+        # raise at construction (int() does), never be ignored or
+        # coerced — a typo'd TPU_DDP_PP_VIRTUAL silently training the
+        # default is the same drift as (6). bool is an int subtype in
+        # Python; bool knobs parse by truthiness and are exempt.
+        if (knob.values and isinstance(knob.values[0], int)
+                and not isinstance(knob.values[0], bool)):
+            junk = "knob-audit-junk"
+            with _scrubbed_env(**{knob.env: junk}):
+                try:
+                    TrainConfig()
+                    problems.append(
+                        f"{knob.name}: {knob.env}={junk!r} did not make "
+                        "TrainConfig raise — the int env surface must "
+                        "fail loudly on junk values")
+                except Exception:  # noqa: BLE001 — raising IS the pass
+                    pass
+
         # (4) launch flag exists and wires to this env var
         if knob.flag is not None:
             src = _launch_source()

@@ -186,10 +186,85 @@ class TestPipelineEquivalence:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        rtol=3e-4, atol=3e-5)
 
+    # Interleaved virtual stages + zero-bubble (round 10): the same
+    # dense-equivalence contract as the classic schedules. One fast cell
+    # per new schedule plus the masked-execution (tp) path; the rest of
+    # the grid is slow.
+    @pytest.mark.parametrize("dp,pp,tp,micro,schedule,virtual", [
+        (1, 2, 1, 4, "zerobubble", 1),
+        pytest.param(1, 4, 1, 4, "zerobubble", 1, marks=_slow),
+        pytest.param(2, 2, 1, 2, "zerobubble", 1, marks=_slow),
+        # tp > 1 forces the masked (non-cond-skip) execution path
+        (1, 2, 2, 2, "zerobubble", 1),
+        (1, 2, 1, 4, "interleaved", 2),
+        # V=1 degenerates to plain 1F1B indices
+        pytest.param(1, 4, 1, 4, "interleaved", 1, marks=_slow),
+        # M == pp: minimum legal microbatch count
+        pytest.param(1, 2, 1, 2, "interleaved", 2, marks=_slow),
+        pytest.param(2, 2, 1, 2, "interleaved", 2, marks=_slow),
+        # 4 chunks of 1 layer each on 1 stage: pure virtual pipelining
+        pytest.param(1, 1, 1, 2, "interleaved", 4, marks=_slow),
+    ])
+    def test_new_schedules_match_dense(self, devices, dp, pp, tp, micro,
+                                       schedule, virtual):
+        tokens = _tokens()
+        dense_p, dense_loss = self._dense_step(devices, tokens)
+
+        model = _tiny()
+        mesh = make_mesh(devices[:dp * pp * tp], dp=dp, sp=1, mp=tp, pp=pp)
+        tr = PipelineLMTrainer(model, mesh, num_micro=micro,
+                               optimizer=_sgd(), schedule=schedule,
+                               pp_virtual=virtual)
+        state = tr.init_state(seed=7)
+        x, y = tr.put_batch(*make_lm_batch(tokens))
+        state, loss = tr.train_step(state, x, y)
+        got_loss = float(np.mean(np.asarray(loss)))
+        assert abs(got_loss - dense_loss) < 1e-4, (schedule, virtual)
+
+        got = unstack_block_params(
+            tr.canonical_params(jax.device_get(state.params)),
+            model.num_layers)
+        for a, b in zip(jax.tree.leaves(dense_p), jax.tree.leaves(got)):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=3e-4, atol=3e-5,
+                err_msg=f"dp={dp} pp={pp} tp={tp} micro={micro} "
+                        f"{schedule} V={virtual}")
+
+    @pytest.mark.slow  # four compiles of one geometry; the per-schedule
+    # dense equivalence above pins correctness fast
+    def test_all_schedules_agree_with_dropout(self, devices):
+        """Every schedule draws IDENTICAL dropout masks (keys derive from
+        (microbatch, DENSE layer index), independent of the schedule and
+        of the virtual-stage row permutation), so one-step results must
+        agree pairwise with dropout active."""
+        tokens = _tokens()
+        results = {}
+        for schedule, virtual in (("gpipe", 1), ("1f1b", 1),
+                                  ("zerobubble", 1), ("interleaved", 2)):
+            model = _tiny(dropout_rate=0.3)
+            mesh = make_mesh(devices[:2], dp=1, sp=1, mp=1, pp=2)
+            tr = PipelineLMTrainer(model, mesh, num_micro=4,
+                                   optimizer=_sgd(), schedule=schedule,
+                                   dropout_seed=3, pp_virtual=virtual)
+            state = tr.init_state(seed=7)
+            x, y = tr.put_batch(*make_lm_batch(tokens))
+            state, loss = tr.train_step(state, x, y)
+            results[schedule] = (
+                float(np.mean(np.asarray(loss))),
+                tr.canonical_params(jax.device_get(state.params)))
+        ref_loss, ref_p = results["gpipe"]
+        for schedule in ("1f1b", "zerobubble", "interleaved"):
+            assert abs(results[schedule][0] - ref_loss) < 1e-4, schedule
+            for a, b in zip(jax.tree.leaves(ref_p),
+                            jax.tree.leaves(results[schedule][1])):
+                np.testing.assert_allclose(
+                    np.asarray(b), np.asarray(a), rtol=3e-4, atol=3e-5,
+                    err_msg=schedule)
+
     def test_unknown_schedule_rejected(self, devices):
         mesh = make_mesh(devices[:2], dp=1, sp=1, mp=1, pp=2)
         with pytest.raises(ValueError, match="schedule"):
-            PipelineLMTrainer(_tiny(), mesh, schedule="interleaved")
+            PipelineLMTrainer(_tiny(), mesh, schedule="bogus")
 
     def test_multi_step_loss_decreases(self, devices):
         model = _tiny()
@@ -204,6 +279,89 @@ class TestPipelineEquivalence:
             losses.append(float(np.mean(np.asarray(loss))))
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]
+
+
+class TestPipelineComposition:
+    """K-step scan + dispatch_depth>0 ride the pipeline rung unchanged
+    (round 10): the schedule engines are pure jittable functions, so the
+    multi-step scan body and the async dispatch window compose with any
+    schedule exactly as they do with the dense trainer."""
+
+    def _run(self, devices, schedule, virtual, steps, step_fn):
+        model = _tiny()
+        mesh = make_mesh(devices[:2], dp=1, sp=1, mp=1, pp=2)
+        tr = PipelineLMTrainer(model, mesh, num_micro=4, optimizer=_sgd(),
+                               schedule=schedule, pp_virtual=virtual)
+        state = tr.init_state(seed=7)
+        x, y = tr.put_batch(*make_lm_batch(_tokens()))
+        return step_fn(tr, state, x, y, steps)
+
+    @pytest.mark.parametrize("schedule,virtual", [
+        ("zerobubble", 1),
+        pytest.param("interleaved", 2,
+                     marks=TestPipelineEquivalence._slow),
+    ])
+    def test_multi_step_scan_matches_single_steps(self, devices,
+                                                  schedule, virtual):
+        def singles(tr, state, x, y, k):
+            losses = []
+            for _ in range(k):
+                state, loss = tr.train_step(state, x, y)
+                losses.append(float(np.mean(np.asarray(loss))))
+            return losses, jax.device_get(state.params)
+
+        def scanned(tr, state, x, y, k):
+            run = tr.build_multi_step(k)
+            xs = jnp.stack([x] * k)
+            ys = jnp.stack([y] * k)
+            state, losses = run(state, xs, ys)
+            return ([float(np.mean(np.asarray(l))) for l in losses],
+                    jax.device_get(state.params))
+
+        ref_losses, ref_p = self._run(devices, schedule, virtual, 2,
+                                      singles)
+        got_losses, got_p = self._run(devices, schedule, virtual, 2,
+                                      scanned)
+        np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(ref_p), jax.tree.leaves(got_p)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=3e-4, atol=3e-5)
+
+    def test_dispatch_depth_composes(self, devices):
+        """Driving the pipelined train_step through a DispatchPipeline
+        window must not change the math — only the host-side sync
+        cadence."""
+        from tpu_ddp.train.pipeline import DispatchPipeline
+
+        def sync(tr, state, x, y, k):
+            losses = []
+            for _ in range(k):
+                state, loss = tr.train_step(state, x, y)
+                losses.append(float(np.mean(np.asarray(loss))))
+            return losses, jax.device_get(state.params)
+
+        def async_(tr, state, x, y, k):
+            got = {}
+
+            def harvest(step):
+                return lambda loss: got.setdefault(
+                    step, float(np.mean(np.asarray(loss))))
+
+            pipe = DispatchPipeline(depth=2)
+            for step in range(k):
+                state, loss = tr.train_step(state, x, y)
+                pipe.submit(loss, harvest(step))
+            pipe.drain()
+            assert pipe.stats()["harvested"] == k
+            return ([got[s] for s in range(k)],
+                    jax.device_get(state.params))
+
+        ref_losses, ref_p = self._run(devices, "zerobubble", 1, 3, sync)
+        got_losses, got_p = self._run(devices, "zerobubble", 1, 3, async_)
+        np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(ref_p), jax.tree.leaves(got_p)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-6)
 
 
 class TestPipelineValidation:
@@ -225,3 +383,36 @@ class TestPipelineValidation:
         with pytest.raises(ValueError, match="not divisible"):
             tr.put_batch(np.zeros((6, 32), np.int32),
                          np.zeros((6, 32), np.int32))
+
+    # --- round-10 schedule constraints (mirrored by tune/space.py) ---
+
+    def test_virtual_requires_interleaved(self, devices):
+        mesh = make_mesh(devices[:2], dp=1, sp=1, mp=1, pp=2)
+        for schedule in ("gpipe", "1f1b", "zerobubble"):
+            with pytest.raises(ValueError, match="pp_virtual"):
+                PipelineLMTrainer(_tiny(), mesh, schedule=schedule,
+                                  pp_virtual=2)
+
+    def test_interleaved_layer_divisibility(self, devices):
+        # 4 layers, pp=2, V=4 -> layers % (pp*V) = 4 % 8 != 0
+        mesh = make_mesh(devices[:2], dp=1, sp=1, mp=1, pp=2)
+        with pytest.raises(ValueError, match="pp_virtual"):
+            PipelineLMTrainer(_tiny(), mesh, schedule="interleaved",
+                              pp_virtual=4)
+
+    def test_interleaved_micro_divisibility(self, devices):
+        # interleaved needs num_micro % pp == 0 (work items advance in
+        # groups of pp microbatches)
+        mesh = make_mesh(devices[:2], dp=1, sp=1, mp=1, pp=2)
+        with pytest.raises(ValueError, match="num_micro"):
+            PipelineLMTrainer(_tiny(), mesh, schedule="interleaved",
+                              pp_virtual=2, num_micro=3)
+
+    def test_virtual_requires_replicated_param_layouts(self, devices):
+        # the flat dp-padded ZeRO layouts slice blocks without knowing
+        # about the row permutation; V>1 refuses them
+        mesh = make_mesh(devices[:4], dp=2, sp=1, mp=1, pp=2)
+        with pytest.raises(ValueError, match="replicated"):
+            PipelineLMTrainer(_tiny(), mesh, schedule="interleaved",
+                              pp_virtual=2, num_micro=2,
+                              opt_sharding="zero1")

@@ -623,6 +623,24 @@ def main(argv=None) -> int:
                         "carries an error-feedback residual; int8-noef "
                         "is the ablation without it). Sets "
                         "TPU_DDP_GRAD_COMPRESS for every rank")
+    p.add_argument("--pp-schedule", default=None,
+                   choices=("gpipe", "1f1b", "interleaved", "zerobubble"),
+                   help="pipeline tick schedule for the pp rung "
+                        "(tpu_ddp/parallel/pipeline.py): gpipe (AD of "
+                        "the forward scan), 1f1b (O(pp) activation "
+                        "residency), interleaved (virtual stages, "
+                        "bubble / pp_virtual) or zerobubble (B-weight "
+                        "fills the cooldown). Sets TPU_DDP_PP_SCHEDULE "
+                        "for every rank")
+    p.add_argument("--pp-microbatches", type=int, default=None,
+                   help="microbatches per pipeline step (0 = auto, one "
+                        "per stage). Sets TPU_DDP_PP_MICROBATCHES for "
+                        "every rank")
+    p.add_argument("--pp-virtual", type=int, default=None,
+                   help="virtual stage chunks per physical stage "
+                        "(interleaved schedule only; needs num_layers "
+                        "divisible by pp*pp_virtual). Sets "
+                        "TPU_DDP_PP_VIRTUAL for every rank")
     p.add_argument("--remat", default=None,
                    choices=("none", "blocks", "conv_stages", "dots"),
                    help="activation rematerialization policy "
@@ -679,6 +697,17 @@ def main(argv=None) -> int:
         env["TPU_DDP_DISPATCH_DEPTH"] = str(args.dispatch_depth)
     if args.grad_compress is not None:
         env["TPU_DDP_GRAD_COMPRESS"] = args.grad_compress
+    if args.pp_schedule is not None:
+        env["TPU_DDP_PP_SCHEDULE"] = args.pp_schedule
+    if args.pp_microbatches is not None:
+        if args.pp_microbatches < 0:
+            p.error(f"--pp-microbatches must be >= 0, "
+                    f"got {args.pp_microbatches}")
+        env["TPU_DDP_PP_MICROBATCHES"] = str(args.pp_microbatches)
+    if args.pp_virtual is not None:
+        if args.pp_virtual < 1:
+            p.error(f"--pp-virtual must be >= 1, got {args.pp_virtual}")
+        env["TPU_DDP_PP_VIRTUAL"] = str(args.pp_virtual)
     if args.remat is not None:
         env["TPU_DDP_REMAT"] = args.remat
     if args.act_dtype is not None:

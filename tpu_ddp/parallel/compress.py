@@ -418,3 +418,126 @@ class GradCompressor:
                 jax.tree.structure(comp["residual"]),
                 [e.reshape(r.shape) for e, r in zip(errs, res_leaves)])
         return treedef.unflatten(outs), new_comp
+
+
+# ---------------------------------------------------------------------------
+# Point-to-point edge codec (round 10, MPMD pipeline).
+#
+# The collectives above compress an ALL-REDUCE; an MPMD pipeline edge is
+# a point-to-point handoff of one activation (down) or cotangent (up)
+# tensor per tick. Same wire formats, different shape: no phases, no
+# all_to_all — just encode on the sending stage, ship the reduced
+# payload over DCN, decode on the receiver. Error feedback carries PER
+# EDGE on the sender: each tick's quantization error is added to the
+# next payload on the same edge, so the bias telescopes along the
+# training trajectory exactly as it does for gradients (the edge sees
+# the same microbatch slot every M ticks, and the loss is what
+# accumulates the bias — tests/test_mpmd.py pins the trajectory).
+# ---------------------------------------------------------------------------
+
+
+class EdgeCodec:
+    """Wire codec for ONE directed MPMD edge.
+
+    Stateful on the sender side (int8 stochastic-rounding seed counter
+    + optional error-feedback residual); the receiver only needs
+    :meth:`decode`, which is stateless. The MPMD scheduler is a host
+    loop, so host-held mutable state is the natural form here — unlike
+    the jit-carried ``comp_state`` of the collective compressor.
+
+    ``encode`` returns ``(wire, nbytes)`` where ``wire`` is a dict of
+    arrays that actually travel and ``nbytes`` counts their payload
+    bytes (the honest numerator for the compression-ratio acceptance
+    numbers; fp32 would be ``4 * x.size``).
+    """
+
+    def __init__(self, spec: str = "none", block_size: int = 256,
+                 seed: int = 0):
+        if spec not in SPECS:
+            raise ValueError(
+                f"unknown edge codec spec {spec!r}; available: "
+                f"{list(SPECS)}")
+        self.spec = spec
+        self.is_int8 = spec.startswith("int8")
+        self.error_feedback = spec == "int8"
+        # Kernel host: borrows _quant/_dequant (and block_size
+        # validation) from the collective compressor.
+        self._k = GradCompressor("int8" if self.is_int8 else "none",
+                                 block_size=block_size)
+        self.block_size = self._k.block_size
+        self._seed = np.uint32(seed)
+        self._residual = None   # lazily sized to the edge payload
+        self.bytes_sent = 0     # cumulative wire bytes (stats surface)
+        self.bytes_dense = 0    # what fp32 would have cost
+
+    def describe(self) -> dict:
+        return {"spec": self.spec,
+                "block_size": self.block_size if self.is_int8 else None,
+                "error_feedback": self.error_feedback}
+
+    @property
+    def ratio(self) -> float:
+        """Achieved dense/wire byte ratio so far (1.0 before traffic)."""
+        return (self.bytes_dense / self.bytes_sent
+                if self.bytes_sent else 1.0)
+
+    def reset(self) -> None:
+        """Drop carried state (elastic restart: a new edge peer must
+        not inherit a residual accumulated against the old one)."""
+        self._residual = None
+        self.bytes_sent = 0
+        self.bytes_dense = 0
+
+    # ---- sender --------------------------------------------------------
+
+    def encode(self, x) -> tuple[dict, int]:
+        x = jnp.asarray(x, jnp.float32)
+        self.bytes_dense += 4 * x.size
+        if self.spec == "none":
+            wire = {"kind": "none", "payload": x}
+            nbytes = 4 * x.size
+        elif self.spec == "bf16":
+            wire = {"kind": "bf16",
+                    "payload": GradCompressor._to_wire_bf16(x)}
+            nbytes = 2 * x.size
+        else:
+            wire, nbytes = self._encode_int8(x)
+        self.bytes_sent += nbytes
+        return wire, nbytes
+
+    def _encode_int8(self, x) -> tuple[dict, int]:
+        flat = x.reshape(-1)
+        if self.error_feedback:
+            if (self._residual is None
+                    or self._residual.shape != flat.shape):
+                self._residual = jnp.zeros_like(flat)
+            flat = flat + self._residual
+        qtotal = self._k._qchunk(flat.shape[0])
+        key = jax.random.key(self._seed)
+        self._seed = np.uint32(self._seed + np.uint32(1))
+        q, scale = self._k._quant(self._k._pad_to(flat, qtotal), key)
+        if self.error_feedback:
+            deq = self._k._dequant(q, scale)[:flat.shape[0]]
+            self._residual = flat - deq
+        wire = {"kind": "int8", "q": q, "scale": scale,
+                "shape": tuple(x.shape)}
+        return wire, q.size + 4 * scale.size
+
+    # ---- receiver (stateless) ------------------------------------------
+
+    @staticmethod
+    def decode(wire: dict):
+        kind = wire["kind"]
+        if kind == "none":
+            return wire["payload"]
+        if kind == "bf16":
+            return GradCompressor._from_wire_bf16(wire["payload"])
+        if kind == "int8":
+            shape = wire["shape"]
+            size = int(np.prod(shape)) if shape else 1
+            k = GradCompressor("int8",
+                               block_size=wire["q"].size
+                               // wire["scale"].size)
+            flat = k._dequant(wire["q"], wire["scale"])[:size]
+            return flat.reshape(shape)
+        raise ValueError(f"unknown edge wire kind {kind!r}")

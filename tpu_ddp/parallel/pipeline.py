@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -401,6 +402,513 @@ def pipeline_1f1b_grads(model, params, inputs, targets, *, pp_size: int,
         jnp.float32(0.0),
     )
     (_, _, _, g_blk, g_emb, g_head, loss_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(M + 2 * (S - 1)))
+
+    grads = {"embed": g_emb, "ln_f": g_head["ln_f"],
+             "head": g_head["head"], "blocks": g_blk}
+    return loss_sum, jnp.float32(B * L), grads
+
+
+# ---------------------------------------------------------------------------
+# Interleaved 1F1B (virtual stages) and zero-bubble (split backward)
+# ---------------------------------------------------------------------------
+
+def interleave_permutation(num_layers: int, pp_size: int,
+                           pp_virtual: int) -> np.ndarray:
+    """Dense -> interleaved row permutation for the STACKED block tree.
+
+    Interleaved 1F1B (Megatron virtual stages, arXiv:2104.04473 §2.2 —
+    reimplemented from the schedule description) splits the layer stack
+    into ``pp * pp_virtual`` chunks of ``Lc = L / (pp * pp_virtual)``
+    layers and assigns stage ``s`` the chunks ``{c*pp + s : c < V}``.
+    The stacked tree shards CONTIGUOUSLY over ``pp``, so stage ``s``'s
+    rows must hold its V chunks back to back: stacked row
+    ``p = s*(L/pp) + c*Lc + j`` holds dense layer ``(c*pp + s)*Lc + j``.
+    Returns ``perm`` with ``stacked_interleaved = dense_stacked[perm]``;
+    ``pp_virtual == 1`` is the identity. Invert with ``np.argsort``.
+    """
+    L, S, V = num_layers, pp_size, pp_virtual
+    if V < 1:
+        raise ValueError(f"pp_virtual must be >= 1, got {V}")
+    if L % (S * V):
+        raise ValueError(f"num_layers={L} not divisible by "
+                         f"pp*pp_virtual={S * V}")
+    Lc = L // (S * V)
+    perm = np.empty(L, np.int64)
+    p = 0
+    for s in range(S):
+        for c in range(V):
+            for j in range(Lc):
+                perm[p] = (c * S + s) * Lc + j
+                p += 1
+    return perm
+
+
+def permute_stacked_blocks(params: dict, perm) -> dict:
+    """Reorder the stacked block rows by ``perm`` (host or device tree).
+    Leaves every other entry untouched; apply ``np.argsort(perm)`` to
+    undo (checkpoints always store the DENSE order)."""
+    idx = np.asarray(perm)
+    out = dict(params)
+    out["blocks"] = jax.tree.map(lambda x: x[idx], params["blocks"])
+    return out
+
+
+def _make_run_chunk(model, blocks, pos, rng, pp_axis: str, pp_size: int,
+                    pp_virtual: int):
+    """One VIRTUAL chunk of this stage's layer rows as
+    ``(x, mb_idx, c) -> y``. The stage's stacked slice holds its V
+    chunks contiguously (:func:`interleave_permutation`): chunk ``c``
+    occupies rows ``[c*Lc, (c+1)*Lc)`` and its global dense layers are
+    ``(c*pp + stage)*Lc + local`` — dropout keys fold the DENSE layer
+    index so masks agree with every other schedule and the dense model.
+    """
+    layers_per_stage = jax.tree.leaves(blocks)[0].shape[0]
+    Lc = layers_per_stage // pp_virtual
+    stage = lax.axis_index(pp_axis)
+
+    def run_chunk(x, mb_idx, c):
+        blocks_c = jax.tree.map(
+            lambda a: lax.dynamic_slice_in_dim(a, c * Lc, Lc, 0), blocks)
+        base = (c * pp_size + stage) * Lc
+
+        def body(h, sl):
+            layer, local_i = sl
+            r = None
+            if rng is not None and model.dropout_rate > 0.0:
+                r = jax.random.fold_in(jax.random.fold_in(rng, mb_idx),
+                                       base + local_i)
+            h, _ = model.block_apply_aux(layer, h, pos, r)
+            return h, None
+        from tpu_ddp.memory import effective_remat, wrap_stage
+        remat = effective_remat(model.remat_policy, "attn")
+        if remat != "none":
+            body = wrap_stage(body, remat, prevent_cse=False)
+        h, _ = lax.scan(body, x, (blocks_c, jnp.arange(Lc)))
+        return h
+
+    return run_chunk
+
+
+def pipeline_interleaved_grads(model, params, inputs, targets, *,
+                               pp_size: int, num_micro: int,
+                               pp_virtual: int,
+                               pp_axis: str = PIPE_AXIS, rng=None,
+                               skip_invalid: bool = True):
+    """Interleaved 1F1B with ``pp_virtual`` chunks per stage (Megatron
+    virtual stages, arXiv:2104.04473 — reimplemented from the schedule
+    description, not from any code). Same contract as
+    :func:`pipeline_1f1b_grads`; ``params["blocks"]`` must hold this
+    stage's rows in the :func:`interleave_permutation` order.
+
+    Schedule, expressed SPMD: the forward stream is a single sequence of
+    work items ``k`` — item ``k`` is chunk ``(k % (S*V)) // S`` of
+    microbatch ``(k // (S*V)) * S + k % S`` (microbatches travel in
+    groups of S, hence ``num_micro % pp == 0``). Stage ``s`` forwards
+    item ``t - s`` at tick ``t``, so the item arriving from the ring
+    (stage S-1's output S ticks ago, item ``k - S``) is exactly the
+    previous chunk of the same microbatch — chunk continuity by
+    construction. The backward stream walks chunks in reverse with lag
+    ``D + S - 2`` (``D = S*V``): stage ``s`` backwards item
+    ``t - (D+S-2) + s`` whose effective chunk is ``V-1 - slot``. At
+    ``V == 1`` every index degenerates to plain 1F1B. T = M*V + D + S - 2
+    ticks; per-item compute is 1/V of a 1F1B item, so the bubble
+    fraction drops to ``(pp-1)/(M*V + pp-1)`` — V x smaller for V x more
+    in-flight activations (ring buffer 2*S*V - 1 chunk slots vs 2*pp-1).
+
+    ``skip_invalid``: wrap the chunk forward/backward in ``lax.cond`` so
+    out-of-range ticks genuinely SKIP compute instead of masking garbage
+    (safe only when stage bodies contain no collectives — pure dp x pp;
+    the trainer disables it under sp/tp/ep).
+    """
+    B, L = inputs.shape
+    model.check_seq_len(L)
+    if B % num_micro:
+        raise ValueError(f"local batch {B} not divisible by "
+                         f"num_micro={num_micro}")
+    S, M, V = pp_size, num_micro, pp_virtual
+    if M % S:
+        raise ValueError(f"interleaved schedule needs num_micro "
+                         f"divisible by pp: {M} % {S} != 0")
+    mb = B // num_micro
+    cd = model.compute_dtype
+    stage = lax.axis_index(pp_axis)
+    pos = model._positions(L)
+    D = S * V          # work items per microbatch group
+    MV = M * V         # total forward (= backward) items per stage
+    K = 2 * D - 1      # saved-input slots: max fwd->bwd gap is 2D-2 ticks
+    lag = D + S - 2    # backward stream offset (V=1: the 1F1B 2(S-1))
+
+    micro = inputs.reshape(M, mb, L)
+    tmicro = targets.reshape(M, mb, L)
+    run_chunk = _make_run_chunk(model, params["blocks"], pos, rng,
+                                pp_axis, S, V)
+
+    def embed_mb(table, mb_idx):
+        toks = lax.dynamic_index_in_dim(micro, mb_idx, 0, keepdims=False)
+        x = table[toks].astype(cd)
+        if rng is not None and model.dropout_rate > 0.0:
+            k = jax.random.fold_in(jax.random.fold_in(rng, mb_idx),
+                                   model.num_layers)
+            x = model._dropout(x, k)
+        return x
+
+    def head_loss(hp, y, tgt):
+        from tpu_ddp.ops.loss import softmax_cross_entropy
+        logits = model.head_apply(hp, y)
+        nll = softmax_cross_entropy(
+            logits.reshape(-1, logits.shape[-1]), tgt.reshape(-1))
+        return jnp.sum(nll)
+
+    head_params = {"ln_f": params["ln_f"], "head": params["head"]}
+    perm_down = [(i, (i + 1) % S) for i in range(S)]
+    perm_up = [(i, (i - 1) % S) for i in range(S)]
+
+    def run_chunk_with(blocks, x, mb_idx, c):
+        return _make_run_chunk(model, blocks, pos, rng, pp_axis, S,
+                               V)(x, mb_idx, c)
+
+    def masked_add(acc, g, valid):
+        return jax.tree.map(
+            lambda a, gg: a + jnp.where(valid, gg, 0).astype(a.dtype),
+            acc, g)
+
+    def decomp(k):
+        """Work item -> (microbatch, chunk slot): k = g*D + c*S + i with
+        microbatch g*S + i."""
+        g, r = k // D, k % D
+        return g * S + r % S, r // S
+
+    def tick(carry, t):
+        fwd_in, bwd_in, buf, g_blk, g_emb, g_head, loss_sum = carry
+        kf = t - stage
+        kb = t - lag + stage
+        f_valid = (0 <= kf) & (kf < MV)
+        b_valid = (0 <= kb) & (kb < MV)
+        kf_safe = jnp.clip(kf, 0, MV - 1)
+        kb_safe = jnp.clip(kb, 0, MV - 1)
+        m_f, c_f = decomp(kf_safe)
+        m_b, cs_b = decomp(kb_safe)
+        c_b = (V - 1) - cs_b  # the backward walks chunks in reverse
+        # The backward item's own forward item (same microbatch, chunk
+        # c_b) — locates its saved input in the ring buffer.
+        kf_of_b = kb_safe + (c_b - cs_b) * S
+
+        # ---- forward micro-step: embed-inject at (stage 0, chunk 0);
+        # everywhere else the ring delivers the previous chunk's output.
+        x_in = jnp.where((stage == 0) & (c_f == 0),
+                         embed_mb(params["embed"], m_f), fwd_in)
+        if skip_invalid:
+            y = lax.cond(f_valid,
+                         lambda xx: run_chunk(xx, m_f, c_f),
+                         lambda xx: jnp.zeros_like(xx), x_in)
+        else:
+            y = run_chunk(x_in, m_f, c_f)
+        buf = jnp.where(f_valid,
+                        lax.dynamic_update_index_in_dim(
+                            buf, x_in, kf_safe % K, 0),
+                        buf)
+
+        # ---- head at the last stage when the forward item is the FINAL
+        # chunk; the same tick's backward item is that microbatch's
+        # chunk V-1 (kf - kb = (V-1)*S by construction), so dy_head
+        # feeds the backward stream directly, as in plain 1F1B.
+        tgt = lax.dynamic_index_in_dim(tmicro, m_f, 0, keepdims=False)
+        at_last = stage == S - 1
+
+        def head_fwd_bwd(y, tgt):
+            nll_sum, head_vjp = jax.vjp(
+                lambda hp, yy: head_loss(hp, yy, tgt), head_params, y)
+            d_hp, dy_head = head_vjp(jnp.float32(1.0))
+            return nll_sum, d_hp, dy_head
+
+        def head_skip(y, tgt):
+            return (jnp.float32(0.0),
+                    jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype),
+                                 head_params),
+                    jnp.zeros_like(y))
+
+        nll_sum, d_hp, dy_head = lax.cond(
+            at_last & f_valid & (c_f == V - 1),
+            head_fwd_bwd, head_skip, y, tgt)
+        loss_sum = loss_sum + nll_sum
+        g_head = jax.tree.map(lambda a, gg: a + gg.astype(a.dtype),
+                              g_head, d_hp)
+
+        # ---- backward micro-step: recompute-vjp of chunk c_b from its
+        # saved input (stored kf_of_b's tick; the stage-S-1/chunk-V-1
+        # case reads the slot written THIS tick — write precedes read).
+        x_saved = lax.dynamic_index_in_dim(buf, kf_of_b % K, 0,
+                                           keepdims=False)
+        d_in = jnp.where(at_last & (cs_b == 0), dy_head.astype(cd),
+                         bwd_in)
+
+        def bwd_real(xx, dd):
+            _, stage_vjp = jax.vjp(
+                lambda blk, x2: run_chunk_with(blk, x2, m_b, c_b),
+                params["blocks"], xx)
+            return stage_vjp(dd)
+
+        def bwd_skip(xx, dd):
+            return (jax.tree.map(
+                lambda p: jnp.zeros(p.shape, p.dtype),
+                params["blocks"]), jnp.zeros_like(xx))
+
+        if skip_invalid:
+            d_blk, dx = lax.cond(b_valid, bwd_real, bwd_skip,
+                                 x_saved, d_in)
+        else:
+            d_blk, dx = bwd_real(x_saved, d_in)
+        g_blk = masked_add(g_blk, d_blk, b_valid)
+
+        # Embed grad at (stage 0, backward chunk 0): dx there is
+        # d(embed output) of microbatch m_b — scatter-add per tick,
+        # dropout transposed from its key (the 1F1B pattern).
+        toks_b = lax.dynamic_index_in_dim(micro, m_b, 0, keepdims=False)
+        dxe = dx.astype(jnp.float32)
+        if rng is not None and model.dropout_rate > 0.0:
+            k = jax.random.fold_in(jax.random.fold_in(rng, m_b),
+                                   model.num_layers)
+            keep = 1.0 - model.dropout_rate
+            mask = jax.random.bernoulli(k, keep, dx.shape)
+            dxe = jnp.where(mask, dxe / keep, 0.0)
+        contrib = jnp.where(b_valid & (stage == 0) & (c_b == 0),
+                            dxe, 0.0)
+        g_emb = g_emb.at[toks_b.reshape(-1)].add(
+            contrib.reshape(-1, contrib.shape[-1]))
+
+        return ((lax.ppermute(y, pp_axis, perm_down),
+                 lax.ppermute(dx, pp_axis, perm_up),
+                 buf, g_blk, g_emb, g_head, loss_sum), None)
+
+    zeros_f32 = lambda tree: jax.tree.map(  # noqa: E731
+        lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+    carry0 = (
+        jnp.zeros((mb, L, model.d_model), cd),       # fwd ring
+        jnp.zeros((mb, L, model.d_model), cd),       # bwd ring
+        jnp.zeros((K, mb, L, model.d_model), cd),    # saved chunk inputs
+        zeros_f32(params["blocks"]),
+        zeros_f32(params["embed"]),
+        zeros_f32(head_params),
+        jnp.float32(0.0),
+    )
+    (_, _, _, g_blk, g_emb, g_head, loss_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(MV + D + S - 2))
+
+    grads = {"embed": g_emb, "ln_f": g_head["ln_f"],
+             "head": g_head["head"], "blocks": g_blk}
+    return loss_sum, jnp.float32(B * L), grads
+
+
+def pipeline_zerobubble_grads(model, params, inputs, targets, *,
+                              pp_size: int, num_micro: int,
+                              pp_axis: str = PIPE_AXIS, rng=None,
+                              skip_invalid: bool = True):
+    """Zero-bubble 1F1B (ZB-H1 family, Qi et al., arXiv:2401.10241 —
+    reimplemented from the schedule description, not from any code):
+    the backward splits into B-input (cotangent propagation, on the
+    1F1B backward clock ``b = t - 2(pp-1) + s`` — it sits on the
+    critical path of upstream stages) and B-weight (the stage's weight
+    gradient, deferred to the UNIFORM clock ``w = t - 2(pp-1)``). The
+    deferral moves every stage's weight-gradient work off the warmup
+    ticks — between the first backward reaching a stage and the ramp
+    being full, stages run F + B-input only — so the lockstep tick cost
+    there drops from 3 to 2 work units and the analytic bubble fraction
+    falls from ``2(pp-1)/(M + 2(pp-1))`` to ``2(pp-1)/(3M + 2(pp-1))``.
+    T = M + 2(pp-1) ticks, unchanged.
+
+    Same contract and layout as :func:`pipeline_1f1b_grads` (linear
+    stage order; no virtual stages — zero-bubble extends plain 1F1B).
+    Each B-input stores its ``(saved input, output cotangent)`` pair in
+    a ``pp``-slot ring for the B-weight that consumes it up to ``s``
+    ticks later, costing one extra stage recompute per item (the
+    recompute-vjp runs once per half). ``skip_invalid`` as in
+    :func:`pipeline_interleaved_grads`.
+    """
+    B, L = inputs.shape
+    model.check_seq_len(L)
+    if B % num_micro:
+        raise ValueError(f"local batch {B} not divisible by "
+                         f"num_micro={num_micro}")
+    mb = B // num_micro
+    S, M = pp_size, num_micro
+    cd = model.compute_dtype
+    stage = lax.axis_index(pp_axis)
+    pos = model._positions(L)
+    K = 2 * S - 1   # saved-input slots (the 1F1B fwd->bwd gap)
+    W = S           # (input, cotangent) slots: B-input -> B-weight gap
+
+    micro = inputs.reshape(M, mb, L)
+    tmicro = targets.reshape(M, mb, L)
+    run_stage = _make_run_stage(model, params["blocks"], pos, rng, pp_axis)
+
+    def embed_mb(table, mb_idx):
+        toks = lax.dynamic_index_in_dim(micro, mb_idx, 0, keepdims=False)
+        x = table[toks].astype(cd)
+        if rng is not None and model.dropout_rate > 0.0:
+            k = jax.random.fold_in(jax.random.fold_in(rng, mb_idx),
+                                   model.num_layers)
+            x = model._dropout(x, k)
+        return x
+
+    def head_loss(hp, y, tgt):
+        from tpu_ddp.ops.loss import softmax_cross_entropy
+        logits = model.head_apply(hp, y)
+        nll = softmax_cross_entropy(
+            logits.reshape(-1, logits.shape[-1]), tgt.reshape(-1))
+        return jnp.sum(nll)
+
+    head_params = {"ln_f": params["ln_f"], "head": params["head"]}
+    perm_down = [(i, (i + 1) % S) for i in range(S)]
+    perm_up = [(i, (i - 1) % S) for i in range(S)]
+
+    def run_stage_with(blocks, x, mb_idx):
+        return _make_run_stage(model, blocks, pos, rng, pp_axis)(x, mb_idx)
+
+    def masked_add(acc, g, valid):
+        return jax.tree.map(
+            lambda a, gg: a + jnp.where(valid, gg, 0).astype(a.dtype),
+            acc, g)
+
+    def tick(carry, t):
+        (fwd_in, bwd_in, buf, wbuf_x, wbuf_d, g_blk, g_emb, g_head,
+         loss_sum) = carry
+        f = t - stage
+        b = t - 2 * (S - 1) + stage     # B-input clock (1F1B backward)
+        w = t - 2 * (S - 1)             # B-weight clock, stage-uniform
+        f_valid = (0 <= f) & (f < M)
+        b_valid = (0 <= b) & (b < M)
+        w_valid = (0 <= w) & (w < M)
+        f_safe = jnp.clip(f, 0, M - 1)
+        b_safe = jnp.clip(b, 0, M - 1)
+        w_safe = jnp.clip(w, 0, M - 1)
+
+        # ---- forward micro-step (identical to 1F1B).
+        x_in = jnp.where(stage == 0, embed_mb(params["embed"], f_safe),
+                         fwd_in)
+        if skip_invalid:
+            y = lax.cond(f_valid, lambda xx: run_stage(xx, f_safe),
+                         lambda xx: jnp.zeros_like(xx), x_in)
+        else:
+            y = run_stage(x_in, f_safe)
+        buf = jnp.where(f_valid,
+                        lax.dynamic_update_index_in_dim(
+                            buf, x_in, f_safe % K, 0),
+                        buf)
+
+        # ---- head at the last stage (f == b there, as in 1F1B).
+        tgt = lax.dynamic_index_in_dim(tmicro, f_safe, 0, keepdims=False)
+        at_last = stage == S - 1
+
+        def head_fwd_bwd(y, tgt):
+            nll_sum, head_vjp = jax.vjp(
+                lambda hp, yy: head_loss(hp, yy, tgt), head_params, y)
+            d_hp, dy_head = head_vjp(jnp.float32(1.0))
+            return nll_sum, d_hp, dy_head
+
+        def head_skip(y, tgt):
+            return (jnp.float32(0.0),
+                    jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype),
+                                 head_params),
+                    jnp.zeros_like(y))
+
+        nll_sum, d_hp, dy_head = lax.cond(at_last & f_valid,
+                                          head_fwd_bwd, head_skip, y, tgt)
+        loss_sum = loss_sum + nll_sum
+        g_head = jax.tree.map(lambda a, gg: a + gg.astype(a.dtype),
+                              g_head, d_hp)
+
+        # ---- B-input: cotangent only (vjp w.r.t. x, blocks closed
+        # over) — the half that feeds the upstream stage's next tick.
+        x_saved = lax.dynamic_index_in_dim(buf, b_safe % K, 0,
+                                           keepdims=False)
+        d_in = jnp.where(at_last, dy_head.astype(cd), bwd_in)
+
+        def binput_real(xx, dd):
+            _, in_vjp = jax.vjp(
+                lambda x2: run_stage_with(params["blocks"], x2, b_safe),
+                xx)
+            (dx,) = in_vjp(dd)
+            return dx
+
+        if skip_invalid:
+            dx = lax.cond(b_valid, binput_real,
+                          lambda xx, dd: jnp.zeros_like(xx),
+                          x_saved, d_in)
+        else:
+            dx = binput_real(x_saved, d_in)
+        # Stash (input, output cotangent) for this item's deferred
+        # B-weight, up to stage-index ticks later (slot reuse is safe:
+        # item b+S's B-input lands strictly after item b's B-weight).
+        wbuf_x = jnp.where(b_valid,
+                           lax.dynamic_update_index_in_dim(
+                               wbuf_x, x_saved, b_safe % W, 0),
+                           wbuf_x)
+        wbuf_d = jnp.where(b_valid,
+                           lax.dynamic_update_index_in_dim(
+                               wbuf_d, d_in, b_safe % W, 0),
+                           wbuf_d)
+
+        # ---- B-weight: the deferred weight-gradient half (vjp w.r.t.
+        # blocks), consuming the stashed pair. At stage 0 it reads the
+        # slot written THIS tick (w == b there) — write precedes read.
+        x_w = lax.dynamic_index_in_dim(wbuf_x, w_safe % W, 0,
+                                       keepdims=False)
+        d_w = lax.dynamic_index_in_dim(wbuf_d, w_safe % W, 0,
+                                       keepdims=False)
+
+        def bweight_real(xx, dd):
+            _, wt_vjp = jax.vjp(
+                lambda blk: run_stage_with(blk, xx, w_safe),
+                params["blocks"])
+            (d_blk,) = wt_vjp(dd)
+            return d_blk
+
+        def bweight_skip(xx, dd):
+            return jax.tree.map(
+                lambda p: jnp.zeros(p.shape, p.dtype), params["blocks"])
+
+        if skip_invalid:
+            d_blk = lax.cond(w_valid, bweight_real, bweight_skip,
+                             x_w, d_w)
+        else:
+            d_blk = bweight_real(x_w, d_w)
+        g_blk = masked_add(g_blk, d_blk, w_valid)
+
+        # Embed grad at stage 0 from the B-input cotangent (1F1B
+        # pattern: per-tick scatter-add, dropout transposed by key).
+        toks_b = lax.dynamic_index_in_dim(micro, b_safe, 0,
+                                          keepdims=False)
+        dxe = dx.astype(jnp.float32)
+        if rng is not None and model.dropout_rate > 0.0:
+            k = jax.random.fold_in(jax.random.fold_in(rng, b_safe),
+                                   model.num_layers)
+            keep = 1.0 - model.dropout_rate
+            mask = jax.random.bernoulli(k, keep, dx.shape)
+            dxe = jnp.where(mask, dxe / keep, 0.0)
+        contrib = jnp.where(b_valid & (stage == 0), dxe, 0.0)
+        g_emb = g_emb.at[toks_b.reshape(-1)].add(
+            contrib.reshape(-1, contrib.shape[-1]))
+
+        return ((lax.ppermute(y, pp_axis, perm_down),
+                 lax.ppermute(dx, pp_axis, perm_up),
+                 buf, wbuf_x, wbuf_d, g_blk, g_emb, g_head, loss_sum),
+                None)
+
+    zeros_f32 = lambda tree: jax.tree.map(  # noqa: E731
+        lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+    carry0 = (
+        jnp.zeros((mb, L, model.d_model), cd),       # fwd ring
+        jnp.zeros((mb, L, model.d_model), cd),       # bwd ring
+        jnp.zeros((K, mb, L, model.d_model), cd),    # saved inputs
+        jnp.zeros((W, mb, L, model.d_model), cd),    # B-weight inputs
+        jnp.zeros((W, mb, L, model.d_model), cd),    # B-weight cotangents
+        zeros_f32(params["blocks"]),
+        zeros_f32(params["embed"]),
+        zeros_f32(head_params),
+        jnp.float32(0.0),
+    )
+    (_, _, _, _, _, g_blk, g_emb, g_head, loss_sum), _ = lax.scan(
         tick, carry0, jnp.arange(M + 2 * (S - 1)))
 
     grads = {"embed": g_emb, "ln_f": g_head["ln_f"],

@@ -120,11 +120,18 @@ class ShardingPlan:
     opt_specs: Any
     comp_specs: Any = None
     batch_spec: Any = dataclasses.field(default_factory=lambda: P(DATA_AXIS))
+    # Per-stage layout metadata (round 10): specs alone cannot tell an
+    # interleaved-virtual-stage row order from the linear one — both are
+    # P(pp, ...) over identical shapes — so a plan carries the stage
+    # layout explicitly and compatibility REFUSES across different row
+    # orders instead of silently mixing layers. None = linear stages
+    # (every pre-round-10 plan decodes to None and stays compatible).
+    stage_layout: Any = None
 
     # -- serialization ----------------------------------------------------
 
     def to_json(self) -> str:
-        return json.dumps({
+        obj = {
             "version": 1,
             "strategy": self.strategy,
             "mesh_axes": [[n, s] for n, s in self.mesh_axes],
@@ -132,7 +139,12 @@ class ShardingPlan:
             "opt_specs": encode_spec_tree(self.opt_specs),
             "comp_specs": encode_spec_tree(self.comp_specs),
             "batch_spec": encode_spec_tree(self.batch_spec),
-        }, indent=2, sort_keys=True)
+        }
+        if self.stage_layout is not None:
+            # Written only when set: version stays 1 and plans from
+            # linear-stage trainers are byte-identical to pre-round-10.
+            obj["stage_layout"] = encode_spec_tree(self.stage_layout)
+        return json.dumps(obj, indent=2, sort_keys=True)
 
     @classmethod
     def from_json(cls, text: str) -> "ShardingPlan":
@@ -147,6 +159,7 @@ class ShardingPlan:
             opt_specs=decode_spec_tree(obj["opt_specs"]),
             comp_specs=decode_spec_tree(obj["comp_specs"]),
             batch_spec=decode_spec_tree(obj["batch_spec"]),
+            stage_layout=decode_spec_tree(obj.get("stage_layout")),
         )
 
     def save(self, directory: str) -> str:
@@ -203,11 +216,13 @@ class ShardingPlan:
         return broadcast_shardings(mesh, specs, tree)
 
     def compatible_with(self, other: "ShardingPlan") -> bool:
-        """Same layout contract (strategy + specs), ANY world size."""
+        """Same layout contract (strategy + specs + stage row order),
+        ANY world size."""
         return (self.strategy == other.strategy
                 and self.param_specs == other.param_specs
                 and self.opt_specs == other.opt_specs
-                and self.comp_specs == other.comp_specs)
+                and self.comp_specs == other.comp_specs
+                and self.stage_layout == other.stage_layout)
 
     def __eq__(self, other) -> bool:
         if not isinstance(other, ShardingPlan):

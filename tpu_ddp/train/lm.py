@@ -188,7 +188,8 @@ class _MeshTrainer:
             param_specs=self._param_specs,
             opt_specs=self._opt_specs,
             comp_specs=None,
-            batch_spec=P((DATA_AXIS, EXPERT_AXIS), SEQ_AXIS))
+            batch_spec=P((DATA_AXIS, EXPERT_AXIS), SEQ_AXIS),
+            stage_layout=getattr(self, "_stage_layout", None))
 
     # ---- checkpoint / resume (no reference equivalent, SURVEY.md §5) ---
 
@@ -213,6 +214,7 @@ class _MeshTrainer:
             opt_state = self.zero3.canonicalize_opt_host(opt_state)
         elif getattr(self, "opt_zero1", False):
             opt_state = self.optimizer.canonicalize_opt_host(opt_state)
+        params, opt_state = self._to_canonical_host(params, opt_state)
         tree = {"params": params, "opt_state": opt_state,
                 "step": np.int64(state.step)}
         # The layout contract rides next to the steps: a restore onto a
@@ -263,6 +265,7 @@ class _MeshTrainer:
         template = {**shapes, "step": np.int64(0)}
         restored, _ = ckpt.restore_checkpoint(directory, template, step)
         params, opt_state = restored["params"], restored["opt_state"]
+        params, opt_state = self._from_canonical_host(params, opt_state)
         if getattr(self, "is_fsdp", False):
             params = self.zero3.shard_params(params)
             opt_state = self.zero3.flatten_opt(opt_state)
@@ -276,6 +279,75 @@ class _MeshTrainer:
     def _gather_to_host(self, tree):
         from tpu_ddp.utils.checkpoint import gather_tree_to_host
         return gather_tree_to_host(tree, NamedSharding(self.mesh, P()))
+
+    def _to_canonical_host(self, params, opt_state):
+        """Trainer layout -> canonical on-disk layout (identity here;
+        the interleaved pipeline unpermutes its stacked layer rows)."""
+        return params, opt_state
+
+    def _from_canonical_host(self, params, opt_state):
+        """Inverse of :meth:`_to_canonical_host` at restore time."""
+        return params, opt_state
+
+    # ---- K-step scan (engine.py's multi-step contract, LM rung) -------
+
+    def build_multi_step(self, k: int):
+        """One jitted program scanning ``k`` train steps: batches arrive
+        stacked on a leading ``k`` axis, losses come back stacked, and
+        the host dispatches once per ``k`` steps — the engine.Trainer
+        ``build_multi_step`` contract on the LM/pipeline rung. Per-step
+        extras (the dropout key) are folded host-side for each scanned
+        step from ``state.step``, so a K-step program advances the key
+        sequence exactly as ``k`` single steps do (resume-exact).
+        Compiled programs are memoized per ``k``."""
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        cache = getattr(self, "_multi_step_cache", None)
+        if cache is None:
+            cache = self._multi_step_cache = {}
+        if k not in cache:
+            batch_spec = P((DATA_AXIS, EXPERT_AXIS), SEQ_AXIS)
+            extra_specs = self._extra_in_specs()
+
+            def body(params, opt_state, inputs_k, targets_k, *extras_k):
+                def step(carry, xs):
+                    p, o = carry
+                    p, o, mean = self._base_step(p, o, xs[0], xs[1],
+                                                 *xs[2:])
+                    return (p, o), mean
+                (p, o), means = lax.scan(
+                    step, (params, opt_state),
+                    (inputs_k, targets_k, *extras_k))
+                return p, o, means
+
+            mapped = jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(self._param_specs, self._opt_specs,
+                          P(None, *tuple(batch_spec)),
+                          P(None, *tuple(batch_spec)),
+                          *tuple(P(None, *tuple(s))
+                                 for s in extra_specs)),
+                out_specs=(self._param_specs, self._opt_specs,
+                           P(None, *tuple(batch_spec))),
+                check_vma=False,
+            )
+            cache[k] = jax.jit(mapped, donate_argnums=(0, 1))
+
+        stepped = cache[k]
+
+        def run(state: LMTrainState, inputs_k, targets_k):
+            rows = [self._extra_args(
+                dataclasses.replace(state, step=state.step + i))
+                for i in range(k)]
+            extras = (tuple(jnp.stack(col) for col in zip(*rows))
+                      if rows and rows[0] else ())
+            params, opt_state, losses = stepped(
+                state.params, state.opt_state, inputs_k, targets_k,
+                *extras)
+            return (LMTrainState(params, opt_state, state.step + k),
+                    losses)
+
+        return run
 
 
 class LMTrainer(_MeshTrainer):
@@ -703,7 +775,7 @@ class PipelineLMTrainer(_MeshTrainer):
                  opt_sharding: str = "replicated",
                  param_sharding: str = "replicated",
                  clip_grad_norm: float | None = None,
-                 sp_mode: str = "ring"):
+                 sp_mode: str = "ring", pp_virtual: int = 1):
         from tpu_ddp.parallel.pipeline import pipeline_param_specs
         if clip_grad_norm is not None and clip_grad_norm <= 0:
             raise ValueError(f"clip_grad_norm must be > 0, got "
@@ -743,10 +815,57 @@ class PipelineLMTrainer(_MeshTrainer):
         # scheduled one-forward-one-backward with recompute-vjp —
         # residency O(pp), the long-batch memory lever
         # (tpu_ddp/parallel/pipeline.py:pipeline_1f1b_grads).
-        if schedule not in ("gpipe", "1f1b"):
-            raise ValueError(f"unknown schedule {schedule!r}; "
-                             "choose 'gpipe' or '1f1b'")
+        # "interleaved": 1F1B with pp_virtual chunks per stage — the
+        # bubble shrinks V x for V x more in-flight chunk activations.
+        # "zerobubble": 1F1B with the backward split into B-input /
+        # B-weight, the weight half deferred off the warmup ticks.
+        if schedule not in ("gpipe", "1f1b", "interleaved", "zerobubble"):
+            raise ValueError(f"unknown schedule {schedule!r}; choose "
+                             "'gpipe', '1f1b', 'interleaved' or "
+                             "'zerobubble'")
         self.schedule = schedule
+        if pp_virtual < 1:
+            raise ValueError(f"pp_virtual must be >= 1, got {pp_virtual}")
+        if pp_virtual > 1 and schedule != "interleaved":
+            raise ValueError(
+                f"pp_virtual={pp_virtual} only applies to "
+                "schedule='interleaved' (zero-bubble extends plain 1F1B;"
+                " gpipe/1f1b run one chunk per stage)")
+        self.pp_virtual = pp_virtual
+        self._layer_perm = None
+        self._stage_layout = None
+        if schedule == "interleaved":
+            if model.num_layers % (self.pp * pp_virtual):
+                raise ValueError(
+                    f"interleaved schedule needs num_layers divisible "
+                    f"by pp*pp_virtual: {model.num_layers} % "
+                    f"{self.pp * pp_virtual} != 0")
+            if self.num_micro % self.pp:
+                raise ValueError(
+                    f"interleaved schedule needs num_micro divisible "
+                    f"by pp: {self.num_micro} % {self.pp} != 0")
+            if pp_virtual > 1:
+                from tpu_ddp.parallel.pipeline import \
+                    interleave_permutation
+                self._layer_perm = interleave_permutation(
+                    model.num_layers, self.pp, pp_virtual)
+                # Rows are re-ordered, so the flat slicing the sharded
+                # layouts do is no longer layer-aligned on disk; the
+                # plan records the layout so restore/reshard onto a
+                # different schedule cannot silently mix layer orders
+                # (parallel/redistribute.py:ShardingPlan.stage_layout).
+                self._stage_layout = {
+                    "kind": "interleaved", "pp": self.pp,
+                    "pp_virtual": pp_virtual,
+                    "num_layers": model.num_layers}
+        if pp_virtual > 1 and (opt_sharding != "replicated"
+                               or param_sharding != "replicated"):
+            raise ValueError(
+                "pp_virtual > 1 re-orders the stacked layer rows "
+                "(interleave_permutation); the sharded-optimizer "
+                "layouts (zero1/zero2/fsdp) slice those rows flat and "
+                "are not permutation-aware — use replicated opt/param "
+                "sharding with virtual stages")
         # Per-step dropout keys: seed + step, folded host-side like the
         # LMTrainer's (resume-exact); inert when dropout_rate == 0.
         self._dropout_key = jax.random.key(dropout_seed)
@@ -795,6 +914,12 @@ class PipelineLMTrainer(_MeshTrainer):
                 "exists where the accumulation buffer does")
         from tpu_ddp.ops.optim import Adafactor
         from tpu_ddp.parallel.pipeline import stack_block_params
+        if pp_virtual > 1 and isinstance(self.optimizer, Adafactor):
+            raise ValueError(
+                "pp_virtual > 1 does not compose with Adafactor: the "
+                "per-cell factored state has no params-shaped host form "
+                "to carry through the interleave permutation at "
+                "checkpoint time; use AdamW/SGD with virtual stages")
         if self.opt_zero1:
             from tpu_ddp.parallel.zero import FactoredZeRO1, ZeRO1
             self._params_template = jax.eval_shape(
@@ -884,8 +1009,11 @@ class PipelineLMTrainer(_MeshTrainer):
         """Same seed -> same parameters as the dense model, re-laid-out:
         blocks stacked on a leading layer axis, sharded over pp (and
         under fsdp additionally flattened into dp shards per cell)."""
-        from tpu_ddp.parallel.pipeline import stack_block_params
+        from tpu_ddp.parallel.pipeline import (permute_stacked_blocks,
+                                               stack_block_params)
         params = stack_block_params(self.model.init(jax.random.key(seed)))
+        if self._layer_perm is not None:
+            params = permute_stacked_blocks(params, self._layer_perm)
         if self.is_fsdp:
             params = self.zero3.shard_params(params)
             return self._place_state(params, self.zero3.init(params))
@@ -899,6 +1027,36 @@ class PipelineLMTrainer(_MeshTrainer):
         proto = dict(params)
         proto["blocks"] = jax.tree.map(lambda p: p[0], params["blocks"])
         return self.optimizer.decay_mask(proto)
+
+    def canonical_params(self, params):
+        """Stacked params in DENSE layer order — identity except under
+        virtual stages, whose stacked rows live in the
+        interleave_permutation order (host or device tree)."""
+        if self._layer_perm is None:
+            return params
+        from tpu_ddp.parallel.pipeline import permute_stacked_blocks
+        return permute_stacked_blocks(params,
+                                      np.argsort(self._layer_perm))
+
+    def _to_canonical_host(self, params, opt_state):
+        """Checkpoints store the DENSE layer order for every schedule:
+        unpermute the stacked rows of params AND each params-shaped
+        optimizer subtree (map_param_like) so a checkpoint written by an
+        interleaved trainer restores into any other schedule."""
+        if self._layer_perm is None:
+            return params, opt_state
+        from tpu_ddp.parallel.pipeline import permute_stacked_blocks
+        inv = np.argsort(self._layer_perm)
+        fn = lambda t: permute_stacked_blocks(t, inv)  # noqa: E731
+        return fn(params), self.optimizer.map_param_like(opt_state, fn)
+
+    def _from_canonical_host(self, params, opt_state):
+        if self._layer_perm is None:
+            return params, opt_state
+        from tpu_ddp.parallel.pipeline import permute_stacked_blocks
+        perm = self._layer_perm
+        fn = lambda t: permute_stacked_blocks(t, perm)  # noqa: E731
+        return fn(params), self.optimizer.map_param_like(opt_state, fn)
 
     def _sync_grads(self, grads, skip_dp: bool = False, specs=None):
         """Stacked block leaves are stage-local (mean over dp/sp/ep
@@ -970,9 +1128,35 @@ class PipelineLMTrainer(_MeshTrainer):
         n_shards = lax.psum(1.0, data_axes)
         return n_shards / total, masked_sum / local_n
 
+    def _schedule_grads(self, params, inputs, targets, rng,
+                        scatter_blocks=None, blocks_grad_init=None):
+        """The hand-scheduled grads function for this trainer's schedule
+        — one dispatch point shared by the replicated and fsdp step
+        paths. ``skip_invalid`` (interleaved/zerobubble): out-of-range
+        ticks cond-skip their chunk compute, safe only when stage bodies
+        are collective-free — pure dp x pp; masked execution under
+        sp/tp/ep, whose in-block collectives need uniform participation."""
+        from tpu_ddp.parallel.pipeline import (
+            pipeline_1f1b_grads, pipeline_interleaved_grads,
+            pipeline_zerobubble_grads)
+        if self.schedule == "1f1b":
+            return pipeline_1f1b_grads(
+                self.model, params, inputs, targets, pp_size=self.pp,
+                num_micro=self.num_micro, rng=rng,
+                scatter_blocks=scatter_blocks,
+                blocks_grad_init=blocks_grad_init)
+        skip = self.sp == 1 and self.tp == 1 and self.ep == 1
+        if self.schedule == "interleaved":
+            return pipeline_interleaved_grads(
+                self.model, params, inputs, targets, pp_size=self.pp,
+                num_micro=self.num_micro, pp_virtual=self.pp_virtual,
+                rng=rng, skip_invalid=skip)
+        return pipeline_zerobubble_grads(
+            self.model, params, inputs, targets, pp_size=self.pp,
+            num_micro=self.num_micro, rng=rng, skip_invalid=skip)
+
     def _base_step(self, params, opt_state, inputs, targets, rng):
-        from tpu_ddp.parallel.pipeline import (pipeline_1f1b_grads,
-                                               pipeline_loss)
+        from tpu_ddp.parallel.pipeline import pipeline_loss
 
         rng = self._decorrelate_rng(rng)
 
@@ -982,12 +1166,11 @@ class PipelineLMTrainer(_MeshTrainer):
         if self.is_fsdp:
             return self._fsdp_step(params, opt_state, inputs, targets,
                                    rng, data_axes)
-        if self.schedule == "1f1b":
+        if self.schedule != "gpipe":
             scatter = (self.optimizer.scatter_grads if self.opt_zero2
                        else None)
-            masked_sum, local_n, grads = pipeline_1f1b_grads(
-                self.model, params, inputs, targets, pp_size=self.pp,
-                num_micro=self.num_micro, rng=rng,
+            masked_sum, local_n, grads = self._schedule_grads(
+                params, inputs, targets, rng,
                 scatter_blocks=scatter,
                 blocks_grad_init=(
                     self.optimizer.shard_zeros(params["blocks"])
@@ -1052,14 +1235,12 @@ class PipelineLMTrainer(_MeshTrainer):
         stage-local gradients afterwards. Either way the non-dp sync
         (pp reassembly of embed/head, sp/ep means) runs with the
         ORIGINAL stacked specs' algebra, aligned shard-by-shard."""
-        from tpu_ddp.parallel.pipeline import (pipeline_1f1b_grads,
-                                               pipeline_loss)
+        from tpu_ddp.parallel.pipeline import pipeline_loss
 
-        if self.schedule == "1f1b":
+        if self.schedule != "gpipe":
             p_full = self.zero3.gather_params(params)
-            masked_sum, local_n, g_full = pipeline_1f1b_grads(
-                self.model, p_full, inputs, targets, pp_size=self.pp,
-                num_micro=self.num_micro, rng=rng)
+            masked_sum, local_n, g_full = self._schedule_grads(
+                p_full, inputs, targets, rng)
             scale, local_mean = self._loss_norm(masked_sum, local_n,
                                                 data_axes)
             g_full = jax.tree.map(lambda g: g * scale, g_full)

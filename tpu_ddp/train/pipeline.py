@@ -175,6 +175,92 @@ class DispatchPipeline:
         on_ready(value)
 
 
+class StageScheduler:
+    """Per-stage dispatch windows + tick accounting for the MPMD
+    pipeline (round 10) — :class:`DispatchPipeline` generalized from
+    one global window to one window per stage.
+
+    The MPMD host loop (parallel/mpmd.py) calls :meth:`tick` once per
+    (stage, tick) with that tick's validity bits; the scheduler
+    classifies the tick into the 1F1B phases —
+
+    - ``warmup``:   forward valid, backward not yet (the fill ramp);
+    - ``steady``:   both valid (the 1F1B body, zero bubble);
+    - ``cooldown``: backward only (the drain ramp);
+    - ``idle``:     neither (this stage's share of the bubble) —
+
+    and, when the caller hands it a device handle, bounds that stage's
+    in-flight work through its own DispatchPipeline window (each stage
+    dispatches independently, so one global window would let a fast
+    early stage run arbitrarily far ahead of a slow late one).
+
+    :meth:`step_done` is the per-step barrier: every stage's window
+    drains (the guard must observe a completed step before the next
+    dispatches) and the heartbeat hook fires — the same
+    ``touch_heartbeat`` cadence the SPMD epoch loop keeps, so the
+    watchdog and the chaos drills work unchanged on this rung.
+    """
+
+    PHASES = ("warmup", "steady", "cooldown", "idle")
+
+    def __init__(self, pp_size: int, depth: int = 2,
+                 heartbeat: Callable[[int], None] | None = None):
+        if pp_size < 1:
+            raise ValueError(f"pp_size must be >= 1, got {pp_size}")
+        self.pp_size = pp_size
+        self.windows = [DispatchPipeline(depth) for _ in range(pp_size)]
+        self.heartbeat = heartbeat
+        self.phase_counts = [dict.fromkeys(self.PHASES, 0)
+                             for _ in range(pp_size)]
+        self.ticks = [0] * pp_size
+        self.steps = 0
+
+    @staticmethod
+    def classify(fwd: bool, bwd: bool) -> str:
+        if fwd and bwd:
+            return "steady"
+        if fwd:
+            return "warmup"
+        if bwd:
+            return "cooldown"
+        return "idle"
+
+    def tick(self, stage: int, fwd: bool, bwd: bool,
+             handle=None) -> str:
+        phase = self.classify(fwd, bwd)
+        self.phase_counts[stage][phase] += 1
+        self.ticks[stage] += 1
+        if handle is not None:
+            self.windows[stage].submit(handle, lambda _v: None)
+        return phase
+
+    def step_done(self, step: int) -> None:
+        for w in self.windows:
+            w.drain()
+        self.steps += 1
+        if self.heartbeat is not None:
+            self.heartbeat(step)
+
+    def bubble_fraction(self, stage: int) -> float:
+        """This stage's idle share of its ticks so far — the measured
+        per-stage bubble the bench compares to the analytic model."""
+        t = self.ticks[stage]
+        return self.phase_counts[stage]["idle"] / t if t else 0.0
+
+    def stats(self) -> dict:
+        return {
+            "pp_size": self.pp_size,
+            "steps": self.steps,
+            "stages": [
+                {"ticks": self.ticks[s],
+                 **self.phase_counts[s],
+                 "bubble_fraction": round(self.bubble_fraction(s), 4),
+                 "window": self.windows[s].stats()}
+                for s in range(self.pp_size)
+            ],
+        }
+
+
 def depth_sweep(trainer, state, host_batches, depths,
                 reps: int = 1, epoch: int = 0) -> tuple[dict, Any]:
     """Measure streaming-loop throughput and host-gap per dispatch depth.

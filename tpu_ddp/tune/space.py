@@ -105,6 +105,23 @@ KNOBS: tuple[Knob, ...] = (
          doc="bucket payload target in MiB for overlap (torch DDP's "
              "bucket_cap_mb=25 default); smaller buckets start "
              "communicating earlier but amortize less per collective"),
+    Knob("pp_schedule", "pp_schedule", "TPU_DDP_PP_SCHEDULE",
+         values=("gpipe", "1f1b", "interleaved", "zerobubble"),
+         flag="--pp-schedule",
+         doc="pipeline tick schedule (parallel/pipeline.py): all four "
+             "compute the same step (schedule-equivalence-tested "
+             "against the dense model), so the choice is pure "
+             "schedule — searchable by default on a pp>1 mesh"),
+    Knob("pp_microbatches", "pp_microbatches", "TPU_DDP_PP_MICROBATCHES",
+         values=(0, 4, 8, 16), flag="--pp-microbatches",
+         doc="microbatches per pipeline step (0 = auto, one per "
+             "stage); more microbatches shrink the bubble fraction "
+             "but shrink each microbatch's arithmetic intensity"),
+    Knob("pp_virtual", "pp_virtual", "TPU_DDP_PP_VIRTUAL",
+         values=(1, 2, 4), flag="--pp-virtual",
+         doc="virtual stage chunks per physical stage (interleaved "
+             "schedule): bubble shrinks V x for V x more in-flight "
+             "chunk activations and V x the edge traffic"),
     Knob("pallas_sgd", "pallas_sgd", "TPU_DDP_PALLAS_SGD",
          values=(False, True),
          doc="fused Pallas SGD momentum update kernel (TPU only)"),
@@ -205,6 +222,11 @@ class Workload:
     # Model family ("conv" | "attn" | "" unknown): the remat policy's
     # degrade/duplicate rules are family-shaped (tpu_ddp/memory/).
     model_family: str = ""
+    # Pipeline context (round 10): stages on the mesh and the model's
+    # layer count (0 = unknown) — the interleaved divisibility rule
+    # needs both; pp <= 1 scopes the pipeline knobs out entirely.
+    pp: int = 1
+    model_layers: int = 0
 
 
 def workload_for(cfg, strategy: str = "none", mesh=None) -> Workload:
@@ -214,13 +236,21 @@ def workload_for(cfg, strategy: str = "none", mesh=None) -> Workload:
 
     from tpu_ddp.parallel.sync import canonical_strategy
 
-    dp = 1
+    dp, pp = 1, 1
     if mesh is not None:
         try:
             dp = int(mesh.shape.get("dp", 1))
+            pp = int(mesh.shape.get("pp", 1))
         except Exception:  # noqa: BLE001 — a mesh without named axes
-            dp = 1
+            dp, pp = 1, 1
     from tpu_ddp.memory import family_for_model
+
+    layers = 0
+    try:
+        from tpu_ddp.models.transformer import make_transformer
+        layers = int(make_transformer(cfg.model).num_layers)
+    except (ValueError, TypeError):
+        pass  # non-transformer family: layer-divisibility rule inert
 
     return Workload(
         platform=jax.devices()[0].platform,
@@ -230,6 +260,8 @@ def workload_for(cfg, strategy: str = "none", mesh=None) -> Workload:
         collective_cadence=bool(cfg.ckpt_every_iters
                                 or cfg.check_replicas_every),
         model_family=family_for_model(cfg.model),
+        pp=pp,
+        model_layers=layers,
     )
 
 
@@ -293,6 +325,39 @@ def violations(assignment: Mapping, ctx: Workload) -> list[str]:
             f"serve_cache_dtype={scd!r} with compute_dtype={cdty!r} — "
             "the cache cast is a no-op, duplicate of 'compute' "
             "(tpu_ddp/memory/policy.py resolve_act_dtype)")
+    # Pipeline knobs (round 10) — mirror PipelineLMTrainer's guards.
+    sched = get("pp_schedule", "gpipe")
+    virt = get("pp_virtual", 1)
+    micro = get("pp_microbatches", 0)
+    if ctx.pp <= 1:
+        if sched != "gpipe" or virt != 1 or micro != 0:
+            bad.append(
+                "pipeline knobs off-default on a pp<=1 mesh — no "
+                "pipeline rung runs, so every cell duplicates the "
+                "default")
+    else:
+        if virt > 1 and sched != "interleaved":
+            bad.append(
+                f"pp_virtual={virt} requires pp_schedule='interleaved' "
+                f"(got {sched!r}) — PipelineLMTrainer rejects it "
+                "(zero-bubble extends plain 1F1B; gpipe/1f1b run one "
+                "chunk per stage)")
+        if sched == "interleaved" and virt == 1:
+            bad.append(
+                "pp_schedule='interleaved' with pp_virtual=1 runs the "
+                "plain 1F1B tick indices — duplicate of the '1f1b' "
+                "cell")
+        if (sched == "interleaved" and ctx.model_layers
+                and ctx.model_layers % (ctx.pp * virt)):
+            bad.append(
+                f"interleaved needs num_layers % (pp*pp_virtual) == 0: "
+                f"{ctx.model_layers} % {ctx.pp * virt} != 0 — "
+                "PipelineLMTrainer rejects it")
+        if micro and micro % ctx.pp:
+            bad.append(
+                f"pp_microbatches={micro} not divisible by "
+                f"pp={ctx.pp} — the interleaved schedule rejects it "
+                "and the others waste the ragged tail")
     if get("steps_per_dispatch", 1) > 1:
         if get("device_prefetch", 0):
             bad.append("steps_per_dispatch>1 with device_prefetch>0 — "

@@ -93,6 +93,22 @@ class TrainConfig:
     # strategy; degrades to "none" with a warning otherwise.
     grad_compress: str = "none"
 
+    # Pipeline schedule knobs (round 10; tpu_ddp/parallel/pipeline.py,
+    # consumed by examples/lm_train.py's pipeline rung). pp_schedule
+    # picks the tick schedule: "gpipe" (AD of the forward scan),
+    # "1f1b" (hand-scheduled, O(pp) activation residency),
+    # "interleaved" (1F1B with pp_virtual chunks per stage — bubble
+    # shrinks V x) or "zerobubble" (backward split B-input/B-weight,
+    # weight grads fill the cooldown). pp_microbatches 0 = auto (= pp).
+    # pp_virtual > 1 requires pp_schedule="interleaved" and
+    # num_layers % (pp * pp_virtual) == 0 — the engine re-validates;
+    # tune/space.py mirrors the same constraints as knob violations.
+    # Env: TPU_DDP_PP_SCHEDULE / TPU_DDP_PP_MICROBATCHES /
+    # TPU_DDP_PP_VIRTUAL.
+    pp_schedule: str = "gpipe"
+    pp_microbatches: int = 0
+    pp_virtual: int = 1
+
     # Overlapped bucketized gradient collectives
     # (tpu_ddp/parallel/overlap.py): partition the gradient pytree into
     # ~bucket_mb-MiB buckets in reverse-autodiff order and issue each
@@ -216,6 +232,34 @@ class TrainConfig:
             raise ValueError(
                 f"grad_compress={self.grad_compress!r}: expected "
                 "none|bf16|int8|int8-noef (TPU_DDP_GRAD_COMPRESS)")
+        env_ps = os.environ.get("TPU_DDP_PP_SCHEDULE")
+        if env_ps:
+            self.pp_schedule = env_ps
+        if self.pp_schedule not in ("gpipe", "1f1b", "interleaved",
+                                    "zerobubble"):
+            raise ValueError(
+                f"pp_schedule={self.pp_schedule!r}: expected "
+                "gpipe|1f1b|interleaved|zerobubble (TPU_DDP_PP_SCHEDULE)")
+        env_pm = os.environ.get("TPU_DDP_PP_MICROBATCHES")
+        if env_pm:
+            self.pp_microbatches = int(env_pm)
+        if self.pp_microbatches < 0:
+            raise ValueError(
+                f"pp_microbatches must be >= 0 (0 = auto), got "
+                f"{self.pp_microbatches} (TPU_DDP_PP_MICROBATCHES)")
+        env_pv = os.environ.get("TPU_DDP_PP_VIRTUAL")
+        if env_pv:
+            self.pp_virtual = int(env_pv)
+        if self.pp_virtual < 1:
+            raise ValueError(
+                f"pp_virtual must be >= 1, got {self.pp_virtual} "
+                "(TPU_DDP_PP_VIRTUAL)")
+        # Cross-knob coupling (pp_virtual>1 needs the interleaved
+        # schedule, layer divisibility) is enforced where the mesh and
+        # model are known: PipelineLMTrainer rejects bad combinations
+        # at construction and tune/space.py mirrors them as violations.
+        # Validating it here would make each env knob's parse depend on
+        # the others', which the single-var audit probes forbid.
         # f32 end-to-end runs turn the bf16-rounding drift story into a
         # measurement (run_experiments --dtype float32): bit-equivalent
         # programs must then agree to f32 reduction-order tolerance.

@@ -174,8 +174,12 @@ HEAVY_OPS = ("convolution", "dot")
 # (oneDNN / Eigen / cuDNN); match those targets as heavy too.
 _HEAVY_CUSTOM = re.compile(r"conv|gemm|matmul|dot|onednn|dnn|eigen", re.I)
 
+# Param lists may nest parens (while/region bodies take TUPLE params:
+# ``%while_body (p: (s32[], f32[...])) -> (...) {``) — ``\(.*\)`` spans
+# them; ``[^)]*`` would drop exactly the computations that hold a
+# pipelined step's edge collectives.
 _COMP_HEADER = re.compile(
-    r"^(?P<entry>ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\([^)]*\)\s*->\s*.*\{")
+    r"^(?P<entry>ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\(.*\)\s*->\s*.*\{")
 
 _INSTR_LINE = re.compile(
     r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
