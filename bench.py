@@ -720,6 +720,63 @@ def run_serve_probe(n_requests: int = 24) -> dict:
     return out
 
 
+def run_fleet_probe(n_requests: int = 24) -> dict:
+    """Fleet probe (tpu_ddp/fleet/): disaggregated prefill/decode with
+    the refcounted prefix cache vs the round-12 single engine at 1.5x
+    the single engine's measured saturation, EQUAL simulated hardware
+    (single-engine block budget = disagg decode+prefill pools
+    combined), on a shared-system-prompt workload. The recorded claim
+    is the ORDERING (``fleet_beats_single``: disagg+prefix wins p99
+    TTFT under oversubscription — the fleet subsystem's reason to
+    exist); absolute ms are host-relative, valid on CPU because
+    scheduling, not matmul, dominates the tiny probe model."""
+    from scripts.serve_sweep import build_engine
+    from tpu_ddp.serve import (calibrate_rate,
+                               make_shared_prefix_workload, run_load)
+
+    specs = make_shared_prefix_workload(
+        n_requests, vocab_size=1024, seed=0, prefix_len=48,
+        tail_len=(2, 9), max_new=(2, 7))
+    geom = dict(serve_prefill_chunk=16)
+    bps = 64 // 16
+    single_blocks = (8 * bps + 1) + (2 * bps + 1)
+
+    def build_single():
+        return build_engine(num_blocks=single_blocks, **geom)
+
+    def build_fleet():
+        return build_engine(fleet_roles="disagg", prefix_cache=True,
+                            **geom)
+
+    for b in (build_single, build_fleet):  # warm outside every window
+        e = b()
+        for sp in specs[:3]:
+            e.submit(sp.prompt, sp.max_new_tokens)
+        e.run()
+    probe = build_single()
+    h = probe.submit(specs[0].prompt, specs[0].max_new_tokens)
+    probe.run()
+    slo_ms = max(50.0, 10.0 * h.ttft_s * 1e3)
+    rate = 1.5 * calibrate_rate(build_single, specs)
+    out = {"slo_ttft_ms": round(slo_ms, 3),
+           "rate_rps": round(rate, 3),
+           "single_num_blocks": single_blocks}
+    fleet_eng = build_fleet()
+    out["single"] = _sub(run_load, build_single(), specs, rate,
+                         seed=1, slo_ttft_ms=slo_ms)
+    out["disagg_prefix"] = _sub(run_load, fleet_eng, specs, rate,
+                                seed=1, slo_ttft_ms=slo_ms)
+    if "error" not in out["disagg_prefix"]:
+        out["disagg_prefix"]["edge"] = fleet_eng.edge.stats()
+        out["disagg_prefix"]["prefix"] = fleet_eng.prefix.stats()
+    fp = out["disagg_prefix"].get("ttft_p99_ms")
+    sp = out["single"].get("ttft_p99_ms")
+    if fp is not None and sp is not None:
+        out["fleet_beats_single"] = bool(fp < sp)
+        out["ttft_p99_ratio"] = round(sp / fp, 3) if fp else None
+    return out
+
+
 def _sub(fn, *args, **kwargs) -> dict:
     """Run one sub-benchmark; a failure becomes a recorded error, never a
     lost headline line (the driver captures exactly one JSON line)."""
@@ -883,6 +940,10 @@ def main() -> dict:
     # Serving probe (tpu_ddp/serve/): continuous-vs-static goodput at
     # 1.5x saturation — the serve subsystem's headline ordering.
     extra["serve"] = _sub(run_serve_probe)
+    # Fleet probe (tpu_ddp/fleet/): disagg+prefix vs the single engine
+    # at equal simulated hardware — the p99-TTFT ordering under
+    # oversubscription.
+    extra["fleet"] = _sub(run_fleet_probe)
     # Run-to-run variance control (round-3 verdict item 2): every
     # timed number is the MEDIAN of >= 3 consecutive chained windows,
     # with the raw per-window samples recorded next to it

@@ -96,22 +96,35 @@ def test_serve_knobs_registered_under_goodput_objective():
     from tpu_ddp.utils.config import TrainConfig
 
     fields = {"serve_slots", "serve_block_size", "serve_prefill_chunk",
-              "serve_cache_dtype"}
+              "serve_cache_dtype", "fleet_roles", "prefix_cache",
+              "router_policy", "kv_wire"}
     for f in fields:
         k = knob_by_field(f)
         assert k is not None and k.objective == "goodput", f
     assert knob_by_field("serve_block_size").env == "TPU_DDP_SERVE_BLOCK"
-    # Cache dtype changes numerics -> semantic, like act_dtype; the
-    # pure-scheduling knobs must not be.
+    assert knob_by_field("kv_wire").env == "TPU_DDP_KV_WIRE"
+    # Cache dtype and the lossy KV wire change numerics -> semantic,
+    # like act_dtype; the pure-scheduling knobs must not be.
     assert knob_by_field("serve_cache_dtype").semantic
+    assert knob_by_field("kv_wire").semantic
     assert not knob_by_field("serve_slots").semantic
+    assert not knob_by_field("fleet_roles").semantic
     cfg, ctx = TrainConfig(), Workload(platform="cpu")
     good = {k.field for k, _ in
             searchable_knobs(cfg, ctx, objective="goodput",
                              include_semantic=True)}
-    assert good == fields
+    # At the default config the coupled fleet knobs collapse to single
+    # candidates (kv_wire needs a disagg edge, prefix-affinity needs a
+    # cache — tune/space.py violations) and drop out of the space.
+    assert good == fields - {"router_policy", "kv_wire"}
     step = {k.field for k, _ in searchable_knobs(cfg, ctx)}
     assert not (step & fields)
+    # With the edge and the cache on, the whole fleet space opens up.
+    fleet_cfg = TrainConfig(fleet_roles="disagg", prefix_cache=True)
+    good = {k.field for k, _ in
+            searchable_knobs(fleet_cfg, ctx, objective="goodput",
+                             include_semantic=True)}
+    assert good == fields
 
 
 def test_reverse_check_catches_unregistered_remat_env():

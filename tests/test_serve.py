@@ -25,6 +25,7 @@ from tpu_ddp.serve import (
     Request,
     Scheduler,
     ServeEngine,
+    make_shared_prefix_workload,
     make_workload,
     run_load,
 )
@@ -271,6 +272,54 @@ class TestLifecycle:
         eng.run()
         assert a.tokens == [] or len(a.tokens) < 6  # never completed
 
+    def test_cancel_mid_prefill_frees_reserved_blocks(self, model,
+                                                      params):
+        """Regression: a request cancelled BETWEEN prefill chunks (its
+        prompt spans several) must hand back every reserved page, not
+        just the ones already written — a leak here strangles the pool
+        one cancelled long prompt at a time."""
+        eng = _engine(model, params)
+        a = eng.submit(_prompt(20, seed=32), 6)  # 3 chunks of 8
+        eng.step()                     # admitted + first chunk only
+        s = [x for x in eng.sched.slots if x is not None][0]
+        assert s.phase == "prefill" and s.prefill_done < 20
+        assert eng.cancel(a)
+        assert a.cancelled and a.done
+        assert eng.pool.free_count == eng.pool.total_usable
+        assert eng.sched.accounting_ok()
+        assert not eng.step()          # engine fully idle again
+
+    def test_cancel_drops_pending_disagg_edge_transfer(self, model,
+                                                       params):
+        """Regression (fleet half of the same bug): a request whose
+        prefill finished but whose KV transfer still sits on the
+        prefill->decode edge must be cancellable — the transfer is
+        dropped and never adopted into the decode pool."""
+        from tpu_ddp.fleet import DisaggEngine
+        # Decode pool of 2 usable pages: exactly one live request.
+        eng = DisaggEngine(model, params, num_blocks=3, **GEOM)
+        a = eng.submit(_prompt(9, seed=33), 6)   # 2 blocks worst-case
+        b = eng.submit(_prompt(9, seed=34), 6)
+        # Step until b's transfer is parked on the edge (a holds the
+        # whole decode pool, so the adopter's reservation check gates).
+        for _ in range(8):
+            eng.step()
+            if eng.edge.queue:
+                break
+        assert [t.request for t in eng.edge.queue] == [b]
+        assert eng.cancel(b)
+        assert b.cancelled and b.done
+        assert len(eng.edge.queue) == 0
+        assert eng.edge.stats()["dropped"] == 1
+        assert eng.accounting_ok()
+        eng.run()                       # a finishes untouched
+        assert a.done and not a.cancelled and len(a.tokens) == 6
+        # Every page of both pools comes home; b was never adopted.
+        assert eng.pool.free_count == eng.pool.total_usable
+        assert eng.prefill_pool.free_count \
+            == eng.prefill_pool.total_usable
+        assert eng.metrics.counters["fleet_adopted"] == 1
+
     def test_eos_stops_early_and_frees_slot(self, model, params):
         prompt = _prompt(5, seed=40)
         full = _ref_greedy(model, params, prompt, 6)
@@ -394,8 +443,23 @@ class TestLoadgen:
         assert m["n_requests"] == 6
         assert m["total_tokens"] == sum(s.max_new_tokens for s in specs)
         assert m["ttft_p50_ms"] <= m["ttft_p99_ms"]
+        # The full latency anatomy: e2e covers TTFT, and with every
+        # spec generating >= 2 tokens TPOT is measurable everywhere.
+        assert m["e2e_p50_ms"] <= m["e2e_p99_ms"]
+        assert m["e2e_p99_ms"] >= m["ttft_p99_ms"]
+        assert m["tpot_p50_ms"] is not None
+        assert 0.0 <= m["tpot_p50_ms"] <= m["tpot_p99_ms"]
+        assert m["tpot_mean_ms"] > 0.0
         assert m["slo_attained"] == 1.0  # absurdly lax SLO
         assert m["goodput_tokens_per_sec"] == m["tokens_per_sec"]
+
+    def test_shared_prefix_workload_is_seeded_and_shared(self):
+        w1 = make_shared_prefix_workload(6, 1024, seed=3, prefix_len=16)
+        w2 = make_shared_prefix_workload(6, 1024, seed=3, prefix_len=16)
+        assert w1 == w2
+        heads = {s.prompt[:16] for s in w1}
+        assert len(heads) == 1           # one shared system prompt
+        assert len({s.prompt for s in w1}) > 1  # distinct tails
 
     @pytest.mark.slow  # wall-clock load drill: two timed runs at 2x
     # saturation plus a calibration run (~tens of seconds)
@@ -498,6 +562,57 @@ class TestTrainServeRoundTrip:
             np.asarray(req.logprobs),
             _ref_logprobs(model, trained, prompt, req.tokens),
             rtol=1e-4, atol=1e-4)
+
+    def test_checkpoint_over_budget_serves_tensor_parallel(
+            self, model, devices, tmp_path):
+        """A checkpoint too big for one chip's param budget routes
+        through shard_decode_params: params split Megatron-style over
+        an mp mesh, both jitted steps run under GSPMD — and the tokens
+        equal the dense engine's (column-parallel projections are
+        communication-free; the row-parallel all-reduces change
+        summation order, which greedy argmax absorbs)."""
+        state = self._train(model, devices[:1], tmp_path)
+        trained = jax.tree.map(jnp.asarray, state.params)
+        nbytes = sum(x.nbytes for x in jax.tree.leaves(trained))
+        eng = ServeEngine.from_checkpoint(
+            model, str(tmp_path), param_budget_bytes=nbytes // 2,
+            shard_devices=devices[:4], **GEOM)
+        assert eng.mesh is not None
+        wo = eng.params["blocks"][0]["wo"]
+        assert not wo.sharding.is_fully_replicated
+        prompt = _prompt(9, seed=82)
+        req = eng.submit(prompt, 6)
+        eng.run()
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens),
+            _ref_greedy(model, trained, prompt, 6))
+
+    def test_checkpoint_under_budget_stays_dense(self, model, devices,
+                                                 tmp_path):
+        state = self._train(model, devices[:1], tmp_path)
+        nbytes = sum(x.nbytes for x in
+                     jax.tree.leaves(state.params))
+        eng = ServeEngine.from_checkpoint(
+            model, str(tmp_path), param_budget_bytes=2 * nbytes,
+            **GEOM)
+        assert eng.mesh is None   # round-12 single-chip path untouched
+
+    def test_indivisible_tp_degree_refused(self, model, params,
+                                           devices):
+        from tpu_ddp.parallel.tensor_parallel import shard_decode_params
+        with pytest.raises(ValueError, match="divisible"):
+            shard_decode_params(model, params, devices[:3])
+
+    def test_training_sharded_model_config_still_refused(self):
+        # The pre-existing refusal: serving shards PARAMS of a dense
+        # model config; a model CONFIGURED for training-time tp/sp/ep
+        # layouts is still rejected loudly.
+        from tpu_ddp.models.transformer import make_transformer
+        tp_model = make_transformer("TransformerLM-tiny",
+                                    max_seq_len=64, tp_axis="mp",
+                                    tp_size=2)
+        with pytest.raises(ValueError, match="dense"):
+            ServeEngine(tp_model, {}, **GEOM)
 
     def test_cross_strategy_checkpoint_restores_dense(self, model,
                                                       devices,

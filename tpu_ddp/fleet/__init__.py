@@ -1,0 +1,18 @@
+"""Serving fleet: prefill/decode disaggregation over an explicit KV
+edge (disagg.py), refcounted prefix caching over the paged pool
+(prefix.py), and a multi-replica router (router.py). docs/DESIGN.md
+§21."""
+
+from tpu_ddp.fleet.disagg import DisaggEngine, KVEdge, KVTransfer
+from tpu_ddp.fleet.prefix import PrefixHit, PrefixIndex
+from tpu_ddp.fleet.router import POLICIES, Router
+
+__all__ = [
+    "DisaggEngine",
+    "KVEdge",
+    "KVTransfer",
+    "PrefixHit",
+    "PrefixIndex",
+    "POLICIES",
+    "Router",
+]

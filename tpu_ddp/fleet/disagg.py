@@ -1,0 +1,532 @@
+"""Prefill/decode disaggregation: two engine roles, one explicit edge.
+
+Why split the roles at all: in the round-12 single engine, a prefill
+chunk and the whole-bank decode step share one host loop, so a burst
+of long prompts steals engine steps from live decodes (TTFT for the
+burst trades directly against TPOT for everyone else). The fleet
+answer — the DistServe/Splitwise argument — is to pin prefill to its
+own worker whose pool only ever holds prompts, and stream each
+finished prompt's KV blocks to the decode worker over an explicit
+edge. Decode steps then never wait on prefill compute; prefill
+capacity scales independently of decode capacity.
+
+The edge is the MPMD round's machinery pointed at serving: the payload
+rides :class:`tpu_ddp.parallel.compress.EdgeCodec` wire formats
+("none" / "bf16" / "int8" — the ``kv_wire`` knob), so a DCN-crossing
+role split pays 2–4x fewer bytes per prompt. int8 rides the
+error-feedback-free variant: each transfer is an independent one-shot
+payload (a different request's KV), so there is no trajectory along
+which a residual could telescope. Garbage tail positions of the last
+prompt block are zero-masked before encoding — stale values would
+pollute the per-block int8 scales.
+
+Adoption is free-list surgery, not a copy: the decode pool allocates
+block ids, the payload lands in them with ONE scatter fused into the
+front of the decode step (``_build_adopt_decode_step``), and the
+request's slot starts directly in the decode phase. The fused program
+applies the adoption scatter BEFORE the bank's own writes/gathers —
+the adopted ids are in no live table this step, so the decode math is
+untouched, and the scatter's dependence cones leave every layer's
+QKV/MLP projections free: ``utils/hlo_comm.update_overlap_report``
+checks exactly that, i.e. a latency-hiding scheduler is ALLOWED to
+run the transfer landing behind decode compute.
+
+Sampling stays stateless-keyed by (seed, position) on both sides, so
+any role split reproduces the single engine's tokens bitwise with
+``kv_wire="none"`` — the parity acceptance criterion. Lossy wires
+round the shipped KV and are gated as semantic, like cache dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+import math
+import time
+from collections import deque
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_ddp.models.decode import check_decodable
+from tpu_ddp.parallel.compress import EdgeCodec
+from tpu_ddp.serve.engine import (
+    Request,
+    _build_prefill_step,
+    decode_bank,
+)
+from tpu_ddp.serve.kv_pool import PagedKVPool
+from tpu_ddp.serve.scheduler import Scheduler
+from tpu_ddp.utils.metrics import MetricsLogger
+
+
+@functools.lru_cache(maxsize=32)
+def _build_adopt_decode_step(model, block_size: int,
+                             blocks_per_seq: int):
+    """The fused transfer-landing + whole-bank decode program.
+    ``adopt_ids`` (nb,) are freshly allocated (table-less) block ids;
+    ``adopt_k``/``adopt_v`` (L, nb, bs, KV, hd) is the decoded wire
+    payload. The scatter runs FIRST so it depends on nothing the
+    decode computes and nothing heavy depends on it — the dataflow
+    freedom ``update_overlap_report`` verifies."""
+
+    def step(params, pool_k, pool_v, adopt_ids, adopt_k, adopt_v,
+             tables, lengths, last_tokens, temps, seeds):
+        pool_k = pool_k.at[:, adopt_ids].set(
+            adopt_k.astype(pool_k.dtype))
+        pool_v = pool_v.at[:, adopt_ids].set(
+            adopt_v.astype(pool_v.dtype))
+        return decode_bank(model, block_size, blocks_per_seq, params,
+                           pool_k, pool_v, tables, lengths,
+                           last_tokens, temps, seeds)
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+@dataclasses.dataclass
+class KVTransfer:
+    """One finished prefill in flight on the edge: encoded KV blocks
+    plus the last-token state the decode role resumes from."""
+
+    request: Request
+    wire_k: dict
+    wire_v: dict
+    n_blocks: int
+    length: int          # prompt tokens (valid cache positions)
+    pending_token: int   # first sampled token, already emitted
+    nbytes: int          # wire payload bytes (both tensors)
+
+
+class KVEdge:
+    """The explicit prefill→decode edge: a FIFO of encoded transfers
+    with one :class:`EdgeCodec` providing the wire format and the
+    honest byte accounting (``bytes_sent`` / ``ratio``)."""
+
+    def __init__(self, wire: str = "none"):
+        if wire not in ("none", "bf16", "int8"):
+            raise ValueError(f"kv_wire={wire!r}: expected "
+                             "none|bf16|int8")
+        self.wire = wire
+        # int8 rides the EF-free variant: transfers are independent
+        # one-shot payloads, not a trajectory a residual could follow.
+        self.codec = EdgeCodec("int8-noef" if wire == "int8" else wire)
+        self.queue: deque = deque()
+        self.sent = 0
+        self.delivered = 0
+        self.dropped = 0
+
+    def send(self, transfer: KVTransfer) -> None:
+        self.queue.append(transfer)
+        self.sent += 1
+
+    def pop(self) -> KVTransfer:
+        self.delivered += 1
+        return self.queue.popleft()
+
+    def drop(self, request: Request) -> bool:
+        """Cancel support: remove a pending transfer for ``request``.
+        Its blocks live only in the payload (the prefill side already
+        freed its pool copies), so dropping the transfer IS the
+        cleanup."""
+        for t in self.queue:
+            if t.request is request:
+                self.queue.remove(t)
+                self.dropped += 1
+                return True
+        return False
+
+    def stats(self) -> dict:
+        return {"wire": self.wire, "sent": self.sent,
+                "delivered": self.delivered, "dropped": self.dropped,
+                "pending": len(self.queue),
+                "bytes_sent": self.codec.bytes_sent,
+                "bytes_dense": self.codec.bytes_dense,
+                "ratio": self.codec.ratio}
+
+
+class DisaggEngine:
+    """Prefill-role + decode-role pair behind the single-engine
+    surface (``submit`` / ``cancel`` / ``step`` / ``run``), so
+    loadgen, the router, and the sweep drive it interchangeably with
+    :class:`ServeEngine`.
+
+    One ``step()`` advances both roles once: admit + one prefill
+    chunk on the prefill worker (shipping on completion), land at
+    most one edge transfer on the decode worker (fused into the
+    decode step when a live batch exists), one whole-bank decode
+    step. Equal-simulated-hardware comparisons give the two pools a
+    combined budget matching the single engine's.
+    """
+
+    def __init__(self, model, params, *,
+                 num_slots: int | None = None,
+                 block_size: int | None = None,
+                 prefill_chunk: int | None = None,
+                 num_blocks: int | None = None,
+                 prefill_blocks: int | None = None,
+                 cache_dtype: str | None = None,
+                 kv_wire: str | None = None,
+                 prefix_cache: bool | None = None,
+                 metrics: MetricsLogger | None = None,
+                 config=None):
+        check_decodable(model)
+        if config is None:
+            from tpu_ddp.utils.config import TrainConfig
+            config = TrainConfig()
+        self.model = model
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.num_slots = int(num_slots if num_slots is not None
+                             else config.serve_slots)
+        self.block_size = int(block_size if block_size is not None
+                              else config.serve_block_size)
+        self.prefill_chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else config.serve_prefill_chunk)
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.blocks_per_seq = math.ceil(model.max_seq_len
+                                        / self.block_size)
+        cache_dtype = (cache_dtype if cache_dtype is not None
+                       else config.serve_cache_dtype)
+        if num_blocks is None:
+            num_blocks = self.num_slots * self.blocks_per_seq + 1
+        if prefill_blocks is None:
+            # Room for two worst-case prompts (one prefilling, one
+            # admitted behind it) plus prefix-cache residency.
+            prefill_blocks = 2 * self.blocks_per_seq + 1
+        # Decode role: the round-12 pool + scheduler, decode-only in
+        # practice (every slot is placed post-prefill).
+        self.pool = PagedKVPool(model, num_blocks, self.block_size,
+                                cache_dtype)
+        self.sched = Scheduler(self.pool, self.num_slots, "continuous")
+        # Prefill role: prompt-only reservations; finished KV ships
+        # over the edge, so the prefix index (when on) lives HERE —
+        # cached blocks must be in the pool the prefill step gathers.
+        self.prefill_pool = PagedKVPool(model, prefill_blocks,
+                                        self.block_size, cache_dtype)
+        self.prefix = None
+        prefix_cache = (bool(prefix_cache) if prefix_cache is not None
+                        else config.prefix_cache)
+        if prefix_cache:
+            from tpu_ddp.fleet.prefix import PrefixIndex
+            self.prefix = PrefixIndex(self.prefill_pool)
+        self.psched = Scheduler(self.prefill_pool, 1, "continuous",
+                                prefix=self.prefix, role="prefill")
+        self.edge = KVEdge(kv_wire if kv_wire is not None
+                           else config.kv_wire)
+        self.metrics = metrics if metrics is not None \
+            else MetricsLogger(None)
+        self._prefill = _build_prefill_step(model, self.block_size,
+                                            self.blocks_per_seq)
+        self._adopt_decode = _build_adopt_decode_step(
+            model, self.block_size, self.blocks_per_seq)
+        self._rid = itertools.count()
+
+    # ---- request lifecycle ---------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: int | None = None,
+               on_token: Callable[[int], None] | None = None) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold >= 1 token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size + max_new_tokens
+        if total > self.model.max_seq_len:
+            raise ValueError(f"prompt + generation = {total} exceeds "
+                             f"max_seq_len={self.model.max_seq_len}")
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        req = Request(rid=next(self._rid), prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature), seed=int(seed),
+                      eos_id=eos_id, on_token=on_token,
+                      submitted_at=time.perf_counter())
+        # Decode-side feasibility must hold too, or the transfer could
+        # never be adopted and would head-block the edge forever.
+        dneed = self.sched.worst_case_blocks(req)
+        if dneed > self.pool.total_usable:
+            raise ValueError(
+                f"request needs up to {dneed} decode KV blocks but "
+                f"the decode pool holds only {self.pool.total_usable}")
+        self.psched.enqueue(req)
+        self.metrics.inc("serve_submitted")
+        return req
+
+    def cancel(self, req: Request) -> bool:
+        """Drop a request anywhere in the pipeline: queued, mid-
+        prefill (frees the prefill pool's reserved blocks), pending on
+        the edge (drops the transfer), or decoding."""
+        if req.done:
+            return False
+        if self.edge.drop(req):
+            pass
+        elif req in self.psched.queue:
+            self.psched.queue.remove(req)
+        else:
+            for sched in (self.psched, self.sched):
+                hit = False
+                for i, s in enumerate(sched.slots):
+                    if s is not None and s.request is req:
+                        sched.retire(i)
+                        hit = True
+                        break
+                if hit:
+                    break
+            else:
+                return False
+        req.cancelled = True
+        req.done = True
+        req.finished_at = time.perf_counter()
+        self.metrics.inc("serve_cancelled")
+        return True
+
+    # ---- the iteration -------------------------------------------------
+
+    def step(self) -> bool:
+        """One fleet iteration: each role advances once."""
+        admitted = self.psched.admit()
+        did = False
+
+        pi = self.psched.prefill_slot()
+        if pi is not None:
+            did = True
+            self._run_prefill_chunk(pi)
+
+        transfer = self._pop_adoptable()
+        dslots = self.sched.decode_slots()
+        if transfer is not None:
+            did = True
+            self._land(transfer, dslots)
+        elif dslots:
+            did = True
+            self._run_decode_step(dslots)
+
+        self.metrics.observe("serve_queue_depth",
+                             len(self.psched.queue))
+        self.metrics.observe("serve_slot_occupancy",
+                             self.sched.live / self.num_slots)
+        return did or bool(admitted)
+
+    def run(self, max_steps: int | None = None) -> int:
+        n = 0
+        while max_steps is None or n < max_steps:
+            if not self.step():
+                break
+            n += 1
+        return n
+
+    # ---- router hooks --------------------------------------------------
+
+    def outstanding(self) -> int:
+        w = 0
+        for r in self.psched.queue:
+            w += len(r.prompt) + r.max_new_tokens
+        for t in self.edge.queue:
+            w += t.request.max_new_tokens - len(t.request.tokens)
+        for sched in (self.psched, self.sched):
+            for s in sched.slots:
+                if s is not None:
+                    w += (len(s.request.prompt) - s.prefill_done) \
+                        + (s.request.max_new_tokens - s.generated)
+        return w
+
+    def prefix_cached_len(self, prompt) -> int:
+        if self.prefix is None:
+            return 0
+        return self.prefix.cached_len(
+            np.asarray(prompt, np.int32).reshape(-1))
+
+    # ---- prefill role --------------------------------------------------
+
+    def _table_for(self, slot) -> np.ndarray:
+        t = np.zeros(self.blocks_per_seq, np.int32)
+        t[:len(slot.blocks)] = slot.blocks
+        return t
+
+    def _run_prefill_chunk(self, pi: int) -> None:
+        s = self.psched.slots[pi]
+        req = s.request
+        start, C = s.prefill_done, self.prefill_chunk
+        chunk = np.zeros((1, C), np.int32)
+        piece = req.prompt[start:start + C]
+        chunk[0, :piece.size] = piece
+        k, v, tok, lp = self._prefill(
+            self.params, self.prefill_pool.k, self.prefill_pool.v,
+            jnp.asarray(self._table_for(s)), jnp.asarray(chunk),
+            jnp.int32(start), jnp.int32(req.prompt.size),
+            jnp.float32(req.temperature), jnp.int32(req.seed))
+        self.prefill_pool.commit(k, v)
+        s.prefill_done = min(start + C, int(req.prompt.size))
+        s.length = s.prefill_done
+        if s.prefill_done >= req.prompt.size:
+            self._ship(pi, int(tok), float(lp))
+
+    def _ship(self, pi: int, tok: int, lp: float) -> None:
+        """Prefill finished: emit the first token (TTFT is prefill
+        completion), encode the prompt's KV blocks onto the edge, hand
+        the blocks back to the prefill pool (the payload is the copy
+        in flight; the prefix index keeps its own refs)."""
+        s = self.psched.slots[pi]
+        req = s.request
+        self._emit_first(req, tok, lp)
+        if not req.done:
+            nb = len(s.blocks)
+            ids = jnp.asarray(np.asarray(s.blocks, np.int32))
+            kb = self.prefill_pool.k[:, ids]   # (L, nb, bs, KV, hd)
+            vb = self.prefill_pool.v[:, ids]
+            # Zero the garbage tail of the last block: stale positions
+            # would pollute the int8 per-block quantization scales.
+            valid = (np.arange(nb * self.block_size)
+                     < req.prompt.size).reshape(nb, self.block_size)
+            mask = jnp.asarray(valid)[None, :, :, None, None]
+            kb = jnp.where(mask, kb, 0)
+            vb = jnp.where(mask, vb, 0)
+            wire_k, n_k = self.edge.codec.encode(kb)
+            wire_v, n_v = self.edge.codec.encode(vb)
+            self.edge.send(KVTransfer(
+                request=req, wire_k=wire_k, wire_v=wire_v, n_blocks=nb,
+                length=int(req.prompt.size), pending_token=tok,
+                nbytes=n_k + n_v))
+            self.metrics.inc("fleet_shipped")
+            self.metrics.observe("fleet_wire_bytes", n_k + n_v)
+        if self.prefix is not None:
+            self.prefix.register(req.prompt, s.blocks)
+        self.psched.retire(pi)
+
+    def _emit_first(self, req: Request, tok: int, lp: float) -> None:
+        req.tokens.append(tok)
+        req.logprobs.append(lp)
+        now = time.perf_counter()
+        req.first_token_at = now
+        self.metrics.observe("serve_ttft_ms",
+                             (now - req.submitted_at) * 1e3)
+        if req.on_token is not None:
+            req.on_token(tok)
+        if req.max_new_tokens == 1 \
+                or (req.eos_id is not None and tok == req.eos_id):
+            req.done = True
+            req.finished_at = now
+            self.metrics.inc("serve_retired")
+
+    # ---- decode role ---------------------------------------------------
+
+    def _pop_adoptable(self) -> KVTransfer | None:
+        """FIFO edge delivery, gated by the decode scheduler's
+        reservation rule (a free slot AND the full worst case fits)."""
+        if not self.edge.queue:
+            return None
+        if self.sched.live >= self.num_slots:
+            return None
+        t = self.edge.queue[0]
+        need = self.sched.worst_case_blocks(t.request)
+        if need > self.pool.allocatable - self.sched.reserved_unallocated:
+            return None
+        return self.edge.pop()
+
+    def _land(self, t: KVTransfer, dslots: list) -> None:
+        """Adopt a transfer's blocks into the decode pool — fused into
+        the decode step when a live batch exists, a standalone scatter
+        otherwise — then place the slot."""
+        ids = [self.pool.alloc() for _ in range(t.n_blocks)]
+        adopt_ids = jnp.asarray(np.asarray(ids, np.int32))
+        ak = EdgeCodec.decode(t.wire_k)
+        av = EdgeCodec.decode(t.wire_v)
+        if dslots:
+            tables, lengths, last, temps, seeds = \
+                self._bank_inputs(dslots)
+            k, v, toks, lps = self._adopt_decode(
+                self.params, self.pool.k, self.pool.v, adopt_ids,
+                ak, av, tables, lengths, last, temps, seeds)
+            self.pool.commit(k, v)
+            self._emit_bank(dslots, toks, lps)
+        else:
+            self.pool.commit(
+                self.pool.k.at[:, adopt_ids].set(
+                    ak.astype(self.pool.k.dtype)),
+                self.pool.v.at[:, adopt_ids].set(
+                    av.astype(self.pool.v.dtype)))
+        self.sched.place(t.request, ids, t.length, t.pending_token)
+        self.metrics.inc("fleet_adopted")
+
+    def _bank_inputs(self, dslots: list):
+        S, BPS = self.num_slots, self.blocks_per_seq
+        tables = np.zeros((S, BPS), np.int32)
+        lengths = np.zeros(S, np.int32)
+        last = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        seeds = np.zeros(S, np.int32)
+        for i in dslots:
+            self.sched.ensure_block(i)
+            s = self.sched.slots[i]
+            tables[i] = self._table_for(s)
+            lengths[i] = s.length
+            last[i] = s.pending_token
+            temps[i] = s.request.temperature
+            seeds[i] = s.request.seed
+        return (jnp.asarray(tables), jnp.asarray(lengths),
+                jnp.asarray(last), jnp.asarray(temps),
+                jnp.asarray(seeds))
+
+    def _run_decode_step(self, dslots: list) -> None:
+        from tpu_ddp.serve.engine import _build_decode_step
+        tables, lengths, last, temps, seeds = self._bank_inputs(dslots)
+        step = _build_decode_step(self.model, self.block_size,
+                                  self.blocks_per_seq)
+        k, v, toks, lps = step(self.params, self.pool.k, self.pool.v,
+                               tables, lengths, last, temps, seeds)
+        self.pool.commit(k, v)
+        self._emit_bank(dslots, toks, lps)
+
+    def _emit_bank(self, dslots: list, toks, lps) -> None:
+        toks, lps = np.asarray(toks), np.asarray(lps)
+        for i in dslots:
+            s = self.sched.slots[i]
+            s.length += 1
+            req = s.request
+            tok = int(toks[i])
+            s.generated += 1
+            s.pending_token = tok
+            req.tokens.append(tok)
+            req.logprobs.append(float(lps[i]))
+            if req.on_token is not None:
+                req.on_token(tok)
+            if s.generated >= req.max_new_tokens \
+                    or (req.eos_id is not None and tok == req.eos_id):
+                req.done = True
+                req.finished_at = time.perf_counter()
+                self.sched.retire(i)
+                self.metrics.inc("serve_retired")
+
+    # ---- introspection -------------------------------------------------
+
+    def accounting_ok(self) -> bool:
+        return (self.sched.accounting_ok()
+                and self.psched.accounting_ok())
+
+    def adopt_decode_hlo(self, n_blocks: int = 2) -> str:
+        """Compiled HLO of the fused adopt+decode program for a
+        representative transfer size — what
+        ``utils/hlo_comm.assert_transfer_overlap`` scans."""
+        sds = lambda x: jax.ShapeDtypeStruct(  # noqa: E731
+            jnp.shape(x), jnp.result_type(x))
+        params = jax.tree.map(sds, self.params)
+        S, BPS = self.num_slots, self.blocks_per_seq
+        pk = sds(self.pool.k)
+        payload = jax.ShapeDtypeStruct(
+            (self.model.num_layers, n_blocks, self.block_size,
+             self.model.kv_heads, self.model.head_dim), jnp.float32)
+        i32 = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
+        return self._adopt_decode.lower(
+            params, pk, pk, i32((n_blocks,)), payload, payload,
+            i32((S, BPS)), i32((S,)), i32((S,)),
+            jax.ShapeDtypeStruct((S,), jnp.float32),
+            i32((S,))).compile().as_text()
+
+
+__all__ = ["DisaggEngine", "KVEdge", "KVTransfer"]

@@ -1,0 +1,132 @@
+"""Multi-replica router: one front-end over N serving engines.
+
+A fleet is N independent replicas (each a ``ServeEngine`` or
+``DisaggEngine`` — the router is duck-typed over ``submit`` /
+``cancel`` / ``step`` plus the two router hooks ``outstanding()`` and
+``prefix_cached_len()``), and the router is the ONLY stateful thing
+above them: it picks a replica per request, remembers the assignment
+for ``cancel``, and fans ``step()`` across the fleet so ``run_load``
+drives a whole fleet exactly like one engine.
+
+Two policies (``TPU_DDP_ROUTER_POLICY``, tune/space.py "goodput"):
+
+- ``least-loaded`` — send the request to the replica owing the fewest
+  outstanding tokens (queued prompt+generation plus live remainders).
+  The queueing-theory baseline: balances makespan, ignores state.
+- ``prefix-affinity`` — ask every replica how many prompt tokens its
+  prefix cache already holds (``prefix_cached_len``, a PURE probe) and
+  send the request to the replica with the longest cached prefix,
+  breaking ties by least-loaded. Shared-prompt traffic then piles onto
+  the replica that already paid the prefill, instead of spraying N
+  copies of the same system prompt across N caches — the hit-rate gap
+  between the two policies on a shared-prefix workload is pinned by
+  tests/test_fleet.py.
+
+Affinity needs a tie-break CAP: a replica with the whole prompt cached
+is still the wrong choice if it owes 10x the work of a cold one. The
+router only honors affinity while the favored replica's backlog stays
+within ``affinity_slack`` tokens of the least-loaded replica's;
+past that it falls back to least-loaded (cache hits are cheap to
+re-earn, head-of-line blocking is not).
+"""
+
+from __future__ import annotations
+
+POLICIES = ("least-loaded", "prefix-affinity")
+
+
+class Router:
+    """Front-end over a list of replicas; same surface as one engine."""
+
+    def __init__(self, replicas, policy: str | None = None,
+                 affinity_slack: int = 256, config=None):
+        if not replicas:
+            raise ValueError("Router needs at least one replica")
+        if config is None:
+            from tpu_ddp.utils.config import TrainConfig
+            config = TrainConfig()
+        policy = policy if policy is not None else config.router_policy
+        if policy not in POLICIES:
+            raise ValueError(f"unknown router policy {policy!r}: "
+                             f"expected one of {POLICIES}")
+        self.replicas = list(replicas)
+        self.policy = policy
+        self.affinity_slack = int(affinity_slack)
+        self.routed = [0] * len(self.replicas)
+        self.affinity_hits = 0      # routed BY cached prefix (> 0 tokens)
+        self._owner: dict[int, int] = {}   # id(request) -> replica index
+
+    # ---- placement -----------------------------------------------------
+
+    def pick(self, prompt) -> int:
+        """The replica index ``submit`` would use for ``prompt`` —
+        split out so tests can interrogate placement decisions."""
+        loads = [r.outstanding() for r in self.replicas]
+        least = min(range(len(loads)), key=lambda i: (loads[i], i))
+        if self.policy == "least-loaded":
+            return least
+        cached = [r.prefix_cached_len(prompt) for r in self.replicas]
+        best = max(range(len(cached)),
+                   key=lambda i: (cached[i], -loads[i], -i))
+        if cached[best] > 0 and \
+                loads[best] - loads[least] <= self.affinity_slack:
+            return best
+        return least
+
+    def submit(self, prompt, max_new_tokens: int, **kw):
+        i = self.pick(prompt)
+        if self.policy == "prefix-affinity" and \
+                self.replicas[i].prefix_cached_len(prompt) > 0:
+            self.affinity_hits += 1
+        req = self.replicas[i].submit(prompt, max_new_tokens, **kw)
+        self.routed[i] += 1
+        self._owner[id(req)] = i
+        return req
+
+    def cancel(self, req) -> bool:
+        i = self._owner.get(id(req))
+        if i is None:
+            return False
+        return self.replicas[i].cancel(req)
+
+    # ---- the iteration (run_load drives this like one engine) ----------
+
+    def step(self) -> bool:
+        worked = False
+        for r in self.replicas:
+            worked |= bool(r.step())   # no short-circuit: step EVERY replica
+        return worked
+
+    def run(self, max_steps: int | None = None) -> int:
+        n = 0
+        while max_steps is None or n < max_steps:
+            if not self.step():
+                break
+            n += 1
+        return n
+
+    # ---- introspection -------------------------------------------------
+
+    def outstanding(self) -> int:
+        return sum(r.outstanding() for r in self.replicas)
+
+    def accounting_ok(self) -> bool:
+        return all(r.accounting_ok() for r in self.replicas)
+
+    def stats(self) -> dict:
+        per = []
+        for i, r in enumerate(self.replicas):
+            s = {"routed": self.routed[i],
+                 "outstanding": r.outstanding()}
+            prefix = getattr(r, "prefix", None)
+            if prefix is not None:
+                s["prefix"] = prefix.stats()
+            per.append(s)
+        return {"policy": self.policy,
+                "n_replicas": len(self.replicas),
+                "routed": list(self.routed),
+                "affinity_hits": self.affinity_hits,
+                "replicas": per}
+
+
+__all__ = ["Router", "POLICIES"]

@@ -34,9 +34,11 @@ from __future__ import annotations
 
 import functools
 
+import jax
 from jax import custom_vjp, lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
-from tpu_ddp.parallel.mesh import MODEL_AXIS
+from tpu_ddp.parallel.mesh import MODEL_AXIS, make_mesh
 
 
 @functools.partial(custom_vjp, nondiff_argnums=(1,))
@@ -87,3 +89,65 @@ def _tp_output_bwd(axis_name, _, g):
 
 
 tp_output.defvjp(_tp_output_fwd, _tp_output_bwd)
+
+
+# ---- tensor-parallel SERVING ------------------------------------------
+
+
+def serve_param_specs(model) -> dict:
+    """Megatron placement for a dense decode checkpoint, independent of
+    the model's training-time ``tp_size`` (a DP-trained checkpoint is
+    dense; serving re-shards it): attention head axes and the MLP
+    hidden axis split over ``mp``, LayerNorms / embeddings / LM head
+    replicated. Mirrors :meth:`TransformerLM.param_specs` but hardwires
+    the ``mp`` mesh axis — the training specs go replicated whenever
+    the model itself was not built tensor-parallel."""
+    if getattr(model, "moe_experts", 0):
+        raise ValueError("tensor-parallel serving supports dense "
+                         "models only (MoE routing is not decodable "
+                         "through the paged engine)")
+    mp = MODEL_AXIS
+    ln = {"scale": P(), "bias": P()}
+    blk = {
+        "ln1": dict(ln),
+        "wo": P(mp, None, None),
+        "ln2": dict(ln),
+        "w1": P(None, mp),
+        "w2": P(mp, None),
+    }
+    if model.is_gqa:
+        blk["wq"] = P(None, mp, None)
+        blk["wkv"] = P(None, None, mp, None)
+    else:
+        blk["wqkv"] = P(None, None, mp, None)
+    return {
+        "embed": P(),
+        "ln_f": dict(ln),
+        "head": P(),
+        "blocks": tuple(dict(blk) for _ in range(model.num_layers)),
+    }
+
+
+def shard_decode_params(model, params, devices=None):
+    """Place dense decode params onto an ``mp``-only mesh over
+    ``devices`` per :func:`serve_param_specs`; returns ``(params,
+    mesh)``. The serve engine's jitted steps then run under GSPMD:
+    QKV/MLP up-projections are column-parallel (no communication), the
+    attention output and MLP down-projections row-parallel (one
+    all-reduce each) — the same two-psum-per-block cost as TP training,
+    with the KV pool and all host-built step inputs replicated."""
+    devices = list(devices) if devices is not None else jax.devices()
+    tp = len(devices)
+    kv = model.kv_heads
+    if model.num_heads % tp or kv % tp or model.d_ff % tp:
+        raise ValueError(
+            f"cannot shard decode params over {tp} devices: "
+            f"num_heads={model.num_heads}, kv_heads={kv}, "
+            f"d_ff={model.d_ff} must all be divisible by the "
+            "tensor-parallel degree")
+    mesh = make_mesh(devices, dp=1, mp=tp)
+    specs = serve_param_specs(model)
+    sharded = jax.tree.map(
+        lambda s, x: jax.device_put(x, NamedSharding(mesh, s)),
+        specs, params, is_leaf=lambda x: isinstance(x, P))
+    return sharded, mesh

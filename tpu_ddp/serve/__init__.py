@@ -18,6 +18,7 @@ from tpu_ddp.serve.kv_pool import PagedKVPool
 from tpu_ddp.serve.loadgen import (
     RequestSpec,
     calibrate_rate,
+    make_shared_prefix_workload,
     make_workload,
     run_load,
 )
@@ -25,5 +26,6 @@ from tpu_ddp.serve.scheduler import Scheduler
 
 __all__ = [
     "PagedKVPool", "Request", "RequestSpec", "Scheduler", "ServeEngine",
-    "calibrate_rate", "make_workload", "run_load",
+    "calibrate_rate", "make_shared_prefix_workload", "make_workload",
+    "run_load",
 ]

@@ -88,6 +88,40 @@ class Request:
         return self.first_token_at - self.submitted_at
 
 
+def decode_bank(model, block_size: int, blocks_per_seq: int, params,
+                pool_k, pool_v, tables, lengths, last_tokens, temps,
+                seeds):
+    """The traced body of the whole-bank decode step — one token for
+    every live slot. Module-level (not a closure) so the disagg fused
+    adopt+decode program (tpu_ddp/fleet/disagg.py) can prepend its
+    KV-block adoption scatter and reuse the identical decode math —
+    bitwise parity between fleet and single-engine output depends on
+    there being exactly ONE implementation of this body."""
+    S = tables.shape[0]
+    cd = model.compute_dtype
+    x = params["embed"][last_tokens[:, None]].astype(cd)  # (S, 1, dm)
+    pos = lengths[:, None]                                # (S, 1)
+    bidx = jnp.take_along_axis(
+        tables, (lengths // block_size)[:, None], axis=1)[:, 0]
+    off = lengths % block_size
+    for li, blk in enumerate(params["blocks"]):
+        q, k, v = project_qkv(model, blk, x, pos)
+        pool_k = pool_k.at[li, bidx, off].set(
+            k[:, 0].astype(pool_k.dtype))
+        pool_v = pool_v.at[li, bidx, off].set(
+            v[:, 0].astype(pool_v.dtype))
+        view = (S, blocks_per_seq * block_size) + pool_k.shape[3:]
+        ck = pool_k[li][tables].reshape(view)
+        cv = pool_v[li][tables].reshape(view)
+        o = attend_cached(model, q, ck, cv, pos)
+        x = block_finish(model, blk, x, o)
+    logits = model.head_apply(params, x)[:, 0]            # (S, V)
+    toks, lps = jax.vmap(
+        lambda lg, t, sd, p: sample_token(model, lg, t, sd, p))(
+            logits, temps, seeds, lengths + 1)
+    return pool_k, pool_v, toks, lps
+
+
 # Both step builders are memoized on (model, block_size, blocks_per_seq)
 # — model is a frozen dataclass, so the key is by-value. Every engine
 # with the same cache geometry shares ONE compiled program; sweep
@@ -101,29 +135,9 @@ def _build_decode_step(model, block_size: int, blocks_per_seq: int):
 
     def step(params, pool_k, pool_v, tables, lengths, last_tokens,
              temps, seeds):
-        S = tables.shape[0]
-        cd = model.compute_dtype
-        x = params["embed"][last_tokens[:, None]].astype(cd)  # (S, 1, dm)
-        pos = lengths[:, None]                                # (S, 1)
-        bidx = jnp.take_along_axis(
-            tables, (lengths // block_size)[:, None], axis=1)[:, 0]
-        off = lengths % block_size
-        for li, blk in enumerate(params["blocks"]):
-            q, k, v = project_qkv(model, blk, x, pos)
-            pool_k = pool_k.at[li, bidx, off].set(
-                k[:, 0].astype(pool_k.dtype))
-            pool_v = pool_v.at[li, bidx, off].set(
-                v[:, 0].astype(pool_v.dtype))
-            view = (S, blocks_per_seq * block_size) + pool_k.shape[3:]
-            ck = pool_k[li][tables].reshape(view)
-            cv = pool_v[li][tables].reshape(view)
-            o = attend_cached(model, q, ck, cv, pos)
-            x = block_finish(model, blk, x, o)
-        logits = model.head_apply(params, x)[:, 0]            # (S, V)
-        toks, lps = jax.vmap(
-            lambda lg, t, sd, p: sample_token(model, lg, t, sd, p))(
-                logits, temps, seeds, lengths + 1)
-        return pool_k, pool_v, toks, lps
+        return decode_bank(model, block_size, blocks_per_seq, params,
+                           pool_k, pool_v, tables, lengths,
+                           last_tokens, temps, seeds)
 
     return jax.jit(step, donate_argnums=(1, 2))
 
@@ -186,6 +200,8 @@ class ServeEngine:
                  num_blocks: int | None = None,
                  cache_dtype: str | None = None,
                  mode: str = "continuous",
+                 prefix_cache: bool | None = None,
+                 mesh=None,
                  metrics: MetricsLogger | None = None,
                  config=None):
         check_decodable(model)
@@ -211,7 +227,24 @@ class ServeEngine:
                        else config.serve_cache_dtype)
         self.pool = PagedKVPool(model, num_blocks, self.block_size,
                                 cache_dtype)
-        self.sched = Scheduler(self.pool, self.num_slots, mode)
+        # Tensor-parallel serving: params arrive pre-sharded over
+        # ``mesh``'s model axis (parallel/tensor_parallel.py
+        # shard_decode_params); the pool and every host-built input
+        # ride replicated and GSPMD partitions the two jitted steps.
+        self.mesh = mesh
+        if mesh is not None:
+            from tpu_ddp.parallel.mesh import replicated_sharding
+            rep = replicated_sharding(mesh)
+            self.pool.k = jax.device_put(self.pool.k, rep)
+            self.pool.v = jax.device_put(self.pool.v, rep)
+        prefix_cache = (bool(prefix_cache) if prefix_cache is not None
+                        else config.prefix_cache)
+        self.prefix = None
+        if prefix_cache:
+            from tpu_ddp.fleet.prefix import PrefixIndex
+            self.prefix = PrefixIndex(self.pool)
+        self.sched = Scheduler(self.pool, self.num_slots, mode,
+                               prefix=self.prefix)
         self.metrics = metrics if metrics is not None \
             else MetricsLogger(None)
         self._decode = _build_decode_step(model, self.block_size,
@@ -222,10 +255,30 @@ class ServeEngine:
 
     @classmethod
     def from_checkpoint(cls, model, directory: str,
-                        step: int | None = None, **kwargs):
+                        step: int | None = None, *,
+                        param_budget_bytes: int | None = None,
+                        shard_devices=None, **kwargs):
         """Load a trained checkpoint (any strategy — the artifact is
-        canonical) into a fresh engine: the train→serve round trip."""
+        canonical) into a fresh engine: the train→serve round trip.
+
+        When the dense params exceed ``param_budget_bytes`` (one
+        chip's budget) — or ``shard_devices`` is passed explicitly —
+        the engine serves tensor-parallel: params shard over the
+        Megatron head/d_ff axes (parallel/tensor_parallel.py) across
+        the given devices and both jitted steps run under GSPMD.
+        Below budget the round-12 single-chip path is unchanged."""
         params = dense_params_from_checkpoint(model, directory, step)
+        if shard_devices is None and param_budget_bytes is not None:
+            nbytes = sum(x.nbytes for x in jax.tree.leaves(params))
+            if nbytes > param_budget_bytes:
+                shard_devices = jax.devices()
+        if shard_devices is not None:
+            from tpu_ddp.parallel.tensor_parallel import (
+                shard_decode_params,
+            )
+            params, mesh = shard_decode_params(model, params,
+                                               shard_devices)
+            return cls(model, params, mesh=mesh, **kwargs)
         return cls(model, params, **kwargs)
 
     # ---- request lifecycle ---------------------------------------------
@@ -310,6 +363,31 @@ class ServeEngine:
             n += 1
         return n
 
+    # ---- router hooks --------------------------------------------------
+
+    def outstanding(self) -> int:
+        """Tokens of work still owed (queued + live) — the router's
+        least-loaded load estimate."""
+        w = 0
+        for r in self.sched.queue:
+            w += len(r.prompt) + r.max_new_tokens
+        for s in self.sched.slots:
+            if s is not None:
+                w += (len(s.request.prompt) - s.prefill_done) \
+                    + (s.request.max_new_tokens - s.generated)
+        return w
+
+    def prefix_cached_len(self, prompt) -> int:
+        """Prompt tokens this engine's prefix cache already holds —
+        the router's prefix-affinity signal (0 without a cache)."""
+        if self.prefix is None:
+            return 0
+        return self.prefix.cached_len(
+            np.asarray(prompt, np.int32).reshape(-1))
+
+    def accounting_ok(self) -> bool:
+        return self.sched.accounting_ok()
+
     # ---- internals -----------------------------------------------------
 
     def _table_for(self, slot) -> np.ndarray:
@@ -333,6 +411,11 @@ class ServeEngine:
         s.prefill_done = min(start + C, int(req.prompt.size))
         s.length = s.prefill_done
         if s.prefill_done >= req.prompt.size:
+            # Register BEFORE emitting: _emit may retire the slot
+            # (max_new_tokens == 1), and the index must take its
+            # holder refs while the blocks are still live.
+            if self.prefix is not None:
+                self.prefix.register(req.prompt, s.blocks)
             s.phase = "decode"
             self._emit(pi, int(tok), float(lp))  # the first token
 

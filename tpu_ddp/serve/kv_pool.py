@@ -13,16 +13,26 @@ worst case, and the same HBM serves many more concurrent sequences
 (the vLLM PagedAttention argument).
 
 Accounting is host-side and exact, and deliberately simple: a free
-list of block ids. Block 0 is the NULL block — never allocated, never
-freed. It is where the jitted steps redirect every masked write
-(idle decode slots, prefill padding), so out-of-range scatters land in
-a sacrificial page instead of a page owned by another request; its
-contents are garbage by design and are never attended (the causal
-position mask in ``decode.attend_cached`` zeroes any read beyond a
-query's own length). The invariant the accounting test pins:
-``free_count + sum(live block-table lengths) == num_blocks - 1``
-at every step, and ``free_count`` returns to ``num_blocks - 1`` once
-all requests retire — no leaks, no double frees.
+list of block ids plus a per-block REFCOUNT. Block 0 is the NULL block
+— never allocated, never freed. It is where the jitted steps redirect
+every masked write (idle decode slots, prefill padding), so
+out-of-range scatters land in a sacrificial page instead of a page
+owned by another request; its contents are garbage by design and are
+never attended (the causal position mask in ``decode.attend_cached``
+zeroes any read beyond a query's own length).
+
+Refcounts are what makes prefix caching (tpu_ddp/fleet/prefix.py)
+safe: a block holding a shared system prompt's KV appears in MANY
+block tables at once (plus the prefix index itself), and is returned
+to the free list only when the LAST holder drops it. ``free`` is
+therefore a decref; ``incref`` adds a holder; ``cow`` gives a writer
+its own copy of a shared block before it diverges. The accounting
+identity generalizes from round 12's
+``free + Σ live block-table lengths == total usable`` to
+``free + Σ unique-allocated == total usable`` with per-block
+refcounts equal to the number of holders — :meth:`refcount_ok` checks
+exactly that, and double-free / null-free / negative-refcount all
+still raise rather than corrupt.
 
 Cache dtype rides the SAME policy vocabulary as training's saved
 activations (tpu_ddp/memory/policy.py): "compute" stores what the
@@ -70,6 +80,16 @@ class PagedKVPool:
         # LIFO free list: recently-freed (still-hot) pages are reused
         # first. Block 0 is never a member.
         self._free = list(range(num_blocks - 1, 0, -1))
+        # refs[b] == number of holders (block tables + prefix-index
+        # entries) for an allocated block; 0 for free blocks and the
+        # null block.
+        self._refs = [0] * num_blocks
+        # Optional last-resort reclaimer (the prefix index registers
+        # itself here): consulted when the free list runs dry, it may
+        # drop index-only holders to turn evictable blocks into free
+        # ones. Interface: ``.evictable_count`` (int property) and
+        # ``.reclaim(n) -> int`` (blocks actually freed).
+        self.reclaimer = None
 
     # ---- allocator -----------------------------------------------------
 
@@ -82,33 +102,103 @@ class PagedKVPool:
     def free_count(self) -> int:
         return len(self._free)
 
+    @property
+    def allocatable(self) -> int:
+        """Blocks an admission may count on: free now, plus what the
+        reclaimer could evict on demand (prefix-index entries nobody
+        else holds). This — not ``free_count`` — is what the
+        scheduler's reservation rule budgets against once a prefix
+        index is attached, otherwise cold cache entries would block
+        admission forever."""
+        extra = self.reclaimer.evictable_count if self.reclaimer else 0
+        return len(self._free) + extra
+
     def blocks_for(self, n_tokens: int) -> int:
         """Blocks needed to hold ``n_tokens`` cache slots."""
         return math.ceil(n_tokens / self.block_size)
 
     def alloc(self) -> int:
-        """Claim one free block id. The scheduler's reservation rule
-        (tpu_ddp/serve/scheduler.py) guarantees this never raises for
-        an admitted request; raising (not waiting) keeps the bug loud
-        if that invariant is ever broken."""
+        """Claim one free block id (refcount 1). The scheduler's
+        reservation rule (tpu_ddp/serve/scheduler.py) guarantees this
+        never raises for an admitted request; raising (not waiting)
+        keeps the bug loud if that invariant is ever broken."""
+        if not self._free and self.reclaimer is not None:
+            self.reclaimer.reclaim(1)
         if not self._free:
             raise RuntimeError(
                 "KV pool exhausted — the scheduler admitted more "
                 "worst-case tokens than the pool holds (reservation "
                 "accounting bug)")
-        return self._free.pop()
+        b = self._free.pop()
+        self._refs[b] = 1
+        return b
+
+    def refcount(self, b: int) -> int:
+        return self._refs[b]
+
+    def incref(self, blocks) -> None:
+        """Add one holder to each block (prefix-index registration, or
+        a new request sharing cached prompt blocks)."""
+        for b in blocks:
+            self._check_id(b)
+            if self._refs[b] == 0:
+                raise ValueError(
+                    f"incref of unallocated block {b} — a holder can "
+                    "only be added to a live block")
+            self._refs[b] += 1
 
     def free(self, blocks) -> None:
-        """Return a request's blocks. Double-free and null-free are
-        accounting corruption, not recoverable states — raise."""
+        """Drop one holder per block; a block returns to the free list
+        when its LAST holder lets go. Double-free (decref below zero)
+        and null-free are accounting corruption, not recoverable
+        states — raise."""
         for b in blocks:
-            if b == self.NULL_BLOCK:
-                raise ValueError("attempted to free the null block")
-            if not 0 < b < self.num_blocks:
-                raise ValueError(f"block id {b} out of range")
-            if b in self._free:
+            self._check_id(b)
+            if self._refs[b] == 0:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._free.append(b)
+
+    def cow(self, b: int):
+        """Copy-on-write: give the caller a PRIVATE copy of shared
+        block ``b`` (refcount 1 on the copy; ``b``'s refcount is
+        untouched — the caller still drops its own share). The device
+        copy happens once, at admission, off the decode hot path."""
+        self._check_id(b)
+        if self._refs[b] == 0:
+            raise ValueError(f"copy-on-write of unallocated block {b}")
+        new = self.alloc()
+        self.k = self.k.at[:, new].set(self.k[:, b])
+        self.v = self.v.at[:, new].set(self.v[:, b])
+        return new
+
+    def _check_id(self, b: int) -> None:
+        if b == self.NULL_BLOCK:
+            raise ValueError("the null block is never allocated, "
+                             "freed, or shared")
+        if not 0 < b < self.num_blocks:
+            raise ValueError(f"block id {b} out of range")
+
+    def refcount_ok(self, holders) -> bool:
+        """The extended accounting identity. ``holders`` is an
+        iterable of block-id lists — every live block table plus the
+        prefix index's held set. Checks (a) each block's refcount
+        equals its number of appearances, (b) free blocks have no
+        holders, and (c) ``free + Σ unique-allocated == total``."""
+        counts = [0] * self.num_blocks
+        for hold in holders:
+            for b in hold:
+                counts[b] += 1
+        if counts[self.NULL_BLOCK]:
+            return False
+        for b in range(1, self.num_blocks):
+            if counts[b] != self._refs[b]:
+                return False
+            if counts[b] and b in self._free:
+                return False
+        unique = sum(1 for b in range(1, self.num_blocks) if counts[b])
+        return self.free_count + unique == self.total_usable
 
     # ---- device state --------------------------------------------------
 

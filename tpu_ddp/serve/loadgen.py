@@ -10,6 +10,13 @@ continuously in between. Per run we report:
 - ``ttft_p50_ms`` / ``ttft_p99_ms`` — time from arrival to first
   streamed token. The SLO metric: it is what queueing delay + prefill
   chunking actually do to a user.
+- ``e2e_p50_ms`` / ``e2e_p99_ms`` — arrival to final token: the whole
+  wait, which TTFT alone understates for long generations.
+- ``tpot_p50_ms`` / ``tpot_p99_ms`` / ``tpot_mean_ms`` — time per
+  output token AFTER the first, per request. The streaming-smoothness
+  metric: disaggregation's claim is precisely that prefill bursts stop
+  showing up here. Single-token requests have no inter-token gaps and
+  are excluded.
 - ``tokens_per_sec`` — completed generated tokens / makespan, the
   throughput axis of the latency/throughput frontier.
 - ``goodput_tokens_per_sec`` — tokens from requests whose TTFT met
@@ -64,6 +71,33 @@ def make_workload(n: int, vocab_size: int, seed: int = 0,
     return specs
 
 
+def make_shared_prefix_workload(n: int, vocab_size: int, seed: int = 0,
+                                prefix_len: int = 16,
+                                tail_len: tuple[int, int] = (2, 9),
+                                max_new: tuple[int, int] = (4, 9),
+                                temperature: float = 0.0
+                                ) -> list[RequestSpec]:
+    """``n`` seeded requests sharing one ``prefix_len``-token system
+    prompt, each with a distinct random tail — the workload prefix
+    caching exists for: an uncached fleet prefills the shared prefix
+    ``n`` times, a cached one once (plus tails). The hit-rate and
+    prefilled-blocks gaps are pinned by tests/test_fleet.py and the
+    serve sweep's shared-prompt cell."""
+    rng = np.random.default_rng(seed)
+    system = tuple(int(t) for t in
+                   rng.integers(0, vocab_size, size=prefix_len))
+    specs = []
+    for i in range(n):
+        t_len = int(rng.integers(*tail_len))
+        tail = tuple(int(t) for t in
+                     rng.integers(0, vocab_size, size=t_len))
+        specs.append(RequestSpec(
+            prompt=system + tail,
+            max_new_tokens=int(rng.integers(*max_new)),
+            temperature=temperature, seed=i))
+    return specs
+
+
 def poisson_arrivals(n: int, rate: float, seed: int = 0) -> np.ndarray:
     """``n`` arrival offsets (seconds from run start) at ``rate``
     requests/second."""
@@ -99,6 +133,13 @@ def run_load(engine, specs: list[RequestSpec], rate: float,
 
     ttfts = np.array([h.ttft_s for h in handles]) * 1e3  # ms
     n_tokens = np.array([len(h.tokens) for h in handles])
+    e2es = np.array([h.finished_at - h.submitted_at
+                     for h in handles]) * 1e3             # ms
+    # Per-request mean time per output token after the first;
+    # single-token requests have no inter-token gap to measure.
+    tpots = np.array([(h.finished_at - h.first_token_at)
+                      / (len(h.tokens) - 1)
+                      for h in handles if len(h.tokens) > 1]) * 1e3
     makespan = t_end - t0
     if slo_ttft_ms is None:
         good = n_tokens.sum()
@@ -112,6 +153,14 @@ def run_load(engine, specs: list[RequestSpec], rate: float,
         "ttft_p50_ms": round(float(np.percentile(ttfts, 50)), 3),
         "ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 3),
         "ttft_mean_ms": round(float(ttfts.mean()), 3),
+        "e2e_p50_ms": round(float(np.percentile(e2es, 50)), 3),
+        "e2e_p99_ms": round(float(np.percentile(e2es, 99)), 3),
+        "tpot_p50_ms": (round(float(np.percentile(tpots, 50)), 3)
+                        if tpots.size else None),
+        "tpot_p99_ms": (round(float(np.percentile(tpots, 99)), 3)
+                        if tpots.size else None),
+        "tpot_mean_ms": (round(float(tpots.mean()), 3)
+                         if tpots.size else None),
         "tokens_per_sec": round(float(n_tokens.sum()) / makespan, 3),
         "slo_ttft_ms": slo_ttft_ms,
         "slo_attained": (None if slo_ttft_ms is None else

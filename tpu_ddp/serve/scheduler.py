@@ -19,13 +19,20 @@ Invariants (docs/DESIGN.md §19, pinned by tests/test_serve.py):
   no request waits forever behind later arrivals.
 - **Admitted requests always finish.** Admission reserves the WORST
   CASE block count ``ceil((prompt + max_new) / block_size)`` against
-  ``pool.free_count`` minus every live request's still-unallocated
+  ``pool.allocatable`` minus every live request's still-unallocated
   reservation. Blocks are then allocated lazily as the sequence grows,
   but the reservation means mid-flight allocation can never fail —
   no deadlock where live requests starve each other out of pages.
-- **Page-pool accounting.** ``free + Σ live allocated == total
-  usable`` at every step; retirement returns exactly the allocated
-  blocks (pool raises on double free / null free).
+  With a prefix index attached the reservation ledger charges every
+  draw an admission can make on ``free + evictable``: fresh blocks
+  (``need`` minus cached hits, plus one for a CoW copy) AND each hit
+  block whose share converts an evictable cache entry into a pinned
+  one. Nothing else ever shrinks ``free + evictable``, so lazy
+  mid-flight allocation still cannot fail.
+- **Page-pool accounting.** ``free + Σ unique-allocated == total
+  usable`` at every step with per-block refcounts equal to holder
+  counts (``pool.refcount_ok``); retirement drops exactly one holder
+  per allocated block (pool raises on double free / null free).
 
 ``mode="static"`` is the experiment baseline, NOT a production path:
 admission waits until EVERY slot is idle, fills all slots from the
@@ -58,15 +65,28 @@ class SlotState:
 
 
 class Scheduler:
-    def __init__(self, pool, num_slots: int, mode: str = "continuous"):
+    def __init__(self, pool, num_slots: int, mode: str = "continuous",
+                 prefix=None, role: str = "serve"):
         if mode not in ("continuous", "static"):
             raise ValueError(f"unknown scheduler mode {mode!r}; "
                              "expected 'continuous' or 'static'")
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if role not in ("serve", "prefill"):
+            raise ValueError(f"unknown scheduler role {role!r}; "
+                             "expected 'serve' or 'prefill'")
         self.pool = pool
         self.num_slots = num_slots
         self.mode = mode
+        # Optional fleet.prefix.PrefixIndex: admission consults it so
+        # shared-prompt requests adopt cached blocks instead of
+        # re-prefilling them.
+        self.prefix = prefix
+        # "serve" = round-12 behavior, prefill + decode in place.
+        # "prefill" = the disagg prefill role: this scheduler only ever
+        # holds prompts (reservations exclude generation tokens — the
+        # finished KV ships over the edge and decodes elsewhere).
+        self.role = role
         self.queue: deque = deque()
         self.slots: list[SlotState | None] = [None] * num_slots
         self._admit_seq = 0
@@ -85,6 +105,8 @@ class Scheduler:
                    for s in self.slots if s is not None)
 
     def worst_case_blocks(self, request) -> int:
+        if self.role == "prefill":
+            return self.pool.blocks_for(len(request.prompt))
         return self.pool.blocks_for(len(request.prompt)
                                     + request.max_new_tokens)
 
@@ -130,19 +152,66 @@ class Scheduler:
                 continue
             req = self.queue[0]
             need = self.worst_case_blocks(req)
-            if need > self.pool.free_count - self.reserved_unallocated:
+            hit = (self.prefix.plan(req.prompt)
+                   if self.prefix is not None else None)
+            # Every draw this admission makes on (free + evictable):
+            # fresh blocks (need minus cached hits, +1 for the CoW
+            # copy), plus each hit whose share pins a previously
+            # evictable cache entry.
+            draw = need
+            if hit is not None:
+                draw -= len(hit.blocks)
+                draw += 1 if hit.cow else 0
+                draw += sum(self.pool.refcount(b) == 1
+                            for b in hit.blocks)
+            if draw > self.pool.allocatable - self.reserved_unallocated:
                 break  # FIFO: never skip the head
             self.queue.popleft()
             slot = SlotState(request=req, admit_seq=self._admit_seq,
                              phase="prefill", reserved=need)
             self._admit_seq += 1
-            # Prompt blocks up front (prefill scatters into them this
-            # or next step); generation blocks arrive lazily.
-            for _ in range(self.pool.blocks_for(len(req.prompt))):
+            if hit is not None:
+                self.prefix.share(hit)  # no-op stats on a miss
+            if hit:
+                slot.blocks = list(hit.blocks)
+                if hit.cow:
+                    # The last hit block would be written in place by
+                    # the re-run of the final prompt token — swap in a
+                    # private copy and drop our share of the original.
+                    private = self.pool.cow(slot.blocks[-1])
+                    self.pool.free([slot.blocks[-1]])
+                    slot.blocks[-1] = private
+                slot.prefill_done = hit.cached_len
+                slot.length = hit.cached_len
+            # Remaining prompt blocks up front (prefill scatters into
+            # them this or next step); generation blocks arrive lazily.
+            for _ in range(self.pool.blocks_for(len(req.prompt))
+                           - len(slot.blocks)):
                 slot.blocks.append(self.pool.alloc())
             self.slots[i] = slot
             admitted.append(i)
         return admitted
+
+    def place(self, request, blocks, length: int,
+              pending_token: int) -> int:
+        """Install an externally prefilled sequence into a free slot —
+        the disagg decode role's admission path. ``blocks`` are
+        already allocated from THIS scheduler's pool (the edge
+        adoption); the slot starts directly in the decode phase with
+        its first sampled token pending. The caller checks the
+        reservation rule before adopting."""
+        for i in range(self.num_slots):
+            if self.slots[i] is None:
+                self.slots[i] = SlotState(
+                    request=request, admit_seq=self._admit_seq,
+                    phase="decode", length=length, prefill_done=length,
+                    generated=len(request.tokens),
+                    pending_token=pending_token, blocks=list(blocks),
+                    reserved=self.worst_case_blocks(request))
+                self._admit_seq += 1
+                return i
+        raise RuntimeError("place() called with no free slot — the "
+                           "adopter must check capacity first")
 
     def ensure_block(self, idx: int) -> None:
         """Grow slot ``idx``'s table to cover writing position
@@ -159,7 +228,11 @@ class Scheduler:
         self.slots[idx] = None
 
     def accounting_ok(self) -> bool:
-        """The §19 page-pool invariant, checkable at any step."""
-        allocated = sum(len(s.blocks)
-                        for s in self.slots if s is not None)
-        return self.pool.free_count + allocated == self.pool.total_usable
+        """The page-pool invariant (§19, extended by §21 refcounts),
+        checkable at any step: every holder the scheduler knows about
+        — live block tables plus the prefix index — accounts for every
+        refcount, and ``free + Σ unique-allocated == total usable``."""
+        holders = [s.blocks for s in self.slots if s is not None]
+        if self.prefix is not None:
+            holders.append(self.prefix.held_blocks())
+        return self.pool.refcount_ok(holders)

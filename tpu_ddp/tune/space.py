@@ -186,6 +186,35 @@ KNOBS: tuple[Knob, ...] = (
              "tpu_ddp/memory/policy.py): 'bf16' under an f32 compute "
              "model halves cache reads but rounds the attended "
              "history — semantic, gated like act_dtype"),
+    # Fleet knobs (tpu_ddp/fleet/): the serving-fleet layer on top of
+    # the engine — same "goodput" objective, measured by the same
+    # loadgen harness.
+    Knob("fleet_roles", "fleet_roles", "TPU_DDP_FLEET_ROLES",
+         values=("single", "disagg"), objective="goodput",
+         doc="engine role split: 'disagg' runs a dedicated prefill "
+             "role streaming finished KV blocks to a decode role over "
+             "an explicit edge (fleet/disagg.py), so long prefills "
+             "never steal decode-batch steps"),
+    Knob("prefix_cache", "prefix_cache", "TPU_DDP_PREFIX_CACHE",
+         values=(False, True), objective="goodput",
+         doc="refcounted shared-prefix KV cache (fleet/prefix.py): "
+             "requests sharing a system prompt pay one prefill; "
+             "exactness-preserving via copy-on-write, so searchable "
+             "without a semantic gate"),
+    Knob("router_policy", "router_policy", "TPU_DDP_ROUTER_POLICY",
+         values=("least-loaded", "prefix-affinity"),
+         objective="goodput",
+         doc="multi-replica routing (fleet/router.py): "
+             "'prefix-affinity' sends a request to the replica whose "
+             "prefix cache holds its longest match (cache hit-rate "
+             "over pure load spreading); needs prefix_cache"),
+    Knob("kv_wire", "kv_wire", "TPU_DDP_KV_WIRE",
+         values=("none", "bf16", "int8"), semantic=True,
+         objective="goodput",
+         doc="disagg prefill->decode edge wire format "
+             "(parallel/compress.py EdgeCodec): 'bf16'/'int8' shrink "
+             "the shipped KV payload but round it — semantic, gated "
+             "like serve_cache_dtype"),
 )
 
 # Model-level knobs are baked into get_model() before the Trainer ever
@@ -325,6 +354,19 @@ def violations(assignment: Mapping, ctx: Workload) -> list[str]:
             f"serve_cache_dtype={scd!r} with compute_dtype={cdty!r} — "
             "the cache cast is a no-op, duplicate of 'compute' "
             "(tpu_ddp/memory/policy.py resolve_act_dtype)")
+    # Fleet knobs (tpu_ddp/fleet/) — mirror the fleet layer's guards.
+    kw = get("kv_wire", "none")
+    if kw != "none" and get("fleet_roles", "single") != "disagg":
+        bad.append(
+            f"kv_wire={kw!r} without fleet_roles='disagg' — no edge "
+            "exists for the wire format to compress, so the cell "
+            "duplicates the default")
+    if (get("router_policy", "least-loaded") == "prefix-affinity"
+            and not get("prefix_cache", False)):
+        bad.append(
+            "router_policy='prefix-affinity' without prefix_cache — "
+            "every replica reports a zero-length cached prefix, so "
+            "routing degenerates to least-loaded (duplicate cell)")
     # Pipeline knobs (round 10) — mirror PipelineLMTrainer's guards.
     sched = get("pp_schedule", "gpipe")
     virt = get("pp_virtual", 1)

@@ -168,6 +168,27 @@ class TrainConfig:
     # act_dtype. Env: TPU_DDP_SERVE_CACHE_DTYPE.
     serve_cache_dtype: str = "compute"
 
+    # Serving fleet (tpu_ddp/fleet/): engine role split — "single"
+    # (round-12 engine: prefill + decode in one program pair) or
+    # "disagg" (dedicated prefill role streaming finished KV blocks to
+    # a decode role over an explicit edge). Env: TPU_DDP_FLEET_ROLES.
+    fleet_roles: str = "single"
+    # Refcounted shared-prefix KV cache (tpu_ddp/fleet/prefix.py): N
+    # requests sharing a system prompt pay ONE prefill. Exactness-
+    # preserving (copy-on-write at the first divergent token). Env:
+    # TPU_DDP_PREFIX_CACHE.
+    prefix_cache: bool = False
+    # Multi-replica router policy (tpu_ddp/fleet/router.py):
+    # "least-loaded" or "prefix-affinity" (route to the replica whose
+    # prefix cache holds the longest match; needs prefix_cache). Env:
+    # TPU_DDP_ROUTER_POLICY.
+    router_policy: str = "least-loaded"
+    # Wire format for the disagg prefill->decode KV-block edge, riding
+    # parallel/compress.py's EdgeCodec vocabulary: "none" (dense),
+    # "bf16", "int8". Lossy formats round the shipped KV, so the knob
+    # is semantic (gated like cache dtype). Env: TPU_DDP_KV_WIRE.
+    kv_wire: str = "none"
+
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
     max_iters: int | None = None
@@ -355,6 +376,31 @@ class TrainConfig:
             raise ValueError(
                 f"serve_cache_dtype={self.serve_cache_dtype!r}: expected "
                 "compute|bf16|f32 (TPU_DDP_SERVE_CACHE_DTYPE)")
+        env_fr = os.environ.get("TPU_DDP_FLEET_ROLES")
+        if env_fr:
+            self.fleet_roles = env_fr
+        if self.fleet_roles not in ("single", "disagg"):
+            raise ValueError(
+                f"fleet_roles={self.fleet_roles!r}: expected "
+                "single|disagg (TPU_DDP_FLEET_ROLES)")
+        self.prefix_cache = _env_bool("TPU_DDP_PREFIX_CACHE",
+                                      self.prefix_cache)
+        env_rp = os.environ.get("TPU_DDP_ROUTER_POLICY")
+        if env_rp:
+            self.router_policy = env_rp
+        if self.router_policy not in ("least-loaded", "prefix-affinity"):
+            raise ValueError(
+                f"router_policy={self.router_policy!r}: expected "
+                "least-loaded|prefix-affinity (TPU_DDP_ROUTER_POLICY)")
+        env_kw = os.environ.get("TPU_DDP_KV_WIRE")
+        if env_kw:
+            self.kv_wire = env_kw
+        # Mirrors parallel/compress.py EdgeCodec wire kinds (the
+        # source of truth, which re-validates at edge construction).
+        if self.kv_wire not in ("none", "bf16", "int8"):
+            raise ValueError(
+                f"kv_wire={self.kv_wire!r}: expected none|bf16|int8 "
+                "(TPU_DDP_KV_WIRE)")
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.

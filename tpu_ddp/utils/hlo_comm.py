@@ -384,6 +384,207 @@ def overlap_report(hlo_text: str, min_payload_bytes: int = 1024) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# The same dataflow predicate, generalized from collectives to LARGE
+# in-place updates — the disagg fleet's KV-block adoption scatter
+# (tpu_ddp/fleet/disagg.py). The claim to check is identical in shape:
+# the fused adopt+decode program applies the transfer's payload with a
+# scatter that depends on nothing the decode computes (it runs against
+# freshly allocated, table-less block ids), so a latency-hiding
+# scheduler is ALLOWED to land the transfer behind decode compute. A
+# wrong fusion order — adopting AFTER the bank's writes — would put
+# every heavy op in the scatter's ancestor cone and serialize the edge
+# behind the step; that is the regression this analysis exists to
+# catch.
+#
+# Backend reality: XLA rarely leaves ``scatter`` standing at the entry
+# computation. The CPU expander lowers a multi-row scatter into a
+# ``while`` loop whose carried state holds the updates payload, and
+# single-row updates fuse into loop fusions with a
+# ``dynamic-update-slice`` root. The target picker therefore matches
+# any entry instruction that IS or CONTAINS (via called computations)
+# a scatter/dynamic-update-slice, and sizes its payload from the
+# shapes riding along: the largest tuple element / operand that is
+# NOT the in-place buffer itself (the buffer is always the biggest —
+# it's the whole pool). ``min_update_bytes`` then separates the
+# block-payload adoption (KBs per transfer) from the bank's own
+# per-token writes (one row per slot).
+# ---------------------------------------------------------------------------
+
+UPDATE_OPS = ("scatter", "dynamic-update-slice")
+
+_ENTRY_NAME = re.compile(r"^ENTRY\s+%?([\w.\-]+)", re.M)
+
+
+def _comp_has_update(comp_name, comps_instrs, memo) -> bool:
+    if comp_name in memo:
+        return memo[comp_name]
+    memo[comp_name] = False  # cycle guard
+    found = False
+    for rec in comps_instrs.get(comp_name, {}).values():
+        if _instr_has_update(rec, comps_instrs, memo):
+            found = True
+            break
+    memo[comp_name] = found
+    return found
+
+
+def _instr_has_update(rec, comps_instrs, memo) -> bool:
+    if rec["op"] in UPDATE_OPS:
+        return True
+    if rec["op"] in ("fusion", "call", "while", "conditional", "map"):
+        return any(_comp_has_update(c, comps_instrs, memo)
+                   for c in _called_comps(rec["attrs"]))
+    return False
+
+
+def _element_bytes(shape_str: str) -> list:
+    """Byte size of each array element of an HLO shape string (one
+    entry for a plain array, one per element for a tuple)."""
+    sizes = []
+    for dtype, dims in _SHAPE.findall(shape_str):
+        if dtype not in DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * DTYPE_BYTES[dtype])
+    return sizes
+
+
+def _update_payload_bytes(rec, instrs) -> int:
+    """Updates-operand size for an update-carrying instruction: the
+    largest shape riding along that is NOT the in-place buffer. For a
+    tuple result (scatter lowered to a while loop) the candidates are
+    the tuple elements; otherwise the resolvable operand shapes."""
+    if rec["shape"].startswith("("):
+        sizes = _element_bytes(rec["shape"])
+    else:
+        sizes = []
+        for o in rec.get("operands", []):
+            if o in instrs:
+                sizes.extend(_element_bytes(instrs[o]["shape"]))
+        sizes.extend([max(_element_bytes(rec["shape"]) or [0])])
+    if len(sizes) < 2:
+        return 0
+    sizes.sort()
+    buffer_bytes = sizes[-1]
+    rest = [s for s in sizes[:-1] if s < buffer_bytes]
+    return max(rest) if rest else 0
+
+
+def update_overlap_report(hlo_text: str,
+                          min_update_bytes: int = 4096) -> dict:
+    """Dataflow overlap verdict for large in-place updates in the
+    ENTRY computation — the disagg KV-adoption check.
+
+    The predicate is STRICTER than the collective one, because "some
+    heavy op outside both cones" is true even of a landing serialized
+    at the very end of the step (it could still overlap the sampling
+    tail). What "the transfer lands behind decode compute" actually
+    requires is that the landing can START at step begin: a target is
+    overlappable iff it has NO heavy ancestor (it waits on no compute)
+    AND at least one heavy op sits outside both its cones (there is
+    compute to hide behind). The verdict requires the LARGEST update
+    (the transfer landing) to pass. Never raises —
+    ``assert_transfer_overlap`` wraps it.
+    """
+    entry = _ENTRY_NAME.search(hlo_text)
+    empty = {"overlapped": False, "n_updates": 0, "n_overlappable": 0,
+             "n_heavy_ops": 0, "computation": None, "updates": [],
+             "min_update_bytes": min_update_bytes}
+    if entry is None:
+        return empty
+    comps_lines = _split_computations(hlo_text)
+    comps_instrs = {name: _parse_computation(lines)
+                    for name, lines in comps_lines.items()}
+    target = entry.group(1)
+    if target not in comps_instrs:
+        return empty
+    instrs = comps_instrs[target]
+    update_memo: dict = {}
+    heavy_memo: dict = {}
+
+    targets = []
+    for name, rec in instrs.items():
+        if not _instr_has_update(rec, comps_instrs, update_memo):
+            continue
+        payload = _update_payload_bytes(rec, instrs)
+        if payload >= min_update_bytes:
+            targets.append((name, payload))
+    if not targets:
+        return dict(empty, computation=target)
+
+    names = list(instrs)
+    idx = {n: i for i, n in enumerate(names)}
+    anc = [0] * len(names)
+    for i, n in enumerate(names):
+        m = 0
+        for o in instrs[n]["operands"]:
+            j = idx[o]
+            m |= anc[j] | (1 << j)
+        anc[i] = m
+    heavy_mask = 0
+    n_heavy = 0
+    for i, n in enumerate(names):
+        if _instr_is_heavy(instrs[n], comps_instrs, heavy_memo):
+            heavy_mask |= 1 << i
+            n_heavy += 1
+
+    tgt_idx = {n: idx[n] for n, _ in targets}
+    desc = {n: 0 for n in tgt_idx}
+    for i in range(len(names)):
+        for n, ti in tgt_idx.items():
+            if anc[i] >> ti & 1:
+                desc[n] |= 1 << i
+
+    updates = []
+    n_overlappable = 0
+    for n, payload in targets:
+        ti = tgt_idx[n]
+        # Heavy ops the landing must WAIT for (its ancestor cone): any
+        # here means the transfer cannot start until compute finishes —
+        # the serialized bad ordering, regardless of how much free
+        # compute the tail still has.
+        blocked_by = heavy_mask & anc[ti]
+        free = heavy_mask & ~anc[ti] & ~desc[n] & ~(1 << ti)
+        ok = not blocked_by and bool(free)
+        n_overlappable += ok
+        updates.append({"name": n, "payload_bytes": payload,
+                        "n_heavy_ancestors": bin(blocked_by).count("1"),
+                        "overlappable": ok})
+    updates.sort(key=lambda u: -u["payload_bytes"])
+    return {
+        "overlapped": bool(updates and updates[0]["overlappable"]),
+        "n_updates": len(updates),
+        "n_overlappable": n_overlappable,
+        "n_heavy_ops": n_heavy,
+        "computation": target,
+        "updates": updates,
+        "min_update_bytes": min_update_bytes,
+    }
+
+
+def assert_transfer_overlap(hlo_text: str,
+                            min_update_bytes: int = 4096) -> dict:
+    """Raise ``AssertionError`` unless the program's largest in-place
+    update (the disagg transfer landing) is dataflow-overlappable with
+    heavy compute; returns the report on success."""
+    report = update_overlap_report(hlo_text,
+                                   min_update_bytes=min_update_bytes)
+    if not report["overlapped"]:
+        raise AssertionError(
+            "the transfer-landing update is not overlappable with "
+            f"compute: {report['n_overlappable']}/{report['n_updates']} "
+            f"updates (>= {min_update_bytes}B payload) start free of "
+            "heavy ancestors with heavy ops outside their cones "
+            f"(computation={report['computation']!r}, "
+            f"heavy_ops={report['n_heavy_ops']}, "
+            f"updates={[(u['name'], u['n_heavy_ancestors']) for u in report['updates']]})")
+    return report
+
+
 def assert_overlap(hlo_text: str, min_payload_bytes: int = 1024) -> dict:
     """Raise ``AssertionError`` unless ``overlap_report`` says the step's
     gradient collectives are bucketized-and-overlappable; returns the
