@@ -777,6 +777,30 @@ def run_fleet_probe(n_requests: int = 24) -> dict:
     return out
 
 
+def run_graph_audit_probe() -> dict:
+    """Static graph audit (tpu_ddp/analysis/) on THIS backend's
+    compiled programs, through the committed sweep's own cell protocol
+    (scripts/graph_audit.py). The CPU tier already pins the verdicts;
+    what the chip adds is the lowering the CPU never sees — TPU
+    schedules emit async ``-start``/``-done`` collective pairs, so the
+    fingerprints recorded here exercise the pair-normalized counting
+    on real hardware and the donation/precision checks run against
+    the exact executables bench times."""
+    from scripts.graph_audit import audit_train_cell
+
+    out: dict = {"cells": {}}
+    for rung, kw in (("fused", {}), ("fused", {"grad_compress": "bf16"})):
+        cell = _sub(audit_train_cell, rung, **kw)
+        key = rung + ("+" + kw["grad_compress"] if kw else "")
+        out["cells"][key] = {
+            k: cell.get(k) for k in ("n_collectives", "findings",
+                                     "wire", "error")
+            if k in cell}
+    out["clean"] = all(not c.get("findings") and "error" not in c
+                       for c in out["cells"].values())
+    return out
+
+
 def _sub(fn, *args, **kwargs) -> dict:
     """Run one sub-benchmark; a failure becomes a recorded error, never a
     lost headline line (the driver captures exactly one JSON line)."""
@@ -944,6 +968,11 @@ def main() -> dict:
     # at equal simulated hardware — the p99-TTFT ordering under
     # oversubscription.
     extra["fleet"] = _sub(run_fleet_probe)
+    # Graph-audit probe (tpu_ddp/analysis/): donation/precision/
+    # lockstep-determinism verdicts on this chip's own lowered step
+    # programs (TPU schedules emit async collective pairs the CPU
+    # tier never compiles).
+    extra["graph_audit"] = _sub(run_graph_audit_probe)
     # Run-to-run variance control (round-3 verdict item 2): every
     # timed number is the MEDIAN of >= 3 consecutive chained windows,
     # with the raw per-window samples recorded next to it

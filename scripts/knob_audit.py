@@ -46,6 +46,10 @@ NONPERF_ENV = {
     "TPU_DDP_MAX_ITERS", "TPU_DDP_LR", "TPU_DDP_CKPT_EVERY",
     "TPU_DDP_CHECK_REPLICAS_EVERY", "TPU_DDP_GUARD",
     "TPU_DDP_GUARD_MAX_BAD", "TPU_DDP_AUTOTUNE",
+    # Graph audit (tpu_ddp/analysis/): a correctness gate, not a perf
+    # knob — it changes what is CHECKED at construction, never what
+    # executes, so searching it would be meaningless.
+    "TPU_DDP_AUDIT",
     # Elastic-membership infrastructure (resilience/elastic.py): the
     # launcher<->worker protocol plumbing, not knobs — only the mode
     # switch TPU_DDP_ELASTIC_RESHARD is a registry entry.

@@ -72,3 +72,19 @@ def cached_vgg_trainer(devices, strategy, dp=4):
         _TRAINER_CACHE[key] = Trainer(model, TrainConfig(),
                                       strategy=strategy, mesh=mesh)
     return _TRAINER_CACHE[key]
+
+
+@pytest.fixture
+def no_retrace():
+    """The retrace sentinel (tpu_ddp/analysis/retrace.py) as a fixture:
+
+        def test_loop(no_retrace):
+            with no_retrace(watch=("train_step",)):
+                for _ in range(5):
+                    trainer.train_step(state, *batch)
+
+    Raises RetraceError on exit if any watched callable compiled more
+    than once (the round-8 bug class: a "compiled" loop re-lowering
+    every call)."""
+    from tpu_ddp.analysis.retrace import no_retrace as _nr
+    return _nr

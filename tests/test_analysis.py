@@ -1,0 +1,371 @@
+"""Each graph-audit detector must catch its seeded defect AND pass a
+clean control — a detector that never fires is indistinguishable from
+one that checks nothing (the test_knob_audit.py doctrine, applied to
+the static program auditor in tpu_ddp/analysis/).
+
+Four drill classes, one per detector, each seeding the historical bug
+class the detector exists for:
+
+- donation: a donated-but-unaliasable buffer (static) and a held
+  ``np.asarray`` view defeating donation at runtime (round-10);
+- retrace: a shape-varying call recompiling a "compiled" path
+  (round-8);
+- lockstep: two programs issuing the same collectives in different
+  orders (the SPMD deadlock class);
+- precision: a naive bf16 psum that XLA widens back to f32 (round-7).
+
+Plus parser unit tests over synthetic HLO (async pair counting, alias
+headers, replica groups, f64 creep) and the construction-time gate's
+dispatch semantics.
+"""
+
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from tpu_ddp import analysis
+from tpu_ddp.analysis import (
+    GraphAuditError,
+    RetraceError,
+    collective_fingerprint,
+    collective_ops,
+    dispatch_findings,
+    donation_report,
+    fingerprint_digest,
+    lockstep_check,
+    no_retrace,
+    precision_report,
+    runtime_donation_check,
+)
+from tpu_ddp.analysis.donation import parse_input_output_alias
+from tpu_ddp.analysis.hlo import async_payload_shape, tuple_elements
+from tpu_ddp.analysis.lockstep import _replica_groups
+
+
+# ---------------------------------------------------------------------------
+# Parser units: synthetic HLO, no compiles.
+
+
+ASYNC_HLO = """\
+HloModule m
+
+ENTRY main (p0: f32[128]) -> f32[128] {
+  p0 = f32[128] parameter(0)
+  ars = (f32[128], f32[128], u32[]) all-reduce-start(p0), replica_groups={{0,1},{2,3}}
+  ROOT ard = f32[128] all-reduce-done(ars)
+}
+"""
+
+
+class TestHloParsers:
+    def test_async_pair_counts_once(self):
+        # Satellite (2): a -start/-done pair is ONE logical collective
+        # whose payload is the result tuple's element 1, not the sum
+        # of the start tuple plus a double-count from the done.
+        ops = collective_ops(ASYNC_HLO)
+        assert len(ops) == 1
+        (rec,) = ops
+        assert rec["op"] == "all-reduce" and rec["async"]
+        assert rec["dtype_bytes"] == {"f32": 128 * 4}
+
+    def test_async_payload_shape(self):
+        assert async_payload_shape(
+            "(f32[32], f32[32], u32[], u32[])") == "f32[32]"
+        assert tuple_elements("(f32[4], s8[8])") == ["f32[4]", "s8[8]"]
+        # Non-tuple shapes pass through (sync collectives).
+        assert async_payload_shape("f32[64]") == "f32[64]"
+
+    def test_alias_header_parsing(self):
+        text = ("HloModule m, input_output_alias={ {0}: (0, {}, "
+                "may-alias), {1}: (3, {}, must-alias) }\n")
+        assert parse_input_output_alias(text) == {0, 3}
+        assert parse_input_output_alias("HloModule m\n") == set()
+
+    def test_replica_groups_forms(self):
+        assert _replica_groups(
+            "replica_groups={{0,1},{2,3}}, to_apply=add") \
+            == "{{0,1},{2,3}}"
+        assert _replica_groups(
+            "channel_id=1, replica_groups=[2,2]<=[4], dims={0}") \
+            == "[2,2]<=[4]"
+        assert _replica_groups("to_apply=add") == ""
+
+    def test_fingerprint_over_async_program(self):
+        fp = collective_fingerprint(ASYNC_HLO)
+        assert fingerprint_digest(fp) == \
+            ["all-reduce:f32:512:{{0,1},{2,3}}"]
+
+
+F64_HLO = """\
+HloModule m
+
+ENTRY main (p0: f32[8]) -> f64[8] {
+  p0 = f32[8] parameter(0)
+  ROOT c = f64[8] convert(p0)
+}
+"""
+
+CLEAN_WIRE_HLO = """\
+HloModule m
+
+ENTRY main (p0: u16[4096]) -> u16[4096] {
+  p0 = u16[4096] parameter(0)
+  ar = u16[4096] all-reduce(p0), replica_groups={{0,1,2,3}}
+  s = f32[1] all-reduce(l), replica_groups={{0,1,2,3}}
+  ROOT r = u16[4096] copy(ar)
+}
+"""
+
+
+class TestPrecisionLint:
+    def test_f64_creep_flagged(self):
+        rep = precision_report(F64_HLO)
+        assert any("f64" in f for f in rep["findings"])
+        assert precision_report(ASYNC_HLO)["findings"] == []
+
+    def test_reduced_wire_clean_control(self):
+        # u16 movement payload + a scalar f32 psum (loss term): the
+        # legitimate compiled shape under wire=bf16 — no findings.
+        rep = precision_report(CLEAN_WIRE_HLO, "bf16")
+        assert rep["findings"] == []
+        assert rep["dtype_bytes"]["u16"] == 4096 * 2
+
+    def test_unknown_wire_rejected(self):
+        with pytest.raises(ValueError, match="unknown wire"):
+            precision_report(CLEAN_WIRE_HLO, "fp8")
+
+
+# ---------------------------------------------------------------------------
+# Drill: donation (round-10).
+
+
+class TestDonationDrill:
+    def test_static_defeated_donation_caught(self):
+        # The donated buffer can alias NO output (dtype change): the
+        # executable drops the donation and copies every call.
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f = jax.jit(lambda x: x.astype(jnp.int8), donate_argnums=0)
+            rep = donation_report(
+                f.lower(jax.ShapeDtypeStruct((512,), jnp.float32)),
+                min_bytes=1024)
+        assert rep["donated"] == [0] and rep["aliased"] == []
+        assert any("copied every call" in f for f in rep["findings"])
+
+    def test_static_clean_control(self):
+        g = jax.jit(lambda x: x + 1.0, donate_argnums=0)
+        rep = donation_report(
+            g.lower(jax.ShapeDtypeStruct((512,), jnp.float32)),
+            min_bytes=1024)
+        assert rep["aliased"] == [0] and rep["findings"] == []
+
+    def test_runtime_held_view_defeats_donation(self):
+        # The alias exists statically, but a live np.asarray view of
+        # the input forces PJRT to copy — only the runtime check sees
+        # this.
+        g = jax.jit(lambda x: x + 1.0, donate_argnums=0)
+        x = jnp.arange(512, dtype=jnp.float32)
+        view = np.asarray(x)  # zero-copy external reference
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            findings = runtime_donation_check(g, x)
+        assert any("COPIED at runtime" in f for f in findings)
+        del view
+
+    def test_runtime_clean_control(self):
+        g = jax.jit(lambda x: x + 1.0, donate_argnums=0)
+        assert runtime_donation_check(
+            g, jnp.arange(512, dtype=jnp.float32)) == []
+
+
+# ---------------------------------------------------------------------------
+# Drill: retrace (round-8).
+
+
+def _drill_step(x):
+    return x * 2.0 + 1.0
+
+
+class TestRetraceDrill:
+    def test_shape_varying_recompile_caught(self):
+        jf = jax.jit(_drill_step)
+        with pytest.raises(RetraceError, match="_drill_step"):
+            with no_retrace(watch=("_drill_step",)):
+                jf(jnp.ones((4,)))
+                jf(jnp.ones((8,)))  # new aval -> second compile
+
+    def test_stable_shapes_clean(self):
+        jf = jax.jit(_drill_step)
+        with no_retrace(watch=("_drill_step",)) as counter:
+            jf(jnp.ones((16,)))
+            jf(jnp.ones((16,)))  # cache hit, not a compile
+        assert counter.counts.get("_drill_step", 0) <= 1
+
+    def test_watch_scopes_the_sentinel(self):
+        # Unwatched names never trip, however often they compile.
+        jf = jax.jit(_drill_step)
+        with no_retrace(watch=("some_other_fn",)):
+            jf(jnp.ones((3,)))
+            jf(jnp.ones((5,)))
+
+    def test_fixture_is_the_context_manager(self, no_retrace):
+        jf = jax.jit(_drill_step)
+        with pytest.raises(RetraceError):
+            with no_retrace(watch=("_drill_step",)):
+                jf(jnp.ones((7,)))
+                jf(jnp.ones((9,)))
+
+
+# ---------------------------------------------------------------------------
+# Drill: collective lockstep (the SPMD deadlock class).
+
+
+def _two_collective_program(flipped, mesh):
+    """A dependency-chained pair of psums (16 then 8 elements per
+    shard, or flipped) — the chain pins program order so the compiled
+    schedule IS the source order."""
+
+    def straight(g):
+        a = lax.psum(g, "dp")
+        return lax.psum(a[:8], "dp")
+
+    def reordered(g):
+        a = lax.psum(g[:8], "dp")
+        return lax.psum(jnp.pad(a, (0, 8)) + g, "dp")[:8]
+
+    body = reordered if flipped else straight
+    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                                 out_specs=P()))
+
+
+class TestLockstepDrill:
+    def test_order_mismatch_caught(self, devices):
+        mesh = Mesh(np.array(devices[:4]), ("dp",))
+        arg = jax.ShapeDtypeStruct((64,), jnp.float32)
+        fps = {}
+        for name, flipped in (("straight", False), ("reordered", True)):
+            text = _two_collective_program(flipped, mesh) \
+                .lower(arg).compile().as_text()
+            fps[name] = collective_fingerprint(text)
+        assert all(len(fp) == 2 for fp in fps.values())
+        findings = lockstep_check(fps)
+        assert any("order mismatch" in f and "deadlock" in f
+                   for f in findings)
+
+    def test_same_config_lowered_twice_is_deterministic(self, devices):
+        mesh = Mesh(np.array(devices[:4]), ("dp",))
+        arg = jax.ShapeDtypeStruct((64,), jnp.float32)
+        fn = _two_collective_program(False, mesh)
+        a = collective_fingerprint(fn.lower(arg).compile().as_text())
+        b = collective_fingerprint(fn.lower(arg).compile().as_text())
+        assert lockstep_check({"lower-1": a, "lower-2": b}) == []
+
+    def test_count_mismatch_caught(self):
+        fp = [{"computation": "main", "op": "all-reduce", "dtype": "f32",
+               "payload_bytes": 64, "replica_groups": "{{0,1}}"}]
+        findings = lockstep_check({"a": fp + fp, "b": fp})
+        assert any("count mismatch" in f for f in findings)
+
+    def test_single_program_vacuously_clean(self):
+        assert lockstep_check({"only": []}) == []
+
+
+# ---------------------------------------------------------------------------
+# Drill: precision widening (round-7).
+
+
+class TestPrecisionDrill:
+    def test_naive_bf16_psum_widened_and_caught(self, devices):
+        # The seeded defect: an ARITHMETIC bf16 psum. XLA's
+        # FloatNormalization legalizes it back to f32 — the compiled
+        # wire is 2x what the config promised. The lint must see both
+        # the f32 traffic and the missing reduced-dtype payload.
+        mesh = Mesh(np.array(devices[:4]), ("dp",))
+
+        def naive(g):
+            return lax.psum(g.astype(jnp.bfloat16), "dp") \
+                .astype(jnp.float32)
+
+        text = jax.jit(jax.shard_map(
+            naive, mesh=mesh, in_specs=P("dp"), out_specs=P())) \
+            .lower(jax.ShapeDtypeStruct((16384,), jnp.float32)) \
+            .compile().as_text()
+        rep = precision_report(text, "bf16")
+        assert any("widened" in f for f in rep["findings"]) \
+            or any("no reduced-dtype" in f for f in rep["findings"])
+
+    def test_real_compressed_wire_is_clean(self):
+        # The committed artifact pins the positive control at repo
+        # scale: the REAL bf16/int8 rungs audited clean.
+        import json
+        from pathlib import Path
+        art = json.loads(
+            (Path(__file__).parent.parent / "experiments"
+             / "graph_audit.json").read_text())
+        cells = {c["program"]: c for c in art["cells"]}
+        for prog in ("train/fused+bf16", "train/fused+int8"):
+            assert cells[prog]["findings"] == []
+            assert cells[prog]["wire"] in ("bf16", "int8")
+
+
+# ---------------------------------------------------------------------------
+# The TPU_DDP_AUDIT gate.
+
+
+class TestAuditGate:
+    def test_dispatch_modes(self):
+        assert dispatch_findings([], "error", "x") == []
+        assert dispatch_findings(["f"], "off", "x") == ["f"]
+        with pytest.warns(UserWarning, match="graph audit"):
+            dispatch_findings(["f"], "warn", "x")
+        with pytest.raises(GraphAuditError, match="graph audit of x"):
+            dispatch_findings(["f"], "error", "x")
+        with pytest.raises(ValueError, match="off|warn|error"):
+            dispatch_findings(["f"], "loud", "x")
+
+    def test_env_surface_parses_and_rejects_junk(self):
+        from tpu_ddp.utils.config import TrainConfig
+        old = os.environ.pop("TPU_DDP_AUDIT", None)
+        try:
+            os.environ["TPU_DDP_AUDIT"] = "warn"
+            assert TrainConfig().audit == "warn"
+            os.environ["TPU_DDP_AUDIT"] = "audit-junk"
+            with pytest.raises(ValueError, match="audit"):
+                TrainConfig()
+        finally:
+            os.environ.pop("TPU_DDP_AUDIT", None)
+            if old is not None:
+                os.environ["TPU_DDP_AUDIT"] = old
+
+    def test_gate_runs_at_trainer_construction(self, devices,
+                                               monkeypatch):
+        # The dispatch path end-to-end through Trainer.__init__,
+        # with the (expensive) probe stubbed: findings must block
+        # construction under error and warn under warn.
+        from tpu_ddp.analysis import gate
+        from tpu_ddp.models.vgg import VGGModel
+        from tpu_ddp.parallel.mesh import make_mesh
+        from tpu_ddp.train.engine import Trainer
+        from tpu_ddp.utils.config import TrainConfig
+
+        monkeypatch.setattr(gate, "audit_trainer",
+                            lambda tr: ["seeded defect"])
+        mesh = make_mesh(devices[:4])
+        model = VGGModel(name="tiny", cfg=(8, "M"),
+                         compute_dtype=jnp.float32)
+        with pytest.raises(GraphAuditError, match="seeded defect"):
+            Trainer(model, TrainConfig(audit="error"),
+                    strategy="fused", mesh=mesh)
+        with pytest.warns(UserWarning, match="seeded defect"):
+            Trainer(model, TrainConfig(audit="warn"),
+                    strategy="fused", mesh=mesh)
+        monkeypatch.setattr(gate, "audit_trainer", lambda tr: [])
+        Trainer(model, TrainConfig(audit="error"), strategy="fused",
+                mesh=mesh)  # clean engine constructs under error

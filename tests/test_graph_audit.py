@@ -1,0 +1,77 @@
+"""The graph-audit sweep must pass on the live tree AND catch seeded
+defects — the test_knob_audit.py doctrine applied to
+scripts/graph_audit.py.
+
+CI runs a reduced cell subset (two train rungs); the committed
+experiments/graph_audit.json is the full sweep's zero-findings
+baseline, and its integrity is asserted here so a finding-bearing
+artifact can't be committed quietly.
+"""
+
+import json
+import warnings
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from scripts.graph_audit import _program_audit, audit_train_cell, main
+
+ARTIFACT = Path(__file__).parent.parent / "experiments" / \
+    "graph_audit.json"
+
+
+def test_train_cell_clean(devices):
+    # The cheap live-tree gate: the fused rung (the round-3
+    # workhorse), audited for donation, precision, and lowering
+    # determinism. (The no-sync rung is covered by the main() subset
+    # test below — no duplicate compiles in tier-1.)
+    cell = audit_train_cell("fused")
+    assert cell["findings"] == [], cell["findings"]
+    assert cell["n_collectives"] >= 1
+    assert cell["donated"], "train step donates its state"
+    assert set(cell["donated"]) <= set(cell["aliased"])
+
+
+def test_program_audit_reports_seeded_defect():
+    # The sweep's own cell machinery must carry a defect through to
+    # findings: a donated buffer no output can alias (dtype change).
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f = jax.jit(lambda x: x.astype(jnp.int8), donate_argnums=0)
+        cell = _program_audit(
+            "seeded/defeated-donation",
+            lambda: f.lower(jax.ShapeDtypeStruct((512,), jnp.float32)))
+    assert any("copied every call" in s for s in cell["findings"])
+
+
+def test_main_subset_exits_zero_without_writing(tmp_path, capsys):
+    # The script surface the full sweep and CI share: a clean subset
+    # returns 0 and prints the per-program lines; write=False leaves
+    # the committed artifact alone.
+    before = ARTIFACT.read_bytes()
+    assert main(only=["train/none"], write=False) == 0
+    assert ARTIFACT.read_bytes() == before
+    out = capsys.readouterr().out
+    assert "train/none" in out and "clean" in out
+
+
+def test_committed_artifact_is_clean_and_complete():
+    art = json.loads(ARTIFACT.read_text())
+    assert art["n_findings"] == 0 and art["n_errors"] == 0
+    programs = {c["program"] for c in art["cells"]}
+    # Every engine family the repo ships is fingerprinted.
+    for needle in ("train/none", "train/gather_scatter",
+                   "train/all_reduce", "train/fused", "train/zero",
+                   "train/fsdp", "train/fused+bf16", "train/fused+int8",
+                   "train/fused+overlap", "mpmd/stage0-fwd",
+                   "serve/decode", "serve/prefill",
+                   "fleet/adopt-decode", "redistribute/src-dp4",
+                   "redistribute/dst-dp2"):
+        assert needle in programs, needle
+    # Fingerprints are recorded (the lockstep baseline a future run
+    # can diff against), and the dp rungs actually collect.
+    cells = {c["program"]: c for c in art["cells"]}
+    assert cells["train/fused"]["n_collectives"] > 0
+    assert all("fingerprint" in c for c in art["cells"])

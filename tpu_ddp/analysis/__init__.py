@@ -1,0 +1,101 @@
+"""Static program auditor over jaxprs and compiled HLO.
+
+Every expensive bug this repo has hit was a *compiled-program property*
+found by hand: defeated buffer donation silently copying params each
+step (round 10), per-epoch recompiles in ``build_multi_step`` (round
+8), cross-host collective-ordering deadlocks that forced the
+dispatch-depth cadence guards (round 6), and XLA FloatNormalization
+widening bf16 collectives back to f32 (round 7).  This package turns
+each of those defect classes into a mechanical check:
+
+- :mod:`tpu_ddp.analysis.hlo` — the collective scanner (ops, dtypes,
+  payload bytes; async start/done pairs counted once), absorbed from
+  ``utils/hlo_comm.py``.
+- :mod:`tpu_ddp.analysis.cones` — the dependence-cone machinery behind
+  ``overlap_report`` / ``update_overlap_report`` /
+  ``assert_transfer_overlap``, now one cached traversal per program.
+- :mod:`tpu_ddp.analysis.lockstep` — per-program collective schedule
+  fingerprints and the cross-program order check (detector 1).
+- :mod:`tpu_ddp.analysis.donation` — intended donate_argnums vs the
+  executable's actual ``input_output_alias`` (detector 2).
+- :mod:`tpu_ddp.analysis.retrace` — the ``no_retrace()`` sentinel
+  counting lowerings per callable (detector 3).
+- :mod:`tpu_ddp.analysis.precision` — f32-widened collectives under a
+  reduced wire config, and f64 creep (detector 4).
+- :mod:`tpu_ddp.analysis.gate` — the ``TPU_DDP_AUDIT=off|warn|error``
+  construction-time gate Trainer/ServeEngine call.
+
+``utils/hlo_comm.py`` remains as a back-compat re-export shim; new
+code should import from here.  ``scripts/graph_audit.py`` sweeps every
+engine x rung cell through the detectors into
+``experiments/graph_audit.json`` (exit 1 on any finding).
+"""
+
+from tpu_ddp.analysis.cones import (
+    HEAVY_OPS,
+    UPDATE_OPS,
+    ProgramGraph,
+    assert_overlap,
+    assert_transfer_overlap,
+    overlap_report,
+    program_graph,
+    update_overlap_report,
+)
+from tpu_ddp.analysis.donation import (
+    donation_report,
+    runtime_donation_check,
+)
+from tpu_ddp.analysis.gate import (
+    GraphAuditError,
+    audit_serve_engine,
+    audit_trainer,
+    dispatch_findings,
+)
+from tpu_ddp.analysis.hlo import (
+    COLLECTIVES,
+    DTYPE_BYTES,
+    collective_dtype_bytes,
+    collective_ops,
+    collective_volume,
+    dtype_bytes,
+    shape_bytes,
+    train_step_hlo,
+)
+from tpu_ddp.analysis.lockstep import (
+    collective_fingerprint,
+    fingerprint_digest,
+    lockstep_check,
+)
+from tpu_ddp.analysis.precision import precision_report
+from tpu_ddp.analysis.retrace import RetraceError, no_retrace
+
+__all__ = [
+    "COLLECTIVES",
+    "DTYPE_BYTES",
+    "GraphAuditError",
+    "HEAVY_OPS",
+    "ProgramGraph",
+    "RetraceError",
+    "UPDATE_OPS",
+    "assert_overlap",
+    "assert_transfer_overlap",
+    "audit_serve_engine",
+    "audit_trainer",
+    "collective_dtype_bytes",
+    "collective_fingerprint",
+    "collective_ops",
+    "collective_volume",
+    "dispatch_findings",
+    "donation_report",
+    "dtype_bytes",
+    "fingerprint_digest",
+    "lockstep_check",
+    "no_retrace",
+    "overlap_report",
+    "precision_report",
+    "program_graph",
+    "runtime_donation_check",
+    "shape_bytes",
+    "train_step_hlo",
+    "update_overlap_report",
+]

@@ -1,0 +1,527 @@
+"""Dependence-cone analysis of compiled HLO: one cached traversal.
+
+``overlap_report`` (gradient collectives vs backward compute),
+``update_overlap_report`` (the disagg KV-adoption landing), and
+``assert_transfer_overlap`` all ask the same structural question —
+"what lies in this instruction's ancestor/descendant cones?" — and
+previously each re-parsed the program and re-ran the bitmask pass per
+call. :class:`ProgramGraph` parses a program ONCE (computation split,
+instruction graph, per-computation ancestor bitmasks, heavy/update
+classification) and memoizes it per HLO text via :func:`program_graph`,
+so the three public predicates share a single traversal.
+
+Overlap verdict semantics are unchanged from the original
+``utils/hlo_comm.py`` (see each function's docstring); this module is
+a refactor plus the async-pair normalization from
+:mod:`tpu_ddp.analysis.hlo` (a ``-start``/``-done`` pair is one
+logical collective whose payload is the result element).
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+
+from tpu_ddp.analysis.hlo import (
+    COLLECTIVES,
+    DTYPE_BYTES,
+    _SHAPE,
+    async_payload_shape,
+    shape_bytes,
+)
+
+# ---------------------------------------------------------------------------
+# Overlap verdict: is the gradient traffic bucketized such that the
+# scheduler COULD hide it behind backward compute?
+#
+# This is deliberately a DATAFLOW predicate, not a schedule one.  The CPU
+# backend (where tests run) strips ``optimization_barrier`` and its linear
+# scheduler is free to sink every collective to the end of the step, so
+# "collective appears between two convolutions in program order" proves
+# nothing either way.  What bucketization actually changes is the
+# dependence structure: with one fused collective, every heavy backward op
+# (convolution/dot) is an ANCESTOR of the collective, so no compute can
+# ever run concurrently with it; with k buckets issued reverse-autodiff
+# order, bucket 0's collective is independent of the (still pending)
+# backward compute of buckets 1..k-1 — a latency-hiding scheduler (the
+# TPU one) is then ALLOWED to overlap them.  We check exactly that: a
+# gradient collective is *overlappable* iff some heavy op is neither in
+# its ancestor cone nor in its descendant cone.
+#
+# Verdict rule: >= 2 gradient-sized collectives, and at least
+# ``max(1, n // 2)`` of them overlappable.  The last bucket (input-side
+# leaves, fires after all backward compute) and the reassembly gathers of
+# the final bucket are structurally never overlappable, hence majority
+# rather than all.  The negative control is a SINGLE-bucket overlap step
+# (``bucket_mb`` larger than the model): one concatenated collective
+# whose ancestor cone contains every heavy op — the "flatten, concat,
+# sync once" anti-pattern torch DDP's bucketing exists to avoid.  Note
+# the per-leaf baseline rungs (sync.py) genuinely ARE dataflow-
+# overlappable and report as such; what bucketing changes vs per-leaf is
+# launch count and payload sizing (per-tensor latency), not dependence
+# structure, so the verdict for them being True is correct, not a false
+# positive.
+# ---------------------------------------------------------------------------
+
+HEAVY_OPS = ("convolution", "dot")
+
+# CPU/GPU backends frequently legalize conv/gemm into custom-calls
+# (oneDNN / Eigen / cuDNN); match those targets as heavy too.
+_HEAVY_CUSTOM = re.compile(r"conv|gemm|matmul|dot|onednn|dnn|eigen", re.I)
+
+UPDATE_OPS = ("scatter", "dynamic-update-slice")
+
+# Param lists may nest parens (while/region bodies take TUPLE params:
+# ``%while_body (p: (s32[], f32[...])) -> (...) {``) — ``\(.*\)`` spans
+# them; ``[^)]*`` would drop exactly the computations that hold a
+# pipelined step's edge collectives.
+_COMP_HEADER = re.compile(
+    r"^(?P<entry>ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\(.*\)\s*->\s*.*\{")
+
+_INSTR_LINE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<shape>\([^)]*\)|[\w\[\],]+(?:\{[^}]*\})?)\s+"
+    r"(?P<op>[\w\-]+)\(")
+
+_NAME_TOKEN = re.compile(r"%?([\w.\-]+)")
+
+_ENTRY_NAME = re.compile(r"^ENTRY\s+%?([\w.\-]+)", re.M)
+
+
+def _split_computations(hlo_text: str) -> dict:
+    """Map computation name -> list of raw instruction lines."""
+    comps: dict = {}
+    current = None
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if current is None:
+            m = _COMP_HEADER.match(stripped)
+            if m and "=" not in stripped.split("(", 1)[0]:
+                current = m.group("name")
+                comps[current] = []
+        elif stripped == "}":
+            current = None
+        elif stripped:
+            comps[current].append(line)
+    return comps
+
+
+def _operand_span(line: str, start: int) -> str:
+    """Text of the balanced operand parens opening at ``line[start]``."""
+    depth = 0
+    for i in range(start, len(line)):
+        if line[i] == "(":
+            depth += 1
+        elif line[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return line[start + 1:i]
+    return line[start + 1:]
+
+
+def _parse_computation(lines: list) -> dict:
+    """name -> {"op", "shape", "operands": [names], "attrs": str}."""
+    instrs: dict = {}
+    order = []
+    for line in lines:
+        m = _INSTR_LINE.match(line)
+        if not m:
+            continue
+        open_at = line.index("(", m.end("op"))
+        operands_txt = _operand_span(line, open_at)
+        attrs = line[open_at + len(operands_txt) + 2:]
+        instrs[m.group("name")] = {
+            "op": m.group("op"), "shape": m.group("shape"),
+            "operands_txt": operands_txt, "attrs": attrs,
+        }
+        order.append(m.group("name"))
+    for name in order:
+        rec = instrs[name]
+        rec["operands"] = [
+            t for t in _NAME_TOKEN.findall(rec.pop("operands_txt"))
+            if t in instrs and t != name]
+    return instrs
+
+
+def _called_comps(attrs: str) -> list:
+    """Computation names referenced by an instruction's attributes
+    (calls= / to_apply= / body= / condition= / branch_computations=)."""
+    return re.findall(r"=\s*\{?%?([\w.\-]+)", attrs)
+
+
+def _element_bytes(shape_str: str) -> list:
+    """Byte size of each array element of an HLO shape string (one
+    entry for a plain array, one per element for a tuple)."""
+    sizes = []
+    for dtype, dims in _SHAPE.findall(shape_str):
+        if dtype not in DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * DTYPE_BYTES[dtype])
+    return sizes
+
+
+class ProgramGraph:
+    """Parsed HLO module with memoized structural queries.
+
+    Everything here is computed lazily and at most once per program:
+    the computation split, each computation's instruction graph and
+    def-before-use ancestor bitmasks, and the transitive heavy/update
+    classification of instructions (which recurse through fusion /
+    call / while / conditional bodies).
+    """
+
+    def __init__(self, hlo_text: str):
+        self.text = hlo_text
+        comps_lines = _split_computations(hlo_text)
+        self.comps = {name: _parse_computation(lines)
+                      for name, lines in comps_lines.items()}
+        m = _ENTRY_NAME.search(hlo_text)
+        self.entry = m.group(1) if m else None
+        self._heavy_memo: dict = {}
+        self._update_memo: dict = {}
+        self._cones: dict = {}
+        self._heavy_masks: dict = {}
+
+    # -- classification ---------------------------------------------------
+
+    def instr_is_heavy(self, rec) -> bool:
+        if rec["op"] in HEAVY_OPS:
+            return True
+        if rec["op"] == "custom-call" \
+                and _HEAVY_CUSTOM.search(rec["attrs"]):
+            return True
+        if rec["op"] in ("fusion", "call", "while", "conditional", "map"):
+            return any(self._comp_has(c, self.instr_is_heavy,
+                                      self._heavy_memo)
+                       for c in _called_comps(rec["attrs"]))
+        return False
+
+    def instr_has_update(self, rec) -> bool:
+        if rec["op"] in UPDATE_OPS:
+            return True
+        if rec["op"] in ("fusion", "call", "while", "conditional", "map"):
+            return any(self._comp_has(c, self.instr_has_update,
+                                      self._update_memo)
+                       for c in _called_comps(rec["attrs"]))
+        return False
+
+    def _comp_has(self, comp_name, pred, memo) -> bool:
+        if comp_name in memo:
+            return memo[comp_name]
+        memo[comp_name] = False  # cycle guard
+        found = any(pred(rec)
+                    for rec in self.comps.get(comp_name, {}).values())
+        memo[comp_name] = found
+        return found
+
+    # -- cones ------------------------------------------------------------
+
+    def cones(self, comp_name: str):
+        """``(names, idx, anc)`` for one computation: instruction names
+        in program order, name -> position, and per-instruction
+        ancestor bitmasks. HLO text is def-before-use so a single
+        forward pass suffices (operands of x always precede x)."""
+        if comp_name in self._cones:
+            return self._cones[comp_name]
+        instrs = self.comps[comp_name]
+        names = list(instrs)
+        idx = {n: i for i, n in enumerate(names)}
+        anc = [0] * len(names)
+        for i, n in enumerate(names):
+            m = 0
+            for o in instrs[n]["operands"]:
+                j = idx[o]
+                m |= anc[j] | (1 << j)
+            anc[i] = m
+        self._cones[comp_name] = (names, idx, anc)
+        return self._cones[comp_name]
+
+    def heavy_mask(self, comp_name: str):
+        """``(mask, count)`` of heavy instructions in a computation."""
+        if comp_name in self._heavy_masks:
+            return self._heavy_masks[comp_name]
+        instrs = self.comps[comp_name]
+        names, _, _ = self.cones(comp_name)
+        mask = 0
+        count = 0
+        for i, n in enumerate(names):
+            if self.instr_is_heavy(instrs[n]):
+                mask |= 1 << i
+                count += 1
+        self._heavy_masks[comp_name] = (mask, count)
+        return self._heavy_masks[comp_name]
+
+    def descendant_masks(self, comp_name: str, targets: dict) -> dict:
+        """Descendant cone of each target instruction (name -> position
+        in ``targets``): every instruction whose ancestor mask contains
+        the target's bit. Not memoized — target sets vary per query and
+        the pass is linear over the already-cached ancestor masks."""
+        _, _, anc = self.cones(comp_name)
+        desc = {n: 0 for n in targets}
+        for i in range(len(anc)):
+            for n, ti in targets.items():
+                if anc[i] >> ti & 1:
+                    desc[n] |= 1 << i
+        return desc
+
+
+@functools.lru_cache(maxsize=8)
+def program_graph(hlo_text: str) -> ProgramGraph:
+    """Memoized :class:`ProgramGraph` for an HLO text — the "one cached
+    traversal" behind every cone query on the same program."""
+    return ProgramGraph(hlo_text)
+
+
+def _base_collective(op: str):
+    """``(base, is_start, is_done)`` for a (possibly async) collective
+    op name; base is None for non-collectives."""
+    for suffix, flags in (("-start", (True, False)),
+                          ("-done", (False, True))):
+        if op.endswith(suffix):
+            base = op[:-len(suffix)]
+            if base in COLLECTIVES:
+                return base, *flags
+    return (op if op in COLLECTIVES else None), False, False
+
+
+def overlap_report(hlo_text: str, min_payload_bytes: int = 1024) -> dict:
+    """Dataflow overlap verdict for a compiled train step.
+
+    Scans the computation with the most gradient-sized collectives
+    (ENTRY for a plain step, the while-body for a K-step scan), builds
+    the dependence graph, and classifies each collective as overlappable
+    iff some heavy op (convolution/dot, incl. fused/custom-call forms)
+    lies outside both its ancestor and descendant cones.
+
+    ``min_payload_bytes`` filters out the scalar bookkeeping collectives
+    (loss psum, StepGuard flag) that exist on every rung regardless of
+    bucketing.  Never raises — ``assert_overlap`` wraps this for tests;
+    bench.py records the raw report.
+    """
+    graph = program_graph(hlo_text)
+
+    def grad_collectives(instrs):
+        out = []
+        for name, rec in instrs.items():
+            base, is_start, is_done = _base_collective(rec["op"])
+            if base is None or is_done:
+                continue  # -done is the already-counted pair's tail
+            shape = rec["shape"]
+            if is_start:
+                shape = async_payload_shape(shape)
+            payload = shape_bytes(shape)
+            if base == "reduce-scatter":
+                # result is the 1/N shard; grad payload is the input.
+                ops = rec["operands"]
+                if ops:
+                    payload = shape_bytes(instrs[ops[0]]["shape"])
+            if payload >= min_payload_bytes:
+                out.append((name, base, payload))
+        return out
+
+    target, target_colls = None, []
+    for name, instrs in graph.comps.items():
+        colls = grad_collectives(instrs)
+        if len(colls) > len(target_colls):
+            target, target_colls = name, colls
+    if target is None:
+        return {"overlapped": False, "n_grad_collectives": 0,
+                "n_overlappable": 0, "n_heavy_ops": 0,
+                "computation": None, "collectives": [],
+                "min_payload_bytes": min_payload_bytes,
+                "schedule_interleaved": None}
+
+    names, idx, anc = graph.cones(target)
+    heavy_mask, n_heavy = graph.heavy_mask(target)
+    heavy_idx = [i for i in range(len(names)) if heavy_mask >> i & 1]
+
+    coll_idx = {n: idx[n] for n, _, _ in target_colls}
+    desc = graph.descendant_masks(target, coll_idx)
+
+    collectives = []
+    n_overlappable = 0
+    for n, base, payload in target_colls:
+        ci = coll_idx[n]
+        free = heavy_mask & ~anc[ci] & ~desc[n] & ~(1 << ci)
+        ok = bool(free)
+        n_overlappable += ok
+        collectives.append({"name": n, "op": base,
+                            "payload_bytes": payload,
+                            "overlappable": ok})
+
+    # Informational only: does program order already interleave heavy
+    # compute between the grad collectives?  (The CPU scheduler often
+    # doesn't even when the dataflow allows it; TPU's does.)
+    positions = sorted(coll_idx.values())
+    interleaved = None
+    if len(positions) >= 2 and heavy_idx:
+        interleaved = any(positions[0] < h < positions[-1]
+                          for h in heavy_idx)
+
+    n = len(target_colls)
+    return {
+        "overlapped": bool(n >= 2 and n_overlappable >= max(1, n // 2)),
+        "n_grad_collectives": n,
+        "n_overlappable": n_overlappable,
+        "n_heavy_ops": n_heavy,
+        "computation": target,
+        "collectives": collectives,
+        "min_payload_bytes": min_payload_bytes,
+        "schedule_interleaved": interleaved,
+    }
+
+
+# ---------------------------------------------------------------------------
+# The same dataflow predicate, generalized from collectives to LARGE
+# in-place updates — the disagg fleet's KV-block adoption scatter
+# (tpu_ddp/fleet/disagg.py). The claim to check is identical in shape:
+# the fused adopt+decode program applies the transfer's payload with a
+# scatter that depends on nothing the decode computes (it runs against
+# freshly allocated, table-less block ids), so a latency-hiding
+# scheduler is ALLOWED to land the transfer behind decode compute. A
+# wrong fusion order — adopting AFTER the bank's writes — would put
+# every heavy op in the scatter's ancestor cone and serialize the edge
+# behind the step; that is the regression this analysis exists to
+# catch.
+#
+# Backend reality: XLA rarely leaves ``scatter`` standing at the entry
+# computation. The CPU expander lowers a multi-row scatter into a
+# ``while`` loop whose carried state holds the updates payload, and
+# single-row updates fuse into loop fusions with a
+# ``dynamic-update-slice`` root. The target picker therefore matches
+# any entry instruction that IS or CONTAINS (via called computations)
+# a scatter/dynamic-update-slice, and sizes its payload from the
+# shapes riding along: the largest tuple element / operand that is
+# NOT the in-place buffer itself (the buffer is always the biggest —
+# it's the whole pool). ``min_update_bytes`` then separates the
+# block-payload adoption (KBs per transfer) from the bank's own
+# per-token writes (one row per slot).
+# ---------------------------------------------------------------------------
+
+
+def _update_payload_bytes(rec, instrs) -> int:
+    """Updates-operand size for an update-carrying instruction: the
+    largest shape riding along that is NOT the in-place buffer. For a
+    tuple result (scatter lowered to a while loop) the candidates are
+    the tuple elements; otherwise the resolvable operand shapes."""
+    if rec["shape"].startswith("("):
+        sizes = _element_bytes(rec["shape"])
+    else:
+        sizes = []
+        for o in rec.get("operands", []):
+            if o in instrs:
+                sizes.extend(_element_bytes(instrs[o]["shape"]))
+        sizes.extend([max(_element_bytes(rec["shape"]) or [0])])
+    if len(sizes) < 2:
+        return 0
+    sizes.sort()
+    buffer_bytes = sizes[-1]
+    rest = [s for s in sizes[:-1] if s < buffer_bytes]
+    return max(rest) if rest else 0
+
+
+def update_overlap_report(hlo_text: str,
+                          min_update_bytes: int = 4096) -> dict:
+    """Dataflow overlap verdict for large in-place updates in the
+    ENTRY computation — the disagg KV-adoption check.
+
+    The predicate is STRICTER than the collective one, because "some
+    heavy op outside both cones" is true even of a landing serialized
+    at the very end of the step (it could still overlap the sampling
+    tail). What "the transfer lands behind decode compute" actually
+    requires is that the landing can START at step begin: a target is
+    overlappable iff it has NO heavy ancestor (it waits on no compute)
+    AND at least one heavy op sits outside both its cones (there is
+    compute to hide behind). The verdict requires the LARGEST update
+    (the transfer landing) to pass. Never raises —
+    ``assert_transfer_overlap`` wraps it.
+    """
+    graph = program_graph(hlo_text)
+    empty = {"overlapped": False, "n_updates": 0, "n_overlappable": 0,
+             "n_heavy_ops": 0, "computation": None, "updates": [],
+             "min_update_bytes": min_update_bytes}
+    target = graph.entry
+    if target is None or target not in graph.comps:
+        return empty
+    instrs = graph.comps[target]
+
+    targets = []
+    for name, rec in instrs.items():
+        if not graph.instr_has_update(rec):
+            continue
+        payload = _update_payload_bytes(rec, instrs)
+        if payload >= min_update_bytes:
+            targets.append((name, payload))
+    if not targets:
+        return dict(empty, computation=target)
+
+    names, idx, anc = graph.cones(target)
+    heavy_mask, n_heavy = graph.heavy_mask(target)
+
+    tgt_idx = {n: idx[n] for n, _ in targets}
+    desc = graph.descendant_masks(target, tgt_idx)
+
+    updates = []
+    n_overlappable = 0
+    for n, payload in targets:
+        ti = tgt_idx[n]
+        # Heavy ops the landing must WAIT for (its ancestor cone): any
+        # here means the transfer cannot start until compute finishes —
+        # the serialized bad ordering, regardless of how much free
+        # compute the tail still has.
+        blocked_by = heavy_mask & anc[ti]
+        free = heavy_mask & ~anc[ti] & ~desc[n] & ~(1 << ti)
+        ok = not blocked_by and bool(free)
+        n_overlappable += ok
+        updates.append({"name": n, "payload_bytes": payload,
+                        "n_heavy_ancestors": bin(blocked_by).count("1"),
+                        "overlappable": ok})
+    updates.sort(key=lambda u: -u["payload_bytes"])
+    return {
+        "overlapped": bool(updates and updates[0]["overlappable"]),
+        "n_updates": len(updates),
+        "n_overlappable": n_overlappable,
+        "n_heavy_ops": n_heavy,
+        "computation": target,
+        "updates": updates,
+        "min_update_bytes": min_update_bytes,
+    }
+
+
+def assert_transfer_overlap(hlo_text: str,
+                            min_update_bytes: int = 4096) -> dict:
+    """Raise ``AssertionError`` unless the program's largest in-place
+    update (the disagg transfer landing) is dataflow-overlappable with
+    heavy compute; returns the report on success."""
+    report = update_overlap_report(hlo_text,
+                                   min_update_bytes=min_update_bytes)
+    if not report["overlapped"]:
+        raise AssertionError(
+            "the transfer-landing update is not overlappable with "
+            f"compute: {report['n_overlappable']}/{report['n_updates']} "
+            f"updates (>= {min_update_bytes}B payload) start free of "
+            "heavy ancestors with heavy ops outside their cones "
+            f"(computation={report['computation']!r}, "
+            f"heavy_ops={report['n_heavy_ops']}, "
+            f"updates={[(u['name'], u['n_heavy_ancestors']) for u in report['updates']]})")
+    return report
+
+
+def assert_overlap(hlo_text: str, min_payload_bytes: int = 1024) -> dict:
+    """Raise ``AssertionError`` unless ``overlap_report`` says the step's
+    gradient collectives are bucketized-and-overlappable; returns the
+    report on success so callers can log it."""
+    report = overlap_report(hlo_text, min_payload_bytes=min_payload_bytes)
+    if not report["overlapped"]:
+        raise AssertionError(
+            "gradient collectives are not overlappable with compute: "
+            f"{report['n_overlappable']}/{report['n_grad_collectives']} "
+            f"grad-sized collectives (>= {min_payload_bytes}B) have "
+            "heavy ops outside their dependence cones "
+            f"(computation={report['computation']!r}, "
+            f"heavy_ops={report['n_heavy_ops']})")
+    return report

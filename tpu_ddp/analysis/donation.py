@@ -1,0 +1,159 @@
+"""Donation audit: intended donate_argnums vs actual buffer aliasing.
+
+The round-10 regression class: a jit surface *declares* donation
+(``donate_argnums``) but the executable never aliases the buffer — so
+every step silently copies the full parameter/optimizer state. XLA
+records what it actually aliased in the module header's
+``input_output_alias``; jax records what was *asked* in
+``Lowered.args_info``. Diffing the two turns "params are being copied
+every step" from a profiler hunt into a one-line CI failure.
+
+Two defeat modes, two checks:
+
+- **static** (:func:`donation_report`): the compiler could not alias a
+  donated parameter at all (dtype/shape mismatch with every output, the
+  donated arg is unused, or the donation was dropped on the floor) —
+  visible in the compiled text with no execution.
+- **runtime** (:func:`runtime_donation_check`): the alias exists but
+  PJRT must copy anyway because the caller still holds a reference to
+  the buffer (a ``np.asarray`` zero-copy view, a stashed alias of the
+  state tree). Detected by running the function once and checking the
+  donated input's ``unsafe_buffer_pointer`` shows up among the outputs.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+
+
+def _flat_args_info(lowered) -> list:
+    """Flattened per-parameter ``(donated, aval)`` in HLO parameter
+    order — jit flattens its arguments in order, and the entry
+    computation's parameters follow the same flat order."""
+    leaves = jax.tree_util.tree_leaves(
+        lowered.args_info,
+        is_leaf=lambda x: hasattr(x, "donated"))
+    # jax.stages.ArgInfo exposes shape/dtype directly (its aval is
+    # private); fall back to an .aval attribute for duck-typed infos.
+    return [(bool(getattr(i, "donated", False)),
+             getattr(i, "aval", None) if not hasattr(i, "shape") else i)
+            for i in leaves]
+
+
+def parse_input_output_alias(hlo_text: str) -> set:
+    """Parameter indices the executable actually aliases, parsed from
+    the module header's ``input_output_alias={ {out}: (param, {},
+    may-alias), ... }``; empty set when the header carries none."""
+    import re
+    key = "input_output_alias="
+    at = hlo_text.find(key)
+    if at < 0:
+        return set()
+    i = at + len(key)
+    depth = 0
+    end = len(hlo_text)
+    for j in range(i, len(hlo_text)):
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                end = j + 1
+                break
+    block = hlo_text[i:end]
+    return {int(m) for m in re.findall(r"\(\s*(\d+)\s*,", block)}
+
+
+def _aval_bytes(aval) -> int:
+    try:
+        import numpy as np
+        return math.prod(aval.shape) * np.dtype(aval.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def _aval_str(aval) -> str:
+    try:
+        import numpy as np
+        return (f"{np.dtype(aval.dtype).name}"
+                f"[{','.join(str(d) for d in aval.shape)}]")
+    except Exception:
+        return "?"
+
+
+def donation_report(lowered, compiled=None, min_bytes: int = 0) -> dict:
+    """Diff intended donations against the executable's actual aliasing.
+
+    ``lowered`` is a ``jax.stages.Lowered`` (e.g. from
+    ``Trainer.lower_train_step`` or ``jitfn.lower(...)``); ``compiled``
+    may be passed to reuse an existing executable. Every parameter the
+    caller donated that the executable did NOT alias (and whose size is
+    ``>= min_bytes`` — scalars donate nothing worth flagging) becomes a
+    finding: that buffer is copied every call.
+    """
+    compiled = compiled if compiled is not None else lowered.compile()
+    text = compiled.as_text()
+    aliased = parse_input_output_alias(text)
+    info = _flat_args_info(lowered)
+    donated = [i for i, (d, _) in enumerate(info) if d]
+    findings = []
+    for i in donated:
+        if i in aliased:
+            continue
+        aval = info[i][1]
+        nbytes = _aval_bytes(aval)
+        if nbytes < min_bytes:
+            continue
+        findings.append(
+            f"parameter {i} ({_aval_str(aval)}, "
+            f"{nbytes} bytes) is donated but the executable aliases no "
+            "output to it — the buffer is copied every call "
+            "(defeated donation, the round-10 bug class)")
+    return {
+        "n_params": len(info),
+        "donated": donated,
+        "aliased": sorted(aliased),
+        "findings": findings,
+    }
+
+
+def runtime_donation_check(jitfn, *args, min_bytes: int = 0) -> list:
+    """Execute ``jitfn`` once and verify each donated input buffer was
+    actually reused by an output — the check the static report cannot
+    make, because PJRT copies (rather than aliases) a donated buffer
+    whose caller still holds an external reference to it.
+
+    Returns findings (empty when every sizeable donated buffer was
+    reused). The donated arguments are consumed, mirroring real call
+    sites; pass freshly-materialized arrays.
+    """
+    lowered = jitfn.lower(*args)
+    info = _flat_args_info(lowered)
+    flat, treedef = jax.tree_util.tree_flatten(args)
+    flat = [jnp_asarray(x) for x in flat]
+    ptrs = {}
+    for i, ((don, aval), x) in enumerate(zip(info, flat)):
+        if don and hasattr(x, "unsafe_buffer_pointer") \
+                and _aval_bytes(aval) >= min_bytes:
+            ptrs[i] = (x.unsafe_buffer_pointer(), _aval_bytes(aval))
+    out = jitfn(*jax.tree_util.tree_unflatten(treedef, flat))
+    out_ptrs = set()
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "unsafe_buffer_pointer"):
+            out_ptrs.add(leaf.unsafe_buffer_pointer())
+    findings = []
+    for i, (ptr, nbytes) in ptrs.items():
+        if ptr not in out_ptrs:
+            findings.append(
+                f"donated parameter {i} ({nbytes} bytes) was COPIED at "
+                "runtime, not reused — a live external reference "
+                "(e.g. a held np.asarray view) defeated the donation")
+    return findings
+
+
+def jnp_asarray(x):
+    """Device-commit a leaf without importing jnp at module scope."""
+    import jax.numpy as jnp
+    return jnp.asarray(x) if not isinstance(x, jax.Array) else x

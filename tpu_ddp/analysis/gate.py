@@ -1,0 +1,165 @@
+"""The TPU_DDP_AUDIT construction-time gate.
+
+``TPU_DDP_AUDIT=off|warn|error`` (TrainConfig.audit) runs the static
+detectors that need no execution — donation and precision — against
+the programs an engine is about to spend its life in, at construction:
+
+- Trainer: the jitted train step, lowered once against abstract state
+  and a probe batch (an ``eval_shape`` of ``init_state`` — no device
+  arrays are built), then compiled exactly as the first real step
+  would be; the executable lands in jax's jit cache, so at ``warn``/
+  ``error`` the audit's compile is the step's compile, not an extra.
+- ServeEngine: the decode and prefill step programs at the engine's
+  (fully static) shapes.
+
+``warn`` surfaces findings as Python warnings and keeps going;
+``error`` raises :class:`GraphAuditError` — construction fails before
+the defect can burn a single step. Probe failures (a model the probe
+batch cannot feed) are never findings: the audit degrades to a warning
+naming the skip, because a gate that can crash construction on its own
+scaffolding would train people to turn it off.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from tpu_ddp.analysis.donation import donation_report
+from tpu_ddp.analysis.precision import precision_report
+
+AUDIT_MODES = ("off", "warn", "error")
+
+
+class GraphAuditError(RuntimeError):
+    """A construction-time audit found a compiled-program defect and
+    TPU_DDP_AUDIT=error is in effect."""
+
+
+def dispatch_findings(findings: list, mode: str, where: str) -> list:
+    """Route ``findings`` per the audit mode: no-op on "off"/clean,
+    ``warnings.warn`` on "warn", raise :class:`GraphAuditError` on
+    "error". Returns the findings for callers that record them."""
+    if mode not in AUDIT_MODES:
+        raise ValueError(
+            f"audit={mode!r}: expected off|warn|error (TPU_DDP_AUDIT)")
+    if not findings or mode == "off":
+        return findings
+    text = f"graph audit of {where}: " + "; ".join(findings)
+    if mode == "error":
+        raise GraphAuditError(text)
+    warnings.warn(text, stacklevel=3)
+    return findings
+
+
+def audit_trainer(trainer, sample_batch=None) -> list:
+    """Donation + precision findings for a Trainer's train step.
+
+    ``sample_batch`` is optional ``(images, labels, weights)`` (arrays
+    or ShapeDtypeStructs); without it a probe batch of
+    ``(2*dp, 32, 32, in_channels)`` f32 images is assumed — the
+    convnet families all take that; a model the probe cannot feed
+    raises, which :func:`maybe_audit_trainer` converts to a skip.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if sample_batch is None:
+        b = 2 * max(1, getattr(trainer, "_dp", 1))
+        side = int(getattr(trainer.model, "image_size", 0))
+        if not side:
+            cfg = getattr(trainer.model, "cfg", None)
+            if isinstance(cfg, (tuple, list)) and "M" in cfg:
+                # VGG flattens after its last pool, so the probe side
+                # must collapse to 1x1: one halving per "M".
+                side = 2 ** cfg.count("M")
+            else:
+                side = 32  # global-pool families take any side
+        chans = int(getattr(trainer.model, "in_channels", 3))
+        sample_batch = (
+            jax.ShapeDtypeStruct((b, side, side, chans), jnp.float32),
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+            jax.ShapeDtypeStruct((b,), jnp.float32),
+        )
+    images, labels, weights = sample_batch
+    # TrainState is a plain container, not a pytree node — eval_shape
+    # the component trees and rebuild a state-like shell around them.
+    # FSDP's init shards leaves through host numpy and cannot trace
+    # abstractly; fall back to one concrete init there.
+    import types
+    try:
+        params, opt_state, comp_state = jax.eval_shape(
+            lambda: (lambda s: (s.params, s.opt_state, s.comp_state))(
+                trainer.init_state()))
+        state = types.SimpleNamespace(
+            params=params, opt_state=opt_state, comp_state=comp_state)
+    except jax.errors.TracerArrayConversionError:
+        state = trainer.init_state()
+    lowered = trainer.lower_train_step(state, images, labels, weights)
+    compiled = lowered.compile()
+    text = compiled.as_text()
+
+    findings = list(donation_report(
+        lowered, compiled=compiled, min_bytes=1024)["findings"])
+    # The wire claim is only in force when compression actually runs
+    # (it degrades to "none" off the compressible rungs); ZeRO/FSDP
+    # all_gather f32 PARAMETERS by design — not gradient traffic.
+    wire = trainer.config.grad_compress \
+        if getattr(trainer, "_comp_active", False) else None
+    exempt = ("all-gather",) if (getattr(trainer, "is_zero", False)
+                                 or getattr(trainer, "is_fsdp", False)
+                                 or getattr(trainer, "_sharded_update",
+                                            None) is not None) else ()
+    findings += precision_report(text, wire, exempt_ops=exempt)["findings"]
+    return findings
+
+
+def audit_serve_engine(engine) -> list:
+    """Donation + precision findings for a ServeEngine's decode and
+    prefill programs (shapes are fully static at construction)."""
+    findings = []
+    for name, lowered in (("decode", engine.lower_decode_step()),
+                          ("prefill", engine.lower_prefill_step())):
+        compiled = lowered.compile()
+        rep = donation_report(lowered, compiled=compiled, min_bytes=1024)
+        findings += [f"{name}: {f}" for f in rep["findings"]]
+        findings += [f"{name}: {f}" for f in precision_report(
+            compiled.as_text())["findings"]]
+    return findings
+
+
+def maybe_audit_trainer(trainer) -> list:
+    """Construction hook: run :func:`audit_trainer` per the config's
+    audit mode; probe failures become a skip warning, findings follow
+    :func:`dispatch_findings`."""
+    mode = getattr(trainer.config, "audit", "off")
+    if mode == "off":
+        return []
+    try:
+        findings = audit_trainer(trainer)
+    except GraphAuditError:
+        raise
+    except Exception as e:  # probe scaffolding failure, not a finding
+        warnings.warn(
+            f"graph audit skipped: could not lower a probe train step "
+            f"({type(e).__name__}: {e}); pass a sample batch to "
+            "tpu_ddp.analysis.audit_trainer for this model",
+            stacklevel=3)
+        return []
+    return dispatch_findings(findings, mode, "Trainer train step")
+
+
+def maybe_audit_serve_engine(engine) -> list:
+    """Construction hook mirroring :func:`maybe_audit_trainer`."""
+    mode = getattr(getattr(engine, "config", None), "audit", "off")
+    if mode == "off":
+        return []
+    try:
+        findings = audit_serve_engine(engine)
+    except GraphAuditError:
+        raise
+    except Exception as e:
+        warnings.warn(
+            f"graph audit skipped: could not lower the serve step "
+            f"programs ({type(e).__name__}: {e})", stacklevel=3)
+        return []
+    return dispatch_findings(findings, mode, "ServeEngine step programs")

@@ -1,0 +1,169 @@
+"""Compiled-HLO collective scanner: ops, dtypes, bytes on the wire.
+
+Absorbed from ``utils/hlo_comm.py`` (which re-exports it for its pinned
+consumers) so jit-level communication claims are checkable anywhere —
+scripts/comm_volume.py's ladder table, tests/test_compress.py's
+reduced-dtype invariant, scripts/compress_sweep.py's bytes/step column,
+and the graph-audit detectors all scan with the same parser instead of
+regex forks.
+
+The scan is textual over ``compiled.as_text()``: each collective
+instruction's RESULT shape gives its payload (for all-reduce and
+collective-permute result == operand; reduce-scatter's input is
+result * N; all-gather's result already is the gathered size — the ring
+cost model accounts for each). Tuple-shaped results (all-to-all renders
+as ``(s8[1,256], s8[1,256], ...)`` per peer) sum their elements.
+
+Async pairs: TPU lowering splits a collective into
+``all-reduce-start`` / ``all-reduce-done`` (likewise all-gather,
+collective-permute, reduce-scatter). The pair is ONE logical collective
+with one wire payload, so the scanner counts the ``-start`` and skips
+the ``-done``; a ``-start``'s tuple result interleaves the operand
+buffer with the result buffer (``(operand, result[, u32 scratch...])``),
+so its payload is the RESULT element alone, not the tuple sum —
+otherwise every TPU-lowered program would double-count its wire bytes.
+
+Why per-dtype accounting exists: gradient compression
+(parallel/compress.py) promises the collective EXECUTES at the reduced
+dtype. That is a claim about compiled HLO — XLA float-normalization can
+legalize a bf16 collective back to f32, silently widening the wire while
+keeping the numerics — so the invariant is "scan the compiled text and
+check the payload bytes per dtype", not "trust the jaxpr".
+"""
+
+from __future__ import annotations
+
+import re
+
+DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+               "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4,
+               "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather",
+               "all-to-all", "collective-permute")
+
+# One HLO instruction: "%name = <shape> op-name(...)" where <shape> is
+# "f32[a,b]{layout}" or a tuple "(f32[a]{0}, f32[b]{0})". The suffix
+# group distinguishes the async start/done halves from the sync form.
+_INSTR = re.compile(
+    r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^ ]*)\s+"
+    r"(" + "|".join(COLLECTIVES) + r")(-start|-done)?\(")
+
+_SHAPE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\](?:\{[^}]*\})?")
+
+
+def shape_bytes(shape_str: str) -> int:
+    """Total bytes of an HLO shape string (tuples sum their elements)."""
+    return sum(dtype_bytes(shape_str).values())
+
+
+def dtype_bytes(shape_str: str) -> dict:
+    """Per-dtype byte totals of an HLO shape string."""
+    out: dict = {}
+    for dtype, dims in _SHAPE.findall(shape_str):
+        if dtype not in DTYPE_BYTES:
+            continue  # e.g. token[] / opaque
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out[dtype] = out.get(dtype, 0) + n * DTYPE_BYTES[dtype]
+    return out
+
+
+def tuple_elements(shape_str: str) -> list:
+    """The array-shape tokens of an HLO shape string, in order (one
+    entry for a plain array shape)."""
+    return [m.group(0) for m in _SHAPE.finditer(shape_str)]
+
+
+def async_payload_shape(shape_str: str) -> str:
+    """Payload shape of an async ``-start`` result: the RESULT element
+    of the ``(operand, result[, scratch...])`` tuple. Falls back to the
+    whole shape for non-tuple/degenerate forms."""
+    elems = tuple_elements(shape_str)
+    if shape_str.lstrip().startswith("(") and len(elems) >= 2:
+        return elems[1]
+    return shape_str
+
+
+def collective_ops(hlo_text: str) -> list:
+    """Every LOGICAL collective as ``{"op", "shape", "payload_bytes",
+    "dtype_bytes", "async"}`` in program order — the raw per-op view
+    ``collective_volume`` aggregates. An async start/done pair counts
+    once (at the ``-start``, with the result element as payload); the
+    ``-done`` contributes nothing."""
+    found = []
+    for m in _INSTR.finditer(hlo_text):
+        shape_str, op, suffix = m.group(1), m.group(2), m.group(3)
+        if suffix == "-done":
+            continue  # second half of an already-counted pair
+        if suffix == "-start":
+            shape_str = async_payload_shape(shape_str)
+        per_dtype = dtype_bytes(shape_str)
+        found.append({"op": op, "shape": shape_str,
+                      "payload_bytes": sum(per_dtype.values()),
+                      "dtype_bytes": per_dtype,
+                      "async": suffix == "-start"})
+    return found
+
+
+def collective_dtype_bytes(hlo_text: str) -> dict:
+    """Payload bytes per dtype summed over ALL collectives — the
+    reduced-dtype invariant's input: a compressed step must put its
+    gradient payload under s8/u16, with f32 collective traffic bounded
+    by the per-block scales + scalar psums (loss terms, guard flag)."""
+    totals: dict = {}
+    for rec in collective_ops(hlo_text):
+        for dt, b in rec["dtype_bytes"].items():
+            totals[dt] = totals.get(dt, 0) + b
+    return totals
+
+
+def collective_volume(hlo_text: str, n_devices: int) -> dict:
+    """Scan compiled HLO for collective ops; payload + ring wire bytes.
+
+    Ring cost model per device (reference CS744 §2.2.2 and the
+    docstring of scripts/comm_volume.py):
+
+    - all-reduce:          2 * (N-1)/N * payload
+    - reduce-scatter:          (N-1)/N * input payload (= result * N)
+    - all-gather:              (N-1)/N * output payload
+    - all-to-all:              (N-1)/N * payload
+    - collective-permute:                payload      (one neighbor hop)
+    """
+    ops: dict = {k: {"count": 0, "payload_bytes": 0, "dtype_bytes": {}}
+                 for k in COLLECTIVES}
+    for rec in collective_ops(hlo_text):
+        agg = ops[rec["op"]]
+        agg["count"] += 1
+        agg["payload_bytes"] += rec["payload_bytes"]
+        for dt, b in rec["dtype_bytes"].items():
+            agg["dtype_bytes"][dt] = agg["dtype_bytes"].get(dt, 0) + b
+    frac = (n_devices - 1) / n_devices
+    wire = 0.0
+    for op, rec in ops.items():
+        if op == "all-reduce":
+            rec["wire_bytes_per_device"] = 2 * frac * rec["payload_bytes"]
+        elif op == "reduce-scatter":
+            # result is the 1/N shard; input payload = result * N.
+            rec["wire_bytes_per_device"] = (frac * rec["payload_bytes"]
+                                            * n_devices)
+        elif op == "all-gather":
+            rec["wire_bytes_per_device"] = frac * rec["payload_bytes"]
+        elif op == "all-to-all":
+            rec["wire_bytes_per_device"] = frac * rec["payload_bytes"]
+        else:  # collective-permute: one neighbor hop
+            rec["wire_bytes_per_device"] = float(rec["payload_bytes"])
+        wire += rec["wire_bytes_per_device"]
+    ops = {k: v for k, v in ops.items() if v["count"]}
+    return {"ops": ops, "total_wire_bytes_per_device": wire,
+            "total_collectives": sum(v["count"] for v in ops.values()),
+            "dtype_payload_bytes": collective_dtype_bytes(hlo_text)}
+
+
+def train_step_hlo(trainer, state, images, labels, weights) -> str:
+    """Compiled HLO text of a Trainer's jitted train step (handles the
+    stateful-compression signature via ``Trainer.lower_train_step``)."""
+    return trainer.lower_train_step(
+        state, images, labels, weights).compile().as_text()

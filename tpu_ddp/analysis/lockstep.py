@@ -1,0 +1,146 @@
+"""Collective lockstep: schedule fingerprints and cross-program checks.
+
+SPMD correctness rests on an unstated invariant: every process that
+participates in a collective must issue the SAME collectives in the
+SAME order with the SAME participant groups, or the fabric deadlocks —
+the class of hang the §13 dispatch-cadence guards (DESIGN.md) work
+around at runtime. veScale (arxiv 2509.07003) argues this should be
+checked mechanically, and arxiv 2112.01075 shows collective programs
+admit exactly this static verification: the collective schedule is a
+property of the compiled text.
+
+:func:`collective_fingerprint` extracts that schedule — op kind
+(async-normalized), replica groups, element type, payload bytes, in
+program order per computation — and :func:`lockstep_check` diffs the
+fingerprints of programs that may interleave across processes, naming
+the first divergent position. Two deployment shapes use it:
+
+- determinism: the same (config, shapes) lowered twice must fingerprint
+  identically — since every process compiles from identical inputs,
+  per-process determinism IS the cross-process lockstep guarantee for
+  SPMD programs (scripts/graph_audit.py runs this per cell);
+- equivalence: programs that interleave on the same fabric (the
+  single-step path vs the K-scan while body, the rungs around a live
+  reshard) must agree on the schedule they share.
+"""
+
+from __future__ import annotations
+
+from tpu_ddp.analysis.cones import _base_collective, program_graph
+from tpu_ddp.analysis.hlo import (
+    async_payload_shape,
+    dtype_bytes,
+    shape_bytes,
+)
+
+
+def _replica_groups(attrs: str) -> str:
+    """The raw ``replica_groups=`` value of an instruction's attribute
+    text — balanced-brace form (``{{0,1},{2,3}}``) or iota form
+    (``[2,2]<=[4]``); empty string when absent (single-group)."""
+    key = "replica_groups="
+    at = attrs.find(key)
+    if at < 0:
+        return ""
+    i = at + len(key)
+    if i >= len(attrs):
+        return ""
+    if attrs[i] == "{":
+        depth = 0
+        for j in range(i, len(attrs)):
+            if attrs[j] == "{":
+                depth += 1
+            elif attrs[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    return attrs[i:j + 1]
+        return attrs[i:]
+    # iota form: [dims]<=[n] — runs to the first comma/space after the
+    # closing bracket of the permutation list.
+    for j in range(i, len(attrs)):
+        if attrs[j] in ", " and attrs[max(i, j - 1)] == "]":
+            return attrs[i:j]
+    return attrs[i:].rstrip()
+
+
+def collective_fingerprint(hlo_text: str) -> list:
+    """Per-program collective schedule fingerprint: one entry per
+    LOGICAL collective (async start/done pairs count once) in textual
+    program order, each ``{"computation", "op", "dtype",
+    "payload_bytes", "replica_groups"}``.
+
+    Textual order is deterministic for a given compiled program, so
+    equal fingerprints mean equal schedules — including the relative
+    order *within* each computation, which is what the fabric sees.
+    """
+    graph = program_graph(hlo_text)
+    fp = []
+    for comp_name, instrs in graph.comps.items():
+        for name, rec in instrs.items():
+            base, is_start, is_done = _base_collective(rec["op"])
+            if base is None or is_done:
+                continue
+            shape = rec["shape"]
+            if is_start:
+                shape = async_payload_shape(shape)
+            per_dtype = dtype_bytes(shape)
+            dtype = max(per_dtype, key=per_dtype.get) if per_dtype \
+                else "?"
+            fp.append({
+                "computation": comp_name,
+                "op": base,
+                "dtype": dtype,
+                "payload_bytes": shape_bytes(shape),
+                "replica_groups": _replica_groups(rec["attrs"]),
+            })
+    return fp
+
+
+def fingerprint_digest(fp: list) -> list:
+    """Compact, comparison-stable rendering of a fingerprint — what
+    graph_audit.json records per cell. Computation names are dropped:
+    XLA's generated names (while-body counters etc.) vary run to run
+    even when the schedule is identical; the fabric only sees the op
+    sequence."""
+    return [f"{e['op']}:{e['dtype']}:{e['payload_bytes']}"
+            f":{e['replica_groups']}" for e in fp]
+
+
+def lockstep_check(named_fingerprints) -> list:
+    """Cross-check collective schedules that may interleave.
+
+    ``named_fingerprints`` is ``{name: fingerprint}`` (or an iterable
+    of ``(name, fingerprint)``): every program is diffed against the
+    first, and any divergence — length or first mismatching entry —
+    produces a finding naming both programs, the position, and the two
+    schedule entries. An empty list means the programs agree and may
+    safely interleave across processes.
+    """
+    if isinstance(named_fingerprints, dict):
+        items = list(named_fingerprints.items())
+    else:
+        items = list(named_fingerprints)
+    if len(items) < 2:
+        return []
+    findings = []
+    ref_name, ref_fp = items[0]
+    ref_d = fingerprint_digest(ref_fp)
+    for name, fp in items[1:]:
+        d = fingerprint_digest(fp)
+        for pos, (a, b) in enumerate(zip(ref_d, d)):
+            if a != b:
+                findings.append(
+                    f"collective order mismatch between {ref_name!r} "
+                    f"and {name!r} at position {pos}: "
+                    f"{ref_name!r} issues {a} where {name!r} issues "
+                    f"{b} — interleaving these programs across "
+                    "processes can deadlock the fabric")
+                break
+        else:
+            if len(ref_d) != len(d):
+                findings.append(
+                    f"collective count mismatch between {ref_name!r} "
+                    f"({len(ref_d)} collectives) and {name!r} "
+                    f"({len(d)}): the longer program blocks on "
+                    "collectives the shorter never issues")
+    return findings

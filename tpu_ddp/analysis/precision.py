@@ -1,0 +1,101 @@
+"""Precision lint: widened collectives and f64 creep in compiled HLO.
+
+The round-7 regression class: gradient compression promises the
+collective EXECUTES at the reduced dtype, but XLA's FloatNormalization
+legalizes naive bf16 arithmetic collectives back to f32 — same
+numerics, 2x the wire. parallel/compress.py defeats it by moving
+payloads as bitcast u16/s8 MOVEMENT collectives; this lint checks the
+result held: under a reduced wire config the compiled program must
+carry its gradient payload in reduced-dtype collectives, with f32
+collective traffic bounded by the legitimate residue (per-block
+scales, scalar psums for loss terms and the StepGuard flag).
+
+Separately, any f64 (or c128) result creeping into a jitted program is
+flagged unconditionally: the repo computes in f32/bf16 everywhere, so
+f64 means an accidental Python-float promotion or a stray
+``jax_enable_x64`` — a silent 2x memory/flops tax.
+"""
+
+from __future__ import annotations
+
+from tpu_ddp.analysis.cones import program_graph
+from tpu_ddp.analysis.hlo import collective_ops
+
+# Which collective dtypes carry the compressed payload per wire config
+# (parallel/compress.py SPECS: bf16 rides u16 bitcasts — or bf16 when
+# a backend leaves the movement collective un-normalized; int8 rides
+# s8 with f32 per-block scales).
+REDUCED_WIRE = {
+    "bf16": ("u16", "bf16", "f16"),
+    "int8": ("s8", "u8"),
+    "int8-noef": ("s8", "u8"),
+}
+
+
+def precision_report(hlo_text: str, wire: str | None = None, *,
+                     exempt_ops=(), f32_budget: int | None = None,
+                     check_f64: bool = True) -> dict:
+    """Lint a compiled program's collective dtypes (and f64 creep).
+
+    ``wire`` is the grad_compress config in effect (``"bf16"`` /
+    ``"int8"`` / ``"int8-noef"``; None or ``"none"`` skips the widening
+    check). ``exempt_ops`` removes collective kinds from the f32
+    accounting — the ZeRO/FSDP rungs all_gather f32 PARAMETERS by
+    design, which is not gradient-wire traffic. ``f32_budget`` caps
+    the allowed f32 collective bytes; the default is
+    ``max(2048, reduced_payload // 8)``, generous enough for scales +
+    scalar psums and far below any widened gradient payload.
+
+    Returns ``{"findings", "dtype_bytes", "wire"}``; empty findings
+    means the wire claim held and no f64 appears.
+    """
+    findings = []
+    totals: dict = {}
+    for rec in collective_ops(hlo_text):
+        if rec["op"] in exempt_ops:
+            continue
+        for dt, b in rec["dtype_bytes"].items():
+            totals[dt] = totals.get(dt, 0) + b
+
+    if wire and wire != "none":
+        reduced_dtypes = REDUCED_WIRE.get(wire)
+        if reduced_dtypes is None:
+            raise ValueError(f"unknown wire config {wire!r}; expected "
+                             f"one of {sorted(REDUCED_WIRE)}|none")
+        reduced = sum(totals.get(dt, 0) for dt in reduced_dtypes)
+        f32 = totals.get("f32", 0)
+        budget = f32_budget if f32_budget is not None \
+            else max(2048, reduced // 8)
+        if f32 > budget:
+            findings.append(
+                f"f32 collective traffic is {f32} bytes under "
+                f"wire={wire!r} (budget {budget}, reduced-dtype "
+                f"payload {reduced}) — XLA widened the gradient "
+                "collectives back to f32 (the round-7 bug class: "
+                "FloatNormalization legalized an arithmetic bf16 "
+                "collective); move the payload as a bitcast "
+                "u16/s8 collective instead")
+        if reduced == 0:
+            findings.append(
+                f"no reduced-dtype collective payload at all under "
+                f"wire={wire!r} — compression is configured but the "
+                "compiled program never puts gradient bytes on the "
+                "wire at the reduced dtype")
+
+    if check_f64:
+        graph = program_graph(hlo_text)
+        hits = []
+        for comp_name, instrs in graph.comps.items():
+            for name, rec in instrs.items():
+                shape = rec["shape"]
+                if "f64[" in shape or "c128[" in shape:
+                    hits.append(f"{comp_name}/{name}: {shape}")
+        if hits:
+            findings.append(
+                f"f64 results in a jitted program ({len(hits)} "
+                f"instruction(s), first: {hits[0]}) — the repo "
+                "computes in f32/bf16; an accidental Python-float "
+                "promotion or jax_enable_x64 is doubling memory "
+                "and flops")
+
+    return {"findings": findings, "dtype_bytes": totals, "wire": wire}

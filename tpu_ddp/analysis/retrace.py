@@ -1,0 +1,122 @@
+"""Retrace sentinel: fail tests on unexpected recompiles.
+
+The round-8 regression class: ``build_multi_step`` rebuilt its K-step
+scan every epoch, so a "compiled" training loop silently re-lowered and
+re-compiled the same program over and over — visible only as a
+mysteriously slow wall clock. :func:`no_retrace` turns it into a hard
+failure: it counts XLA compiles per callable name for the duration of
+the block (via jax's own compile-path debug logging, so there is no
+flag to flip and no monkeypatching of jit internals) and raises
+:class:`RetraceError` if any watched callable compiles more than
+``max_compiles`` times.
+
+Counting is by CALLABLE NAME, deliberately: the retrace bug class is
+"the same function compiled twice with different shapes/avals", which
+per-program keys would classify as two distinct programs and miss.
+The cost is that jax's internal eager-op helper jits (``jit(multiply)``
+etc., which legitimately compile per dtype/shape) must be ignored —
+the default ignore set covers them, and ``watch=`` restricts counting
+to exactly the names you mean to guard, which is the recommended form
+inside training loops.
+
+The pytest fixture (tests/conftest.py) exposes this as ``no_retrace``.
+"""
+
+from __future__ import annotations
+
+import logging
+from contextlib import contextmanager
+
+_COMPILE_LOGGER = "jax._src.interpreters.pxla"
+_COMPILE_PREFIX = "Compiling %s"
+
+# jax compiles these tiny helper programs for EAGER ops outside any
+# user jit (one per dtype/shape combination) — they are not retraces
+# of anything and must not trip the sentinel. Underscore-prefixed
+# names (_reduce_sum, _threefry_split, ...) are ignored wholesale.
+IGNORED_CALLABLES = frozenset({
+    "convert_element_type", "broadcast_in_dim", "multiply", "add",
+    "subtract", "divide", "true_divide", "floor_divide", "remainder",
+    "power", "negative", "iota", "concatenate", "reshape", "transpose",
+    "squeeze", "expand_dims", "copy", "select_n", "where", "clip",
+    "equal", "not_equal", "less", "less_equal", "greater",
+    "greater_equal", "maximum", "minimum", "abs", "sign", "exp", "log",
+    "sqrt", "rsqrt", "tanh", "fn", "stack", "split", "full", "ones",
+    "zeros", "arange", "take", "gather", "dynamic_slice",
+    "dynamic_update_slice", "cumsum", "argmax", "argmin", "sort",
+    "isnan", "isfinite", "logical_and", "logical_or", "logical_not",
+    "bitcast_convert_type", "device_put", "ravel", "squeeze",
+})
+
+
+class RetraceError(AssertionError):
+    """A watched callable compiled more often than allowed."""
+
+
+class _CompileCounter(logging.Handler):
+    def __init__(self, watch, ignore):
+        super().__init__(level=logging.DEBUG)
+        self.watch = tuple(watch) if watch is not None else None
+        self.ignore = ignore
+        self.counts: dict = {}
+        self.shapes: dict = {}
+
+    def emit(self, record):
+        if not record.msg.startswith(_COMPILE_PREFIX):
+            return
+        args = record.args or ()
+        name = str(args[0]) if args else "?"
+        if self.watch is not None:
+            if name not in self.watch:
+                return
+        elif name in self.ignore or name.startswith("_"):
+            return
+        self.counts[name] = self.counts.get(name, 0) + 1
+        if len(args) > 1:
+            self.shapes.setdefault(name, []).append(str(args[1])[:200])
+
+
+@contextmanager
+def no_retrace(max_compiles: int = 1, watch=None,
+               ignore=IGNORED_CALLABLES):
+    """Context manager asserting bounded compiles per callable.
+
+    ``max_compiles`` is the per-callable budget for the whole block
+    (1 = "compile at most once"; use 0 for a block that must reuse
+    existing executables only). ``watch`` restricts counting to the
+    given callable names; without it every non-helper compile counts.
+
+    Yields the live counter (``.counts`` maps name -> compiles so far)
+    and raises :class:`RetraceError` on exit if any callable exceeded
+    the budget, naming the callable and the argument shapes of each
+    compile — the shape drift IS the diagnosis for the common bug
+    (an un-padded batch remainder, a Python-int axis that became a
+    float, a fresh closure identity per epoch).
+    """
+    logger = logging.getLogger(_COMPILE_LOGGER)
+    counter = _CompileCounter(watch, ignore)
+    old_level = logger.level
+    old_propagate = logger.propagate
+    logger.addHandler(counter)
+    # The handler needs DEBUG records delivered; stop propagation so
+    # forcing DEBUG doesn't spray jax's compile chatter through root
+    # handlers for the duration of the block. Restore both on exit.
+    logger.setLevel(logging.DEBUG)
+    logger.propagate = False
+    try:
+        yield counter
+    finally:
+        logger.removeHandler(counter)
+        logger.setLevel(old_level)
+        logger.propagate = old_propagate
+    offenders = {n: c for n, c in counter.counts.items()
+                 if c > max_compiles}
+    if offenders:
+        detail = "; ".join(
+            f"{n!r} compiled {c}x "
+            f"(shapes: {' | '.join(counter.shapes.get(n, [])[:4])})"
+            for n, c in sorted(offenders.items()))
+        raise RetraceError(
+            f"unexpected recompilation (> {max_compiles} per "
+            f"callable): {detail} — the round-8 bug class: a "
+            "supposedly-compiled path is re-lowering every call")

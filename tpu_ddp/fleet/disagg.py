@@ -509,10 +509,10 @@ class DisaggEngine:
         return (self.sched.accounting_ok()
                 and self.psched.accounting_ok())
 
-    def adopt_decode_hlo(self, n_blocks: int = 2) -> str:
-        """Compiled HLO of the fused adopt+decode program for a
-        representative transfer size — what
-        ``utils/hlo_comm.assert_transfer_overlap`` scans."""
+    def lower_adopt_decode(self, n_blocks: int = 2):
+        """``jit.lower`` the fused adopt+decode program for a
+        representative transfer size — the audit surface
+        ``tpu_ddp/analysis`` fingerprints and donation-checks."""
         sds = lambda x: jax.ShapeDtypeStruct(  # noqa: E731
             jnp.shape(x), jnp.result_type(x))
         params = jax.tree.map(sds, self.params)
@@ -526,7 +526,12 @@ class DisaggEngine:
             params, pk, pk, i32((n_blocks,)), payload, payload,
             i32((S, BPS)), i32((S,)), i32((S,)),
             jax.ShapeDtypeStruct((S,), jnp.float32),
-            i32((S,))).compile().as_text()
+            i32((S,)))
+
+    def adopt_decode_hlo(self, n_blocks: int = 2) -> str:
+        """Compiled HLO of the fused adopt+decode program — what
+        ``tpu_ddp/analysis`` (assert_transfer_overlap) scans."""
+        return self.lower_adopt_decode(n_blocks).compile().as_text()
 
 
 __all__ = ["DisaggEngine", "KVEdge", "KVTransfer"]

@@ -688,6 +688,14 @@ def main(argv=None) -> int:
                         "only; multi-process ranks fall back to 'cached' "
                         "semantics). Sets TPU_DDP_AUTOTUNE for every "
                         "rank")
+    p.add_argument("--audit", default=None,
+                   choices=("off", "warn", "error"),
+                   help="construction-time graph audit "
+                        "(tpu_ddp/analysis/): statically check buffer "
+                        "donation and collective precision of every "
+                        "rank's compiled step programs before training "
+                        "starts; 'error' fails construction on a "
+                        "finding. Sets TPU_DDP_AUDIT for every rank")
     args, extra = p.parse_known_args(argv)
     env = {}
     if args.dispatch_depth is not None:
@@ -714,6 +722,8 @@ def main(argv=None) -> int:
         env["TPU_DDP_ACT_DTYPE"] = args.act_dtype
     if args.autotune is not None:
         env["TPU_DDP_AUTOTUNE"] = args.autotune
+    if args.audit is not None:
+        env["TPU_DDP_AUDIT"] = args.audit
     if args.overlap:
         env["TPU_DDP_OVERLAP"] = "1"
     if args.bucket_mb is not None:

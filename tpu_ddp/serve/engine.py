@@ -252,6 +252,36 @@ class ServeEngine:
         self._prefill = _build_prefill_step(model, self.block_size,
                                             self.blocks_per_seq)
         self._rid = itertools.count()
+        self.config = config
+        # TPU_DDP_AUDIT=warn|error: static donation/precision audit of
+        # the two step programs before the engine takes traffic
+        # (tpu_ddp/analysis/gate.py; shapes are fully static here).
+        if getattr(config, "audit", "off") != "off":
+            from tpu_ddp.analysis.gate import maybe_audit_serve_engine
+            maybe_audit_serve_engine(self)
+
+    def lower_decode_step(self):
+        """``jit.lower`` the whole-bank decode step at the engine's
+        static shapes — the HLO-inspection surface the graph audit
+        (tpu_ddp/analysis/) fingerprints and donation-checks."""
+        S, BPS = self.num_slots, self.blocks_per_seq
+        sds = jax.ShapeDtypeStruct
+        return self._decode.lower(
+            self.params, self.pool.k, self.pool.v,
+            sds((S, BPS), jnp.int32), sds((S,), jnp.int32),
+            sds((S,), jnp.int32), sds((S,), jnp.float32),
+            sds((S,), jnp.int32))
+
+    def lower_prefill_step(self):
+        """``jit.lower`` the one-slot prefill-chunk step (same audit
+        surface as :meth:`lower_decode_step`)."""
+        sds = jax.ShapeDtypeStruct
+        return self._prefill.lower(
+            self.params, self.pool.k, self.pool.v,
+            sds((self.blocks_per_seq,), jnp.int32),
+            sds((1, self.prefill_chunk), jnp.int32),
+            sds((), jnp.int32), sds((), jnp.int32),
+            sds((), jnp.float32), sds((), jnp.int32))
 
     @classmethod
     def from_checkpoint(cls, model, directory: str,

@@ -285,6 +285,13 @@ class Trainer:
                 else self._repl_sharding)
         self._train_step = self._build_train_step()
         self._eval_step = jax.jit(self._eval_step_impl)
+        # TPU_DDP_AUDIT=warn|error: static donation/precision audit of
+        # the train step before it burns a single real step
+        # (tpu_ddp/analysis/gate.py). The audit's compile lands in the
+        # jit cache, so it is the first step's compile, not an extra.
+        if getattr(self.config, "audit", "off") != "off":
+            from tpu_ddp.analysis.gate import maybe_audit_trainer
+            maybe_audit_trainer(self)
 
     # ---- state ---------------------------------------------------------
 

@@ -7,6 +7,11 @@ candidate values trials may measure. ``scripts/knob_audit.py``
 cross-checks the four surfaces against each other (and against the
 hand-rolled env block in ``utils/config.py``) so they cannot silently
 drift — a new knob lands as one registry entry, not N files.
+Non-perf control surfaces (TPU_DDP_AUDIT's graph-audit gate, the
+elastic protocol plumbing) are deliberately NOT entries: they change
+what is *checked* at construction, never what executes, so searching
+them would be meaningless — ``knob_audit``'s ``NONPERF_ENV`` allowlist
+names them and the reverse sweep keeps the split exact.
 
 The constraint model (:func:`violations`) encodes the combinations the
 engine itself refuses or degrades, so the search never spends a trial

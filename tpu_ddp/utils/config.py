@@ -150,6 +150,15 @@ class TrainConfig:
     # beat the tuner.
     autotune: str = "off"
 
+    # Graph audit (tpu_ddp/analysis/): "off" (default), "warn"
+    # (construction-time donation + precision audit of the jitted step
+    # programs, findings surfaced as warnings), or "error" (findings
+    # raise GraphAuditError before the engine burns a step). Non-perf
+    # — it changes what is checked, never what is executed — so it has
+    # no tune/space.py entry (NONPERF_ENV in scripts/knob_audit.py).
+    # Env: TPU_DDP_AUDIT; launch flag --audit.
+    audit: str = "off"
+
     # Serving (tpu_ddp/serve/): continuous-batching decode slots — the
     # live-batch width of the jitted whole-bank decode step. Env:
     # TPU_DDP_SERVE_SLOTS.
@@ -346,6 +355,13 @@ class TrainConfig:
             raise ValueError(
                 f"autotune={self.autotune!r}: expected off|cached|search "
                 "(TPU_DDP_AUTOTUNE)")
+        env_audit = os.environ.get("TPU_DDP_AUDIT")
+        if env_audit:
+            self.audit = env_audit
+        if self.audit not in ("off", "warn", "error"):
+            raise ValueError(
+                f"audit={self.audit!r}: expected off|warn|error "
+                "(TPU_DDP_AUDIT)")
         env_ss = os.environ.get("TPU_DDP_SERVE_SLOTS")
         if env_ss:
             self.serve_slots = int(env_ss)

@@ -1,601 +1,61 @@
-"""Compiled-HLO collective scanner: ops, dtypes, bytes on the wire.
+"""Back-compat shim: the HLO scanner moved to ``tpu_ddp.analysis``.
 
-Factored out of ``scripts/comm_volume.py`` (which re-exports it for its
-pinned tests) so jit-level communication claims are checkable anywhere —
-the script's ladder table, tests/test_compress.py's reduced-dtype
-invariant, and scripts/compress_sweep.py's bytes/step column all scan
-with the same parser instead of three regex forks.
-
-The scan is textual over ``compiled.as_text()``: each collective
-instruction's RESULT shape gives its payload (for all-reduce and
-collective-permute result == operand; reduce-scatter's input is
-result * N; all-gather's result already is the gathered size — the ring
-cost model accounts for each). Tuple-shaped results (all-to-all renders
-as ``(s8[1,256], s8[1,256], ...)`` per peer) sum their elements.
-
-Why per-dtype accounting exists: gradient compression
-(parallel/compress.py) promises the collective EXECUTES at the reduced
-dtype. That is a claim about compiled HLO — XLA float-normalization can
-legalize a bf16 collective back to f32, silently widening the wire while
-keeping the numerics — so the invariant is "scan the compiled text and
-check the payload bytes per dtype", not "trust the jaxpr".
+The collective scanner lives in :mod:`tpu_ddp.analysis.hlo` and the
+dependence-cone overlap predicates in :mod:`tpu_ddp.analysis.cones`;
+this module re-exports every public (and pinned-by-tests private) name
+so existing consumers — scripts/comm_volume.py, scripts/overlap_sweep.py,
+scripts/compress_sweep.py, bench.py, tests/test_overlap.py,
+tests/test_compress.py, tests/test_mpmd.py, tests/test_fleet.py —
+keep importing from here unchanged. New code should import from
+``tpu_ddp.analysis``.
 """
 
 from __future__ import annotations
 
-import re
-
-DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
-               "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4,
-               "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
-
-COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather",
-               "all-to-all", "collective-permute")
-
-# One HLO instruction: "%name = <shape> op-name(...)" where <shape> is
-# "f32[a,b]{layout}" or a tuple "(f32[a]{0}, f32[b]{0})".
-_INSTR = re.compile(
-    r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^ ]*)\s+"
-    r"(" + "|".join(COLLECTIVES) + r")(?:-start)?\(")
-
-_SHAPE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
-
-
-def shape_bytes(shape_str: str) -> int:
-    """Total bytes of an HLO shape string (tuples sum their elements)."""
-    return sum(dtype_bytes(shape_str).values())
-
-
-def dtype_bytes(shape_str: str) -> dict:
-    """Per-dtype byte totals of an HLO shape string."""
-    out: dict = {}
-    for dtype, dims in _SHAPE.findall(shape_str):
-        if dtype not in DTYPE_BYTES:
-            continue  # e.g. token[] / opaque
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        out[dtype] = out.get(dtype, 0) + n * DTYPE_BYTES[dtype]
-    return out
-
-
-def collective_ops(hlo_text: str) -> list:
-    """Every collective instruction as ``{"op", "shape", "payload_bytes",
-    "dtype_bytes"}`` in program order — the raw per-op view
-    ``collective_volume`` aggregates."""
-    found = []
-    for m in _INSTR.finditer(hlo_text):
-        shape_str, op = m.group(1), m.group(2)
-        per_dtype = dtype_bytes(shape_str)
-        found.append({"op": op, "shape": shape_str,
-                      "payload_bytes": sum(per_dtype.values()),
-                      "dtype_bytes": per_dtype})
-    return found
-
-
-def collective_dtype_bytes(hlo_text: str) -> dict:
-    """Payload bytes per dtype summed over ALL collectives — the
-    reduced-dtype invariant's input: a compressed step must put its
-    gradient payload under s8/u16, with f32 collective traffic bounded
-    by the per-block scales + scalar psums (loss terms, guard flag)."""
-    totals: dict = {}
-    for rec in collective_ops(hlo_text):
-        for dt, b in rec["dtype_bytes"].items():
-            totals[dt] = totals.get(dt, 0) + b
-    return totals
-
-
-def collective_volume(hlo_text: str, n_devices: int) -> dict:
-    """Scan compiled HLO for collective ops; payload + ring wire bytes.
-
-    Ring cost model per device (reference CS744 §2.2.2 and the
-    docstring of scripts/comm_volume.py):
-
-    - all-reduce:          2 * (N-1)/N * payload
-    - reduce-scatter:          (N-1)/N * input payload (= result * N)
-    - all-gather:              (N-1)/N * output payload
-    - all-to-all:              (N-1)/N * payload
-    - collective-permute:                payload      (one neighbor hop)
-    """
-    ops: dict = {k: {"count": 0, "payload_bytes": 0, "dtype_bytes": {}}
-                 for k in COLLECTIVES}
-    for rec in collective_ops(hlo_text):
-        agg = ops[rec["op"]]
-        agg["count"] += 1
-        agg["payload_bytes"] += rec["payload_bytes"]
-        for dt, b in rec["dtype_bytes"].items():
-            agg["dtype_bytes"][dt] = agg["dtype_bytes"].get(dt, 0) + b
-    frac = (n_devices - 1) / n_devices
-    wire = 0.0
-    for op, rec in ops.items():
-        if op == "all-reduce":
-            rec["wire_bytes_per_device"] = 2 * frac * rec["payload_bytes"]
-        elif op == "reduce-scatter":
-            # result is the 1/N shard; input payload = result * N.
-            rec["wire_bytes_per_device"] = (frac * rec["payload_bytes"]
-                                            * n_devices)
-        elif op == "all-gather":
-            rec["wire_bytes_per_device"] = frac * rec["payload_bytes"]
-        elif op == "all-to-all":
-            rec["wire_bytes_per_device"] = frac * rec["payload_bytes"]
-        else:  # collective-permute: one neighbor hop
-            rec["wire_bytes_per_device"] = float(rec["payload_bytes"])
-        wire += rec["wire_bytes_per_device"]
-    ops = {k: v for k, v in ops.items() if v["count"]}
-    return {"ops": ops, "total_wire_bytes_per_device": wire,
-            "total_collectives": sum(v["count"] for v in ops.values()),
-            "dtype_payload_bytes": collective_dtype_bytes(hlo_text)}
-
-
-def train_step_hlo(trainer, state, images, labels, weights) -> str:
-    """Compiled HLO text of a Trainer's jitted train step (handles the
-    stateful-compression signature via ``Trainer.lower_train_step``)."""
-    return trainer.lower_train_step(
-        state, images, labels, weights).compile().as_text()
-
-
-# ---------------------------------------------------------------------------
-# Overlap verdict: is the gradient traffic bucketized such that the
-# scheduler COULD hide it behind backward compute?
-#
-# This is deliberately a DATAFLOW predicate, not a schedule one.  The CPU
-# backend (where tests run) strips ``optimization_barrier`` and its linear
-# scheduler is free to sink every collective to the end of the step, so
-# "collective appears between two convolutions in program order" proves
-# nothing either way.  What bucketization actually changes is the
-# dependence structure: with one fused collective, every heavy backward op
-# (convolution/dot) is an ANCESTOR of the collective, so no compute can
-# ever run concurrently with it; with k buckets issued reverse-autodiff
-# order, bucket 0's collective is independent of the (still pending)
-# backward compute of buckets 1..k-1 — a latency-hiding scheduler (the
-# TPU one) is then ALLOWED to overlap them.  We check exactly that: a
-# gradient collective is *overlappable* iff some heavy op is neither in
-# its ancestor cone nor in its descendant cone.
-#
-# Verdict rule: >= 2 gradient-sized collectives, and at least
-# ``max(1, n // 2)`` of them overlappable.  The last bucket (input-side
-# leaves, fires after all backward compute) and the reassembly gathers of
-# the final bucket are structurally never overlappable, hence majority
-# rather than all.  The negative control is a SINGLE-bucket overlap step
-# (``bucket_mb`` larger than the model): one concatenated collective
-# whose ancestor cone contains every heavy op — the "flatten, concat,
-# sync once" anti-pattern torch DDP's bucketing exists to avoid.  Note
-# the per-leaf baseline rungs (sync.py) genuinely ARE dataflow-
-# overlappable and report as such; what bucketing changes vs per-leaf is
-# launch count and payload sizing (per-tensor latency), not dependence
-# structure, so the verdict for them being True is correct, not a false
-# positive.
-# ---------------------------------------------------------------------------
-
-HEAVY_OPS = ("convolution", "dot")
-
-# CPU/GPU backends frequently legalize conv/gemm into custom-calls
-# (oneDNN / Eigen / cuDNN); match those targets as heavy too.
-_HEAVY_CUSTOM = re.compile(r"conv|gemm|matmul|dot|onednn|dnn|eigen", re.I)
-
-# Param lists may nest parens (while/region bodies take TUPLE params:
-# ``%while_body (p: (s32[], f32[...])) -> (...) {``) — ``\(.*\)`` spans
-# them; ``[^)]*`` would drop exactly the computations that hold a
-# pipelined step's edge collectives.
-_COMP_HEADER = re.compile(
-    r"^(?P<entry>ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\(.*\)\s*->\s*.*\{")
-
-_INSTR_LINE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
-    r"(?P<shape>\([^)]*\)|[\w\[\],]+(?:\{[^}]*\})?)\s+"
-    r"(?P<op>[\w\-]+)\(")
-
-_NAME_TOKEN = re.compile(r"%?([\w.\-]+)")
-
-
-def _split_computations(hlo_text: str) -> dict:
-    """Map computation name -> list of raw instruction lines."""
-    comps: dict = {}
-    current = None
-    for line in hlo_text.splitlines():
-        stripped = line.strip()
-        if current is None:
-            m = _COMP_HEADER.match(stripped)
-            if m and "=" not in stripped.split("(", 1)[0]:
-                current = m.group("name")
-                comps[current] = []
-        elif stripped == "}":
-            current = None
-        elif stripped:
-            comps[current].append(line)
-    return comps
-
-
-def _operand_span(line: str, start: int) -> str:
-    """Text of the balanced operand parens opening at ``line[start]``."""
-    depth = 0
-    for i in range(start, len(line)):
-        if line[i] == "(":
-            depth += 1
-        elif line[i] == ")":
-            depth -= 1
-            if depth == 0:
-                return line[start + 1:i]
-    return line[start + 1:]
-
-
-def _parse_computation(lines: list) -> dict:
-    """name -> {"op", "shape", "operands": [names], "attrs": str}."""
-    instrs: dict = {}
-    order = []
-    for line in lines:
-        m = _INSTR_LINE.match(line)
-        if not m:
-            continue
-        open_at = line.index("(", m.end("op"))
-        operands_txt = _operand_span(line, open_at)
-        attrs = line[open_at + len(operands_txt) + 2:]
-        instrs[m.group("name")] = {
-            "op": m.group("op"), "shape": m.group("shape"),
-            "operands_txt": operands_txt, "attrs": attrs,
-        }
-        order.append(m.group("name"))
-    for name in order:
-        rec = instrs[name]
-        rec["operands"] = [
-            t for t in _NAME_TOKEN.findall(rec.pop("operands_txt"))
-            if t in instrs and t != name]
-    return instrs
-
-
-def _called_comps(attrs: str) -> list:
-    """Computation names referenced by an instruction's attributes
-    (calls= / to_apply= / body= / condition= / branch_computations=)."""
-    return re.findall(r"=\s*\{?%?([\w.\-]+)", attrs)
-
-
-def _comp_has_heavy(comp_name, comps_instrs, memo) -> bool:
-    if comp_name in memo:
-        return memo[comp_name]
-    memo[comp_name] = False  # cycle guard
-    heavy = False
-    for rec in comps_instrs.get(comp_name, {}).values():
-        if _instr_is_heavy(rec, comps_instrs, memo):
-            heavy = True
-            break
-    memo[comp_name] = heavy
-    return heavy
-
-
-def _instr_is_heavy(rec, comps_instrs, memo) -> bool:
-    if rec["op"] in HEAVY_OPS:
-        return True
-    if rec["op"] == "custom-call" and _HEAVY_CUSTOM.search(rec["attrs"]):
-        return True
-    if rec["op"] in ("fusion", "call", "while", "conditional", "map"):
-        return any(_comp_has_heavy(c, comps_instrs, memo)
-                   for c in _called_comps(rec["attrs"]))
-    return False
-
-
-def overlap_report(hlo_text: str, min_payload_bytes: int = 1024) -> dict:
-    """Dataflow overlap verdict for a compiled train step.
-
-    Scans the computation with the most gradient-sized collectives
-    (ENTRY for a plain step, the while-body for a K-step scan), builds
-    the dependence graph, and classifies each collective as overlappable
-    iff some heavy op (convolution/dot, incl. fused/custom-call forms)
-    lies outside both its ancestor and descendant cones.
-
-    ``min_payload_bytes`` filters out the scalar bookkeeping collectives
-    (loss psum, StepGuard flag) that exist on every rung regardless of
-    bucketing.  Never raises — ``assert_overlap`` wraps this for tests;
-    bench.py records the raw report.
-    """
-    comps_lines = _split_computations(hlo_text)
-    comps_instrs = {name: _parse_computation(lines)
-                    for name, lines in comps_lines.items()}
-    heavy_memo: dict = {}
-
-    def grad_collectives(instrs):
-        out = []
-        for name, rec in instrs.items():
-            op = rec["op"]
-            base = op[:-6] if op.endswith("-start") else op
-            if base not in COLLECTIVES:
-                continue
-            payload = shape_bytes(rec["shape"])
-            if base == "reduce-scatter":
-                # result is the 1/N shard; grad payload is the input.
-                ops = rec["operands"]
-                if ops:
-                    payload = shape_bytes(instrs[ops[0]]["shape"])
-            if payload >= min_payload_bytes:
-                out.append((name, base, payload))
-        return out
-
-    target, target_colls = None, []
-    for name, instrs in comps_instrs.items():
-        colls = grad_collectives(instrs)
-        if len(colls) > len(target_colls):
-            target, target_colls = name, colls
-    if target is None:
-        return {"overlapped": False, "n_grad_collectives": 0,
-                "n_overlappable": 0, "n_heavy_ops": 0,
-                "computation": None, "collectives": [],
-                "min_payload_bytes": min_payload_bytes,
-                "schedule_interleaved": None}
-
-    instrs = comps_instrs[target]
-    names = list(instrs)
-    idx = {n: i for i, n in enumerate(names)}
-
-    # Ancestor cones as bitmasks; HLO text is def-before-use so a single
-    # forward pass suffices (operands of x always precede x).
-    anc = [0] * len(names)
-    for i, n in enumerate(names):
-        m = 0
-        for o in instrs[n]["operands"]:
-            j = idx[o]
-            m |= anc[j] | (1 << j)
-        anc[i] = m
-
-    heavy_idx = [i for i, n in enumerate(names)
-                 if _instr_is_heavy(instrs[n], comps_instrs, heavy_memo)]
-    heavy_mask = 0
-    for i in heavy_idx:
-        heavy_mask |= 1 << i
-
-    coll_idx = {n: idx[n] for n, _, _ in target_colls}
-    # Descendant cone of each collective: every instr whose ancestor
-    # mask contains the collective's bit.
-    desc = {n: 0 for n in coll_idx}
-    for i in range(len(names)):
-        for n, ci in coll_idx.items():
-            if anc[i] >> ci & 1:
-                desc[n] |= 1 << i
-
-    collectives = []
-    n_overlappable = 0
-    for n, base, payload in target_colls:
-        ci = coll_idx[n]
-        free = heavy_mask & ~anc[ci] & ~desc[n] & ~(1 << ci)
-        ok = bool(free)
-        n_overlappable += ok
-        collectives.append({"name": n, "op": base,
-                            "payload_bytes": payload,
-                            "overlappable": ok})
-
-    # Informational only: does program order already interleave heavy
-    # compute between the grad collectives?  (The CPU scheduler often
-    # doesn't even when the dataflow allows it; TPU's does.)
-    positions = sorted(coll_idx.values())
-    interleaved = None
-    if len(positions) >= 2 and heavy_idx:
-        interleaved = any(positions[0] < h < positions[-1]
-                          for h in heavy_idx)
-
-    n = len(target_colls)
-    return {
-        "overlapped": bool(n >= 2 and n_overlappable >= max(1, n // 2)),
-        "n_grad_collectives": n,
-        "n_overlappable": n_overlappable,
-        "n_heavy_ops": len(heavy_idx),
-        "computation": target,
-        "collectives": collectives,
-        "min_payload_bytes": min_payload_bytes,
-        "schedule_interleaved": interleaved,
-    }
-
-
-# ---------------------------------------------------------------------------
-# The same dataflow predicate, generalized from collectives to LARGE
-# in-place updates — the disagg fleet's KV-block adoption scatter
-# (tpu_ddp/fleet/disagg.py). The claim to check is identical in shape:
-# the fused adopt+decode program applies the transfer's payload with a
-# scatter that depends on nothing the decode computes (it runs against
-# freshly allocated, table-less block ids), so a latency-hiding
-# scheduler is ALLOWED to land the transfer behind decode compute. A
-# wrong fusion order — adopting AFTER the bank's writes — would put
-# every heavy op in the scatter's ancestor cone and serialize the edge
-# behind the step; that is the regression this analysis exists to
-# catch.
-#
-# Backend reality: XLA rarely leaves ``scatter`` standing at the entry
-# computation. The CPU expander lowers a multi-row scatter into a
-# ``while`` loop whose carried state holds the updates payload, and
-# single-row updates fuse into loop fusions with a
-# ``dynamic-update-slice`` root. The target picker therefore matches
-# any entry instruction that IS or CONTAINS (via called computations)
-# a scatter/dynamic-update-slice, and sizes its payload from the
-# shapes riding along: the largest tuple element / operand that is
-# NOT the in-place buffer itself (the buffer is always the biggest —
-# it's the whole pool). ``min_update_bytes`` then separates the
-# block-payload adoption (KBs per transfer) from the bank's own
-# per-token writes (one row per slot).
-# ---------------------------------------------------------------------------
-
-UPDATE_OPS = ("scatter", "dynamic-update-slice")
-
-_ENTRY_NAME = re.compile(r"^ENTRY\s+%?([\w.\-]+)", re.M)
-
-
-def _comp_has_update(comp_name, comps_instrs, memo) -> bool:
-    if comp_name in memo:
-        return memo[comp_name]
-    memo[comp_name] = False  # cycle guard
-    found = False
-    for rec in comps_instrs.get(comp_name, {}).values():
-        if _instr_has_update(rec, comps_instrs, memo):
-            found = True
-            break
-    memo[comp_name] = found
-    return found
-
-
-def _instr_has_update(rec, comps_instrs, memo) -> bool:
-    if rec["op"] in UPDATE_OPS:
-        return True
-    if rec["op"] in ("fusion", "call", "while", "conditional", "map"):
-        return any(_comp_has_update(c, comps_instrs, memo)
-                   for c in _called_comps(rec["attrs"]))
-    return False
-
-
-def _element_bytes(shape_str: str) -> list:
-    """Byte size of each array element of an HLO shape string (one
-    entry for a plain array, one per element for a tuple)."""
-    sizes = []
-    for dtype, dims in _SHAPE.findall(shape_str):
-        if dtype not in DTYPE_BYTES:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        sizes.append(n * DTYPE_BYTES[dtype])
-    return sizes
-
-
-def _update_payload_bytes(rec, instrs) -> int:
-    """Updates-operand size for an update-carrying instruction: the
-    largest shape riding along that is NOT the in-place buffer. For a
-    tuple result (scatter lowered to a while loop) the candidates are
-    the tuple elements; otherwise the resolvable operand shapes."""
-    if rec["shape"].startswith("("):
-        sizes = _element_bytes(rec["shape"])
-    else:
-        sizes = []
-        for o in rec.get("operands", []):
-            if o in instrs:
-                sizes.extend(_element_bytes(instrs[o]["shape"]))
-        sizes.extend([max(_element_bytes(rec["shape"]) or [0])])
-    if len(sizes) < 2:
-        return 0
-    sizes.sort()
-    buffer_bytes = sizes[-1]
-    rest = [s for s in sizes[:-1] if s < buffer_bytes]
-    return max(rest) if rest else 0
-
-
-def update_overlap_report(hlo_text: str,
-                          min_update_bytes: int = 4096) -> dict:
-    """Dataflow overlap verdict for large in-place updates in the
-    ENTRY computation — the disagg KV-adoption check.
-
-    The predicate is STRICTER than the collective one, because "some
-    heavy op outside both cones" is true even of a landing serialized
-    at the very end of the step (it could still overlap the sampling
-    tail). What "the transfer lands behind decode compute" actually
-    requires is that the landing can START at step begin: a target is
-    overlappable iff it has NO heavy ancestor (it waits on no compute)
-    AND at least one heavy op sits outside both its cones (there is
-    compute to hide behind). The verdict requires the LARGEST update
-    (the transfer landing) to pass. Never raises —
-    ``assert_transfer_overlap`` wraps it.
-    """
-    entry = _ENTRY_NAME.search(hlo_text)
-    empty = {"overlapped": False, "n_updates": 0, "n_overlappable": 0,
-             "n_heavy_ops": 0, "computation": None, "updates": [],
-             "min_update_bytes": min_update_bytes}
-    if entry is None:
-        return empty
-    comps_lines = _split_computations(hlo_text)
-    comps_instrs = {name: _parse_computation(lines)
-                    for name, lines in comps_lines.items()}
-    target = entry.group(1)
-    if target not in comps_instrs:
-        return empty
-    instrs = comps_instrs[target]
-    update_memo: dict = {}
-    heavy_memo: dict = {}
-
-    targets = []
-    for name, rec in instrs.items():
-        if not _instr_has_update(rec, comps_instrs, update_memo):
-            continue
-        payload = _update_payload_bytes(rec, instrs)
-        if payload >= min_update_bytes:
-            targets.append((name, payload))
-    if not targets:
-        return dict(empty, computation=target)
-
-    names = list(instrs)
-    idx = {n: i for i, n in enumerate(names)}
-    anc = [0] * len(names)
-    for i, n in enumerate(names):
-        m = 0
-        for o in instrs[n]["operands"]:
-            j = idx[o]
-            m |= anc[j] | (1 << j)
-        anc[i] = m
-    heavy_mask = 0
-    n_heavy = 0
-    for i, n in enumerate(names):
-        if _instr_is_heavy(instrs[n], comps_instrs, heavy_memo):
-            heavy_mask |= 1 << i
-            n_heavy += 1
-
-    tgt_idx = {n: idx[n] for n, _ in targets}
-    desc = {n: 0 for n in tgt_idx}
-    for i in range(len(names)):
-        for n, ti in tgt_idx.items():
-            if anc[i] >> ti & 1:
-                desc[n] |= 1 << i
-
-    updates = []
-    n_overlappable = 0
-    for n, payload in targets:
-        ti = tgt_idx[n]
-        # Heavy ops the landing must WAIT for (its ancestor cone): any
-        # here means the transfer cannot start until compute finishes —
-        # the serialized bad ordering, regardless of how much free
-        # compute the tail still has.
-        blocked_by = heavy_mask & anc[ti]
-        free = heavy_mask & ~anc[ti] & ~desc[n] & ~(1 << ti)
-        ok = not blocked_by and bool(free)
-        n_overlappable += ok
-        updates.append({"name": n, "payload_bytes": payload,
-                        "n_heavy_ancestors": bin(blocked_by).count("1"),
-                        "overlappable": ok})
-    updates.sort(key=lambda u: -u["payload_bytes"])
-    return {
-        "overlapped": bool(updates and updates[0]["overlappable"]),
-        "n_updates": len(updates),
-        "n_overlappable": n_overlappable,
-        "n_heavy_ops": n_heavy,
-        "computation": target,
-        "updates": updates,
-        "min_update_bytes": min_update_bytes,
-    }
-
-
-def assert_transfer_overlap(hlo_text: str,
-                            min_update_bytes: int = 4096) -> dict:
-    """Raise ``AssertionError`` unless the program's largest in-place
-    update (the disagg transfer landing) is dataflow-overlappable with
-    heavy compute; returns the report on success."""
-    report = update_overlap_report(hlo_text,
-                                   min_update_bytes=min_update_bytes)
-    if not report["overlapped"]:
-        raise AssertionError(
-            "the transfer-landing update is not overlappable with "
-            f"compute: {report['n_overlappable']}/{report['n_updates']} "
-            f"updates (>= {min_update_bytes}B payload) start free of "
-            "heavy ancestors with heavy ops outside their cones "
-            f"(computation={report['computation']!r}, "
-            f"heavy_ops={report['n_heavy_ops']}, "
-            f"updates={[(u['name'], u['n_heavy_ancestors']) for u in report['updates']]})")
-    return report
-
-
-def assert_overlap(hlo_text: str, min_payload_bytes: int = 1024) -> dict:
-    """Raise ``AssertionError`` unless ``overlap_report`` says the step's
-    gradient collectives are bucketized-and-overlappable; returns the
-    report on success so callers can log it."""
-    report = overlap_report(hlo_text, min_payload_bytes=min_payload_bytes)
-    if not report["overlapped"]:
-        raise AssertionError(
-            "gradient collectives are not overlappable with compute: "
-            f"{report['n_overlappable']}/{report['n_grad_collectives']} "
-            f"grad-sized collectives (>= {min_payload_bytes}B) have "
-            "heavy ops outside their dependence cones "
-            f"(computation={report['computation']!r}, "
-            f"heavy_ops={report['n_heavy_ops']})")
-    return report
+from tpu_ddp.analysis.cones import (  # noqa: F401
+    HEAVY_OPS,
+    UPDATE_OPS,
+    _called_comps,
+    _COMP_HEADER,
+    _element_bytes,
+    _HEAVY_CUSTOM,
+    _INSTR_LINE,
+    _NAME_TOKEN,
+    _operand_span,
+    _parse_computation,
+    _split_computations,
+    _update_payload_bytes,
+    assert_overlap,
+    assert_transfer_overlap,
+    overlap_report,
+    update_overlap_report,
+)
+from tpu_ddp.analysis.hlo import (  # noqa: F401
+    _INSTR,
+    _SHAPE,
+    COLLECTIVES,
+    DTYPE_BYTES,
+    collective_dtype_bytes,
+    collective_ops,
+    collective_volume,
+    dtype_bytes,
+    shape_bytes,
+    train_step_hlo,
+)
+
+__all__ = [
+    "COLLECTIVES",
+    "DTYPE_BYTES",
+    "HEAVY_OPS",
+    "UPDATE_OPS",
+    "assert_overlap",
+    "assert_transfer_overlap",
+    "collective_dtype_bytes",
+    "collective_ops",
+    "collective_volume",
+    "dtype_bytes",
+    "overlap_report",
+    "shape_bytes",
+    "train_step_hlo",
+    "update_overlap_report",
+]
