@@ -777,6 +777,77 @@ def run_fleet_probe(n_requests: int = 24) -> dict:
     return out
 
 
+def run_fleet_resilience_probe(n_requests: int = 24) -> dict:
+    """Fleet-resilience probe (tpu_ddp/fleet/resilience.py, DESIGN.md
+    §23): goodput of a 3-replica routed fleet with 1 replica chaos-
+    crashed mid-load vs the same fleet healthy, identical workload and
+    Poisson rate. The recorded claim is ``degraded_goodput_ratio``
+    >= 0.55 — losing a third of the fleet must cost roughly a third of
+    the goodput (requests migrate and finish), not all of it — plus
+    ``replica_readmitted``: the backoff probe restores the crashed
+    replica once its one-shot fault has fired. Absolute tokens/sec are
+    host-relative; the ratio and the re-admission are the claims."""
+    import os
+    import time as _time
+
+    from scripts.serve_sweep import build_engine
+    from tpu_ddp.fleet import Router
+    from tpu_ddp.serve import calibrate_rate, make_workload, run_load
+
+    specs = make_workload(n_requests, vocab_size=1024, seed=0,
+                          prompt_len=(4, 17), max_new=(4, 17))
+
+    def build_fleet():
+        return Router([build_engine() for _ in range(3)],
+                      probe_backoff_ms=100.0)
+
+    e = build_engine()                      # warm outside every window
+    for sp in specs[:3]:
+        e.submit(sp.prompt, sp.max_new_tokens)
+    e.run()
+    # Rate sized to ONE replica's saturation: the 3-replica fleet is
+    # comfortably provisioned, so the healthy run clears its SLO and
+    # the crashed run's deficit measures resilience, not overload.
+    rate = calibrate_rate(build_engine, specs)
+    probe = build_engine()
+    h = probe.submit(specs[0].prompt, specs[0].max_new_tokens)
+    probe.run()
+    slo_ms = max(100.0, 20.0 * h.ttft_s * 1e3)
+    out = {"slo_ttft_ms": round(slo_ms, 3), "rate_rps": round(rate, 3),
+           "n_replicas": 3}
+    out["healthy"] = _sub(run_load, build_fleet(), specs, rate,
+                          seed=1, slo_ttft_ms=slo_ms)
+    os.environ["TPU_DDP_CHAOS_FAULTS"] = "replica-crash@6:rank=0"
+    try:
+        import warnings as _warnings
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            crashed_fleet = build_fleet()
+            out["crashed"] = _sub(run_load, crashed_fleet, specs, rate,
+                                  seed=1, slo_ttft_ms=slo_ms)
+            # Drive the probe loop until the backoff re-admits the
+            # one-shot-crashed replica.
+            deadline = _time.monotonic() + 5.0
+            while (crashed_fleet.readmitted == 0
+                   and _time.monotonic() < deadline):
+                crashed_fleet.step()
+                _time.sleep(0.01)
+    finally:
+        del os.environ["TPU_DDP_CHAOS_FAULTS"]
+    out["crashed"]["router"] = {
+        k: crashed_fleet.stats()[k]
+        for k in ("failovers", "readmitted", "migrated", "retried",
+                  "shed")}
+    out["replica_readmitted"] = bool(crashed_fleet.readmitted)
+    hg = out["healthy"].get("goodput_tokens_per_sec")
+    cg = out["crashed"].get("goodput_tokens_per_sec")
+    if hg and cg is not None:
+        out["degraded_goodput_ratio"] = round(cg / hg, 3)
+        out["resilient"] = bool(cg / hg >= 0.55
+                                and out["replica_readmitted"])
+    return out
+
+
 def run_graph_audit_probe() -> dict:
     """Static graph audit (tpu_ddp/analysis/) on THIS backend's
     compiled programs, through the committed sweep's own cell protocol
@@ -968,6 +1039,10 @@ def main() -> dict:
     # at equal simulated hardware — the p99-TTFT ordering under
     # oversubscription.
     extra["fleet"] = _sub(run_fleet_probe)
+    # Fleet-resilience probe (fleet/resilience.py): goodput with 1 of
+    # 3 replicas chaos-crashed mid-load vs healthy — the >= 0.55 ratio
+    # plus backoff re-admission are the recorded claims.
+    extra["fleet_resilience"] = _sub(run_fleet_resilience_probe)
     # Graph-audit probe (tpu_ddp/analysis/): donation/precision/
     # lockstep-determinism verdicts on this chip's own lowered step
     # programs (TPU schedules emit async collective pairs the CPU

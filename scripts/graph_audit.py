@@ -201,8 +201,16 @@ def audit_fleet_cell():
     model = _tiny_lm()
     params = model.init(jax.random.key(0))
     fleet = DisaggEngine(model, params, **GEOM)
-    return _program_audit("fleet/adopt-decode",
-                          lambda: fleet.lower_adopt_decode(2))
+    return [
+        _program_audit("fleet/adopt-decode",
+                       lambda: fleet.lower_adopt_decode(2)),
+        # Degraded-mode local prefill (DESIGN.md §23): the SAME chunked
+        # prefill computation as serve/prefill, but compiled against
+        # the DECODE pool's geometry — a distinct program the decode
+        # worker runs when the edge or the prefill worker dies.
+        _program_audit("fleet/degraded-prefill",
+                       fleet.lower_degraded_prefill),
+    ]
 
 
 def audit_redistribute_cell():
@@ -248,7 +256,7 @@ def build_cells(only=None):
                   lambda: [audit_train_cell("fused", overlap=True)]))
     specs.append(("mpmd", audit_mpmd_cells))
     specs.append(("serve", audit_serve_cells))
-    specs.append(("fleet", lambda: [audit_fleet_cell()]))
+    specs.append(("fleet", audit_fleet_cell))
     specs.append(("redistribute", audit_redistribute_cell))
     if only is not None:
         specs = [(n, t) for n, t in specs

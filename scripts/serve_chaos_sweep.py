@@ -1,0 +1,322 @@
+"""Serve-side chaos sweep — every serving fault kind through its
+recovery path, once (docs/DESIGN.md §23).
+
+The training chaos sweep (scripts/chaos_sweep.py) drills the cluster
+fault kinds through real multi-process runs; this is its serving
+mirror, in-process: one drill per kind in
+``tpu_ddp.resilience.chaos.SERVE_FAULT_KINDS``, each driving a real
+engine/Router fleet under an injected fault and judging the outcome
+against the UNDISTURBED run's token streams — the resilience layer's
+whole claim is that faults are bitwise invisible to survivors.
+
+================  ====================================================
+drill             pass criterion
+================  ====================================================
+replica-crash     a replica dies mid-decode (1 of 3); the Router
+                  marks it unhealthy, migrates its in-flight requests,
+                  final streams are BITWISE equal to the undisturbed
+                  run, and the backoff probe re-admits the replica
+slow-replica      a replica wedges past the step deadline; treated
+                  exactly like a crash (slow == dead), same parity bar
+edge-drop         a prefill->decode KV delivery is lost; the decode
+                  worker re-prefills locally (degraded mode) and the
+                  streams still match bitwise
+nonfinite-logits  one live request's KV pages are NaN-poisoned; the
+                  in-graph finiteness mask quarantines exactly that
+                  request, its batchmates keep bitwise-exact streams,
+                  and the scrubbed pages are safely reusable
+================  ====================================================
+
+Every drill additionally pins the accounting identity
+``completed + cancelled + shed == submitted`` — chaos may slow, shed,
+or quarantine a request, but never lose one.
+
+Writes ``experiments/serve_chaos.json``; exits 1 unless every drill
+passes.
+
+Usage::
+
+    python scripts/serve_chaos_sweep.py            # all drills
+    python scripts/serve_chaos_sweep.py --only edge-drop
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import warnings
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tpu_ddp.resilience.chaos import CHAOS_ENV, SERVE_FAULT_KINDS  # noqa: E402
+
+GEOM = dict(num_slots=4, block_size=8, prefill_chunk=8)
+MIXED = [(0, 5, 6, 0.0), (1, 9, 5, 0.0), (2, 12, 4, 0.7),
+         (3, 8, 6, 1.0)]
+
+
+def _model_params():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_ddp.models.transformer import make_transformer
+
+    model = make_transformer("TransformerLM-tiny", max_seq_len=64,
+                             compute_dtype=jnp.float32)
+    return model, model.init(jax.random.key(0))
+
+
+def _prompt(L, seed=0):
+    import numpy as np
+    return np.random.default_rng(seed).integers(0, 1024, size=L,
+                                                dtype=np.int64)
+
+
+def _submit_mixed(engine):
+    return [engine.submit(_prompt(L, seed=ps), n, temperature=t, seed=i)
+            for i, (ps, L, n, t) in enumerate(MIXED)]
+
+
+def _baseline(model, params):
+    from tpu_ddp.serve import ServeEngine
+    eng = ServeEngine(model, params, **GEOM)
+    hs = _submit_mixed(eng)
+    eng.run()
+    return [list(h.tokens) for h in hs]
+
+
+def _check(cell: dict, name: str, ok: bool, detail=None) -> bool:
+    cell["checks"][name] = {"ok": bool(ok)}
+    if detail is not None:
+        cell["checks"][name]["detail"] = detail
+    return bool(ok)
+
+
+def _identity(cell, handles) -> bool:
+    """completed + cancelled + shed == submitted, nothing undone."""
+    n_done = sum(h.done for h in handles)
+    n_shed = sum(h.shed for h in handles)
+    n_cancelled = sum(h.cancelled and not h.shed for h in handles)
+    n_completed = sum(h.done and not h.shed and not h.cancelled
+                     for h in handles)
+    return _check(cell, "zero_requests_lost",
+                  n_done == len(handles)
+                  and n_completed + n_cancelled + n_shed == len(handles),
+                  {"submitted": len(handles), "completed": n_completed,
+                   "cancelled": n_cancelled, "shed": n_shed})
+
+
+def drill_replica_crash(ctx, cell: dict) -> bool:
+    """1 of 3 replicas dies mid-decode; migration must be bitwise
+    invisible and the backoff probe must re-admit the replica."""
+    from tpu_ddp.fleet import Router
+    from tpu_ddp.serve import ServeEngine
+
+    model, params, baseline = ctx
+    os.environ[CHAOS_ENV] = "replica-crash@4:rank=0"
+    try:
+        replicas = [ServeEngine(model, params, **GEOM)
+                    for _ in range(3)]
+        router = Router(replicas, probe_backoff_ms=50.0)
+        hs = _submit_mixed(router)
+        router.run()
+    finally:
+        del os.environ[CHAOS_ENV]
+    ok = _check(cell, "all_done", all(h.done for h in hs))
+    ok &= _check(cell, "failover_engaged",
+                 router.failovers == 1, router.failovers)
+    ok &= _check(cell, "tokens_bitwise_equal_undisturbed",
+                 [list(h.tokens) for h in hs] == baseline)
+    ok &= _identity(cell, hs)
+    ok &= _check(cell, "pool_accounting_ok", router.accounting_ok())
+    # Re-admission: keep stepping until the 50 ms backoff elapses and
+    # the probe succeeds (the crash was one-shot).
+    deadline = time.monotonic() + 5.0
+    while router.readmitted == 0 and time.monotonic() < deadline:
+        router.step()
+        time.sleep(0.01)
+    ok &= _check(cell, "replica_readmitted_after_backoff",
+                 router.readmitted == 1
+                 and all(h.healthy for h in router.health))
+    # And the re-admitted fleet serves new traffic bitwise-correctly.
+    hs2 = _submit_mixed(router)
+    router.run()
+    ok &= _check(cell, "post_readmission_parity",
+                 [list(h.tokens) for h in hs2] == baseline)
+    return ok
+
+
+def drill_slow_replica(ctx, cell: dict) -> bool:
+    """A replica overruns the step deadline; slow == dead — same
+    migration path, same parity bar."""
+    from tpu_ddp.fleet import Router
+    from tpu_ddp.serve import ServeEngine
+
+    model, params, baseline = ctx
+    os.environ[CHAOS_ENV] = "slow-replica@3:rank=1"
+    os.environ["TPU_DDP_CHAOS_SLOW_S"] = "0.4"
+    try:
+        replicas = [ServeEngine(model, params, **GEOM)
+                    for _ in range(3)]
+        router = Router(replicas, probe_backoff_ms=50.0,
+                        step_deadline_ms=150.0)
+        hs = _submit_mixed(router)
+        router.run()
+    finally:
+        del os.environ[CHAOS_ENV]
+        del os.environ["TPU_DDP_CHAOS_SLOW_S"]
+    ok = _check(cell, "all_done", all(h.done for h in hs))
+    ok &= _check(cell, "deadline_overrun_became_failover",
+                 router.failovers == 1
+                 and not router.health[1].healthy
+                 or router.readmitted >= 1,
+                 {"failovers": router.failovers,
+                  "readmitted": router.readmitted})
+    ok &= _check(cell, "tokens_bitwise_equal_undisturbed",
+                 [list(h.tokens) for h in hs] == baseline)
+    ok &= _identity(cell, hs)
+    ok &= _check(cell, "pool_accounting_ok", router.accounting_ok())
+    return ok
+
+
+def drill_edge_drop(ctx, cell: dict) -> bool:
+    """A KV-edge delivery is lost in flight; the decode worker falls
+    back to local chunked prefill — single-engine semantics, already
+    bitwise-pinned."""
+    from tpu_ddp.fleet import DisaggEngine
+
+    model, params, baseline = ctx
+    os.environ[CHAOS_ENV] = "edge-drop@2"
+    try:
+        fleet = DisaggEngine(model, params, **GEOM)
+        hs = _submit_mixed(fleet)
+        fleet.run()
+    finally:
+        del os.environ[CHAOS_ENV]
+    ok = _check(cell, "all_done", all(h.done for h in hs))
+    ok &= _check(cell, "delivery_dropped", fleet.edge.dropped == 1,
+                 fleet.edge.dropped)
+    ok &= _check(cell, "degraded_local_prefill_engaged",
+                 fleet.metrics.counters.get("fleet_degraded", 0) >= 1,
+                 dict(fleet.metrics.counters))
+    ok &= _check(cell, "tokens_bitwise_equal_undisturbed",
+                 [list(h.tokens) for h in hs] == baseline)
+    ok &= _identity(cell, hs)
+    ok &= _check(cell, "pool_accounting_ok", fleet.accounting_ok())
+    return ok
+
+
+def drill_nonfinite_logits(ctx, cell: dict) -> bool:
+    """NaN-poisoned KV pages make one request's logits non-finite; the
+    decode analog of StepGuard quarantines the request, not the
+    batch."""
+    from tpu_ddp.serve import ServeEngine
+
+    model, params, baseline = ctx
+    os.environ[CHAOS_ENV] = "nonfinite-logits@6"
+    try:
+        eng = ServeEngine(model, params, **GEOM)
+        hs = _submit_mixed(eng)
+        eng.run()
+    finally:
+        del os.environ[CHAOS_ENV]
+    bad = [h for h in hs if h.quarantined]
+    ok = _check(cell, "all_done", all(h.done for h in hs))
+    ok &= _check(cell, "exactly_one_quarantined", len(bad) == 1,
+                 [h.rid for h in bad])
+    ok &= _check(cell, "batchmates_bitwise_equal_undisturbed",
+                 [list(h.tokens) for h in hs if not h.quarantined]
+                 == [b for h, b in zip(hs, baseline)
+                     if not h.quarantined])
+    ok &= _identity(cell, hs)
+    ok &= _check(cell, "pool_accounting_ok", eng.accounting_ok())
+    # Scrub proof: reusing the pool after the quarantine must produce
+    # finite, bitwise-correct streams (a NaN'd page leaking into a new
+    # request would corrupt it through zero-weight attention).
+    hs2 = _submit_mixed(eng)
+    eng.run()
+    ok &= _check(cell, "scrubbed_pages_reused_cleanly",
+                 [list(h.tokens) for h in hs2] == baseline)
+    return ok
+
+
+DRILLS = {
+    "replica-crash": drill_replica_crash,
+    "slow-replica": drill_slow_replica,
+    "edge-drop": drill_edge_drop,
+    "nonfinite-logits": drill_nonfinite_logits,
+}
+assert set(DRILLS) == set(SERVE_FAULT_KINDS), \
+    "a serve fault kind exists without a sweep drill"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of serve fault kinds")
+    ap.add_argument("--out", default=str(REPO / "experiments"
+                                         / "serve_chaos.json"))
+    args = ap.parse_args(argv)
+    kinds = (args.only.split(",") if args.only else list(DRILLS))
+    for k in kinds:
+        if k not in DRILLS:
+            ap.error(f"unknown serve fault kind {k!r}; "
+                     f"have {sorted(DRILLS)}")
+
+    import jax
+    model, params = _model_params()
+    baseline = _baseline(model, params)
+    ctx = (model, params, baseline)
+
+    dev = jax.devices()[0]
+    results = {
+        "note": ("in-process serve chaos drills over the tiny f32 LM "
+                 "(geometry matches tests/test_fleet_resilience.py); "
+                 "the pass bar is BITWISE token parity with the "
+                 "undisturbed run for every surviving request plus "
+                 "the zero-lost identity completed+cancelled+shed == "
+                 "submitted. Backend-independent claims — no "
+                 "wall-clock numbers are compared."),
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "geometry": GEOM,
+        "n_requests": len(MIXED),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "cells": {},
+    }
+    for kind in kinds:
+        cell = {"checks": {}}
+        print(f"[serve-chaos] {kind}...", flush=True)
+        t0 = time.monotonic()
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                cell["passed"] = DRILLS[kind](ctx, cell)
+        except Exception as e:  # noqa: BLE001 — record, keep sweeping
+            cell["passed"] = False
+            cell["error"] = f"{type(e).__name__}: {e}"
+        cell["wall_s"] = round(time.monotonic() - t0, 1)
+        results["cells"][kind] = cell
+        print(f"[serve-chaos] {kind}: "
+              f"{'PASS' if cell['passed'] else 'FAIL'} "
+              f"({cell['wall_s']}s) "
+              f"{ {k: v['ok'] for k, v in cell['checks'].items()} }",
+              flush=True)
+
+    results["all_passed"] = all(c["passed"]
+                                for c in results["cells"].values())
+    out = Path(args.out)
+    out.parent.mkdir(exist_ok=True)
+    out.write_text(json.dumps(results, indent=1))
+    print(f"[serve-chaos] wrote {out} "
+          f"(all_passed={results['all_passed']})")
+    return 0 if results["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

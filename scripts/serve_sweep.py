@@ -81,6 +81,16 @@ def build_engine(mode: str = "continuous", **knobs):
     roles = knobs.pop("fleet_roles", "single")
     kv_wire = knobs.pop("kv_wire", "none")
     knobs.pop("router_policy", None)
+    # Resilience knobs: shedding is an engine admission parameter; the
+    # health/migration knobs (fleet_health, backoff, deadline, retry
+    # budget) are Router concerns with no single-engine meaning —
+    # dropped here like router_policy, exercised by
+    # scripts/serve_chaos_sweep.py and tests/test_fleet_resilience.py.
+    geom["queue_limit"] = knobs.pop("serve_queue_limit", 0)
+    geom["shed_ms"] = knobs.pop("serve_shed_ms", 0.0)
+    for k in ("fleet_health", "fleet_probe_backoff_ms",
+              "fleet_step_deadline_ms", "fleet_retry_budget"):
+        knobs.pop(k, None)
     if roles == "disagg":
         from tpu_ddp.fleet import DisaggEngine
         return DisaggEngine(model, params, kv_wire=kv_wire,

@@ -338,6 +338,8 @@ class TestFactoredZeRO1:
         np.testing.assert_allclose(losses["zero1"], losses["replicated"],
                                    rtol=1e-4)
 
+    @pytest.mark.slow  # AdamW-under-zero1 parity is pinned fast and at
+    # length by tests/test_zero.py; this re-checks it from the factored side
     def test_lmtrainer_zero1_adamw_matches_replicated(self, devices):
         """The elementwise branch: AdamW under opt_sharding='zero1' goes
         through the flat ZeRO1 wrapper and must match too."""
@@ -364,6 +366,9 @@ class TestFactoredZeRO1:
         np.testing.assert_allclose(losses["zero1"], losses["replicated"],
                                    rtol=1e-4)
 
+    @pytest.mark.slow  # cross-layout restore; the same-layout roundtrip in
+    # TestFactoredZeRO1Partitioned stays fast, cross-layout is pinned by
+    # test_zero.py / test_fsdp.py
     def test_zero1_checkpoint_restores_into_replicated(self, devices,
                                                        tmp_path):
         """zero1 checkpoints hold canonical shapes: a replicated trainer
@@ -445,7 +450,10 @@ class TestCellAdafactor:
             parts_tree,
             is_leaf=lambda x: x is None or isinstance(x, _LeafPart))
 
-    @pytest.mark.parametrize("b1", [None, 0.9])
+    # b1=0.9 is the fast cell (it additionally allocates momentum state);
+    # the momentum-free variant only drops a term from the update.
+    @pytest.mark.parametrize("b1", [
+        pytest.param(None, marks=pytest.mark.slow), 0.9])
     def test_tp_matches_per_cell_ground_truth(self, devices, b1):
         from tpu_ddp.parallel.mesh import MODEL_AXIS
 

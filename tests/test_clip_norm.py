@@ -185,6 +185,8 @@ class TestClipPipeline:
     def _tokens(self, b=8, seed=13):
         return np.random.default_rng(seed).integers(0, 1024, size=(b, 17))
 
+    @pytest.mark.slow  # three LM trainer compiles; the pp psum term is the
+    # only new piece and tp/dense clip agreement stays fast above
     def test_pp_matches_dense(self, devices):
         model = make_transformer("TransformerLM-tiny", max_seq_len=16,
                                  compute_dtype=jnp.float32)

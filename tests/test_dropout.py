@@ -166,6 +166,8 @@ class TestTrainerDropout:
         state, loss = tr2.train_step(state, x, y)
         assert np.isfinite(np.ravel(np.asarray(loss))).all()
 
+    @pytest.mark.slow  # three pp-trainer compiles; the pp mask keying is
+    # pinned fast by test_pipeline_dropout_key_varies_by_step
     def test_pipeline_dropout_geometry_invariant(self, devices):
         """Dropout under pp: masks key on (microbatch, GLOBAL layer), so
         the same seed gives IDENTICAL gradients at pp=1 and pp=2 — the

@@ -272,7 +272,7 @@ class TestDisagg:
         def bad_step(params, pool_k, pool_v, adopt_ids, adopt_k,
                      adopt_v, tables, lengths, last_tokens, temps,
                      seeds):
-            k, v, toks, lps = decode_bank(
+            k, v, toks, lps, _bad = decode_bank(
                 model, fleet.block_size, fleet.blocks_per_seq, params,
                 pool_k, pool_v, tables, lengths, last_tokens, temps,
                 seeds)

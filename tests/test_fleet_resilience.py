@@ -1,0 +1,469 @@
+"""Fleet resilience (tpu_ddp/fleet/resilience.py, docs/DESIGN.md §23):
+replica health + deterministic migration in the Router, degraded-mode
+disaggregation, SLO-aware load shedding, and the serve-side chaos
+kinds.
+
+The acceptance bar is the same one the fleet was built on — BITWISE
+TOKEN PARITY — now under faults: a replica crash mid-decode, a dropped
+KV-edge delivery, or a dead prefill worker must leave the surviving
+token streams identical to the undisturbed run (sampling is stateless
+keyed on (seed, position), so a migrated continuation replayed from
+``prompt + tokens_so_far`` re-keys exactly where the original left
+off). On top of parity, every drill pins the accounting identity:
+``completed + cancelled + shed == submitted`` — no request is ever
+lost, resurrected after cancel, or double-freed.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.fleet import (
+    DisaggEngine,
+    ReplicaCrashError,
+    ReplicaHealth,
+    Router,
+    continuation_of,
+)
+from tpu_ddp.models.transformer import make_transformer
+from tpu_ddp.serve import ServeEngine, make_workload, run_load
+
+GEOM = dict(num_slots=4, block_size=8, prefill_chunk=8)
+
+MIXED = [(0, 5, 6, 0.0), (1, 9, 5, 0.0), (2, 12, 4, 0.7),
+         (3, 8, 6, 1.0)]
+
+
+@pytest.fixture(scope="module")
+def model():
+    return make_transformer("TransformerLM-tiny", max_seq_len=64,
+                            compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def baseline(model, params):
+    """Undisturbed single-engine token streams for MIXED — the parity
+    reference every fault drill is judged against."""
+    eng = ServeEngine(model, params, **GEOM)
+    hs = _submit_mixed(eng)
+    eng.run()
+    return [list(h.tokens) for h in hs]
+
+
+def _prompt(L, seed=0):
+    return np.random.default_rng(seed).integers(0, 1024, size=L,
+                                                dtype=np.int64)
+
+
+def _submit_mixed(engine):
+    return [engine.submit(_prompt(L, seed=ps), n, temperature=t, seed=i)
+            for i, (ps, L, n, t) in enumerate(MIXED)]
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _Crashy:
+    """Replica wrapper that raises out of step() exactly once at the
+    Nth step — the deterministic stand-in for a replica crash."""
+
+    def __init__(self, engine, crash_at):
+        self.engine = engine
+        self.crash_at = crash_at
+        self.n = 0
+
+    def step(self):
+        self.n += 1
+        if self.n == self.crash_at:
+            raise ReplicaCrashError(f"synthetic crash at step {self.n}")
+        return self.engine.step()
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+
+class TestReplicaHealth:
+    def test_backoff_doubles_and_caps(self):
+        clk = _FakeClock()
+        h = ReplicaHealth(backoff_s=0.2, backoff_cap_s=1.0, clock=clk)
+        assert h.healthy
+        assert h.mark_failure() == pytest.approx(0.2)
+        assert h.mark_failure() == pytest.approx(0.4)
+        assert h.mark_failure() == pytest.approx(0.8)
+        assert h.mark_failure() == pytest.approx(1.0)   # capped
+        assert h.mark_failure() == pytest.approx(1.0)
+        assert not h.healthy and h.failures == 5
+
+    def test_probe_gate_and_recovery_reset(self):
+        clk = _FakeClock()
+        h = ReplicaHealth(backoff_s=0.5, clock=clk)
+        h.mark_failure()
+        assert not h.probe_due()          # backoff not served yet
+        clk.t = 0.49
+        assert not h.probe_due()
+        clk.t = 0.5
+        assert h.probe_due()
+        h.mark_recovered()
+        assert h.healthy and h.failures == 0
+        # Post-recovery failure starts the schedule over at 1x.
+        assert h.mark_failure() == pytest.approx(0.5)
+
+    def test_rejects_nonpositive_backoff(self):
+        with pytest.raises(ValueError, match="backoff_s"):
+            ReplicaHealth(backoff_s=0.0)
+
+
+class TestContinuation:
+    def test_prompt_extends_and_budget_shrinks(self, model, params):
+        eng = ServeEngine(model, params, **GEOM)
+        h = eng.submit(_prompt(6, seed=1), 5, seed=3)
+        eng.run()
+        assert len(h.tokens) == 5
+        prompt, budget = continuation_of(h)
+        assert budget == 0
+        np.testing.assert_array_equal(
+            prompt, np.concatenate([np.asarray(h.prompt, np.int32),
+                                    np.asarray(h.tokens, np.int32)]))
+
+    def test_tokenless_request_passes_through(self, model, params):
+        eng = ServeEngine(model, params, **GEOM)
+        h = eng.submit(_prompt(6, seed=1), 5)
+        prompt, budget = continuation_of(h)
+        assert budget == 5 and len(prompt) == 6
+
+
+class TestMigration:
+    def test_crash_mid_decode_is_bitwise_invisible(self, model, params,
+                                                   baseline):
+        """The tentpole contract: a replica dying mid-decode migrates
+        its in-flight requests, and the final token streams are
+        IDENTICAL to the undisturbed single-engine run."""
+        crashy = _Crashy(ServeEngine(model, params, **GEOM), crash_at=4)
+        other = ServeEngine(model, params, **GEOM)
+        router = Router([crashy, other], probe_backoff_ms=10_000.0)
+        hs = _submit_mixed(router)
+        with pytest.warns(UserWarning, match="marked unhealthy"):
+            router.run()
+        assert all(h.done for h in hs)
+        assert [list(h.tokens) for h in hs] == baseline
+        st = router.stats()
+        assert st["failovers"] == 1
+        assert st["migrated"] + st["retried"] >= 1
+        assert router.accounting_ok()
+
+    def test_backoff_probe_readmits_the_replica(self, model, params,
+                                                baseline):
+        crashy = _Crashy(ServeEngine(model, params, **GEOM), crash_at=2)
+        other = ServeEngine(model, params, **GEOM)
+        router = Router([crashy, other], probe_backoff_ms=1.0)
+        hs = _submit_mixed(router)
+        with pytest.warns(UserWarning, match="marked unhealthy"):
+            router.run()
+        assert [list(h.tokens) for h in hs] == baseline
+        # The 1ms backoff elapses inside the run: the probe step
+        # succeeds (the crash is one-shot) and the replica rejoins.
+        assert router.stats()["readmitted"] == 1
+        assert all(h.healthy for h in router.health)
+        # The re-admitted replica serves new traffic bitwise-correctly.
+        hs2 = _submit_mixed(router)
+        router.run()
+        assert [list(h.tokens) for h in hs2] == baseline
+        assert router.accounting_ok()
+
+    def test_whole_fleet_dark_holds_then_replays(self, model, params,
+                                                 baseline):
+        crashy = _Crashy(ServeEngine(model, params, **GEOM), crash_at=1)
+        router = Router([crashy], probe_backoff_ms=1.0)
+        with pytest.warns(UserWarning, match="marked unhealthy"):
+            router.step()                      # kill the only replica
+        hs = _submit_mixed(router)             # fleet dark: held
+        assert router.stats()["pending"] == 4
+        router.run()
+        assert all(h.done for h in hs)
+        assert [list(h.tokens) for h in hs] == baseline
+        assert router.accounting_ok()
+
+    def test_retry_budget_exhaustion_sheds(self, model, params):
+        crashy = _Crashy(ServeEngine(model, params, **GEOM), crash_at=4)
+        router = Router([crashy], retry_budget=0,
+                        probe_backoff_ms=1.0)
+        hs = _submit_mixed(router)
+        with pytest.warns(UserWarning, match="marked unhealthy"):
+            router.run()
+        assert all(h.done for h in hs)
+        shed = [h for h in hs if h.shed]
+        assert shed and router.stats()["shed"] == len(shed)
+        done = sum(not h.shed and not h.cancelled for h in hs)
+        assert done + len(shed) == len(hs)     # the identity
+        assert router.accounting_ok()
+
+
+class TestCancelDuringMigration:
+    def test_cancel_in_pending_queue_never_resurrects(self, model,
+                                                      params):
+        """The regression the satellite pins: cancelling a request
+        parked in the retry queue (its pages already freed by the
+        failover drain) must neither resurrect it at the next resubmit
+        nor double-free anything."""
+        crashy = _Crashy(ServeEngine(model, params, **GEOM), crash_at=3)
+        other = _Crashy(ServeEngine(model, params, **GEOM), crash_at=3)
+        router = Router([crashy, other], probe_backoff_ms=1.0)
+        hs = _submit_mixed(router)
+        with pytest.warns(UserWarning, match="marked unhealthy"):
+            while not router.stats()["pending"]:
+                router.step()                  # both replicas die
+        victim = next(h for h in hs
+                      if any(p is h for p in router._pending))
+        assert router.cancel(victim) is True
+        assert victim.cancelled and victim.done
+        ntoks = len(victim.tokens)
+        router.run()                           # replays the survivors
+        assert all(h.done for h in hs)
+        assert not any(h is victim for _, c, _, _
+                       in router._migrating.values()
+                       for h in (c,))          # never resubmitted
+        assert len(victim.tokens) == ntoks     # no zombie tokens
+        # Double-cancel is a no-op, and pool accounting still balances
+        # on every replica (a double-free would throw or break it).
+        assert router.cancel(victim) is False
+        assert router.accounting_ok()
+        done = sum(not h.cancelled for h in hs)
+        assert done + 1 == len(hs)
+
+    def test_cancel_of_migrating_continuation(self, model, params):
+        crashy = _Crashy(ServeEngine(model, params, **GEOM), crash_at=4)
+        other = ServeEngine(model, params, **GEOM)
+        router = Router([crashy, other], probe_backoff_ms=10_000.0)
+        hs = _submit_mixed(router)
+        with pytest.warns(UserWarning, match="marked unhealthy"):
+            while not router._migrating:
+                router.step()
+        victim = next(h for h in hs if id(h) in router._migrating)
+        assert router.cancel(victim) is True
+        assert victim.cancelled and id(victim) not in router._migrating
+        router.run()
+        assert all(h.done for h in hs)
+        assert router.accounting_ok()
+
+
+class TestDegradedDisagg:
+    def test_edge_drop_falls_back_to_local_prefill(self, model, params,
+                                                   baseline,
+                                                   monkeypatch):
+        monkeypatch.setenv("TPU_DDP_CHAOS_FAULTS", "edge-drop@2")
+        fleet = DisaggEngine(model, params, **GEOM)
+        assert fleet.chaos is not None
+        hs = _submit_mixed(fleet)
+        with pytest.warns(UserWarning, match="lost on the edge"):
+            fleet.run()
+        assert all(h.done for h in hs)
+        assert [list(h.tokens) for h in hs] == baseline
+        assert fleet.metrics.counters.get("fleet_edge_failures") == 1
+        assert fleet.edge.dropped == 1
+        assert fleet.accounting_ok()
+
+    def test_prefill_death_degrades_engine_to_local(self, model,
+                                                    params, baseline):
+        fleet = DisaggEngine(model, params, **GEOM)
+        calls = {"n": 0}
+        orig = fleet._prefill
+
+        def dying(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("prefill worker died")
+            return orig(*a, **kw)
+
+        fleet._prefill = dying
+        hs = _submit_mixed(fleet)
+        with pytest.warns(UserWarning,
+                          match="falling back to local chunked"):
+            fleet.run()
+        assert fleet.prefill_degraded
+        assert all(h.done for h in hs)
+        assert [list(h.tokens) for h in hs] == baseline
+        assert fleet.accounting_ok()
+        # Degraded mode is sticky: later submits take the local path
+        # and still match the reference bitwise.
+        hs2 = _submit_mixed(fleet)
+        fleet.run()
+        assert [list(h.tokens) for h in hs2] == baseline
+        assert fleet.accounting_ok()
+
+
+class TestQuarantine:
+    def test_poisoned_request_is_quarantined_not_the_batch(
+            self, model, params, baseline, monkeypatch):
+        """The decode analog of StepGuard: NaN'd KV pages make exactly
+        one request's logits non-finite; the in-graph finiteness mask
+        quarantines THAT request while its batchmates keep their
+        bitwise-exact streams."""
+        monkeypatch.setenv("TPU_DDP_CHAOS_FAULTS", "nonfinite-logits@6")
+        eng = ServeEngine(model, params, **GEOM)
+        hs = _submit_mixed(eng)
+        with pytest.warns(UserWarning, match="quarantin"):
+            eng.run()
+        assert all(h.done for h in hs)
+        bad = [h for h in hs if h.quarantined]
+        assert len(bad) == 1
+        assert [list(h.tokens) for h in hs if not h.quarantined] \
+            == [b for h, b in zip(hs, baseline) if not h.quarantined]
+        assert eng.metrics.counters.get("serve_quarantined") == 1
+        assert eng.accounting_ok()
+        # The poisoned pages were scrubbed before refill: reusing the
+        # pool must produce finite, bitwise-correct streams.
+        monkeypatch.delenv("TPU_DDP_CHAOS_FAULTS")
+        hs2 = _submit_mixed(eng)
+        eng.run()
+        assert [list(h.tokens) for h in hs2] == baseline
+
+
+class TestLoadShedding:
+    def test_queue_limit_sheds_at_the_door(self, model, params):
+        eng = ServeEngine(model, params, queue_limit=1, **GEOM)
+        hs = _submit_mixed(eng)
+        hs += [eng.submit(_prompt(6, seed=9), 4, seed=9)
+               for _ in range(4)]
+        eng.run()
+        n_shed = sum(h.shed for h in hs)
+        n_done = sum(h.done and not h.shed for h in hs)
+        assert n_shed >= 1
+        for h in hs:
+            if h.shed:
+                assert h.done and not h.tokens
+        assert n_shed + n_done == len(hs)      # the identity
+        assert eng.metrics.counters.get("serve_shed") == n_shed
+        assert eng.accounting_ok()
+
+    def test_deadline_shed_drops_stale_queue_entries(self, model,
+                                                     params):
+        clockbox = {"t": 0.0}
+        eng = ServeEngine(model, params, shed_ms=50.0, **GEOM)
+        hs = [eng.submit(_prompt(5, seed=s), 3, seed=s)
+              for s in range(8)]
+        # Age the queued (not yet prefilled) tail past the deadline.
+        for h in hs:
+            if not h.tokens:
+                h.submitted_at -= 10.0
+        eng.run()
+        assert all(h.done for h in hs)
+        assert any(h.shed for h in hs)
+        assert sum(h.shed for h in hs) \
+            + sum(not h.shed and not h.cancelled for h in hs) == len(hs)
+        assert eng.accounting_ok()
+        del clockbox
+
+    def test_run_load_accounts_shed_honestly(self, model, params):
+        specs = make_workload(12, vocab_size=1024, seed=0,
+                              prompt_len=(4, 9), max_new=(3, 6))
+        eng = ServeEngine(model, params, queue_limit=1, **GEOM)
+        m = run_load(eng, specs, rate=10_000.0, seed=1,
+                     slo_ttft_ms=50.0)
+        assert m["accounting_ok"]
+        assert m["n_completed"] + m["n_cancelled"] + m["n_shed"] \
+            == m["n_requests"]
+        assert m["n_shed"] >= 1
+        # Goodput and percentiles are over completed requests only; a
+        # 100%-shed run must report None, not crash.
+        assert m["total_tokens"] >= 0
+
+    def test_negative_knobs_rejected(self, model, params):
+        with pytest.raises(ValueError, match="queue_limit"):
+            ServeEngine(model, params, queue_limit=-1, **GEOM)
+        with pytest.raises(ValueError, match="shed_ms"):
+            ServeEngine(model, params, shed_ms=-0.5, **GEOM)
+
+
+class TestChaosSpecs:
+    def test_serve_kinds_parse_and_train_kinds_ignored(self,
+                                                       monkeypatch):
+        from tpu_ddp.resilience.chaos import SERVE_FAULT_KINDS, FaultSpec
+        for kind in SERVE_FAULT_KINDS:
+            FaultSpec(kind=kind, step=3)
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultSpec(kind="replica-typo", step=3)
+        # A mixed train+serve spec string: the serve injector ignores
+        # the training kind entirely.
+        from tpu_ddp.fleet.resilience import ServeFaultInjector
+        monkeypatch.setenv("TPU_DDP_CHAOS_FAULTS",
+                           "nan-grad@3,replica-crash@5:rank=1")
+        inj = ServeFaultInjector.from_env()
+        inj.set_rank(0)
+        for s in range(1, 10):
+            inj.replica_step(s)               # rank mismatch: no fire
+        inj.set_rank(1)
+        with pytest.raises(ReplicaCrashError):
+            inj.replica_step(5)
+
+    def test_crash_is_one_shot_as_steps_advance(self, monkeypatch):
+        # One-shot comes from the exact step match: the engine's step
+        # counter keeps advancing through the crash, so the probe that
+        # re-admits the replica (a LATER step) never re-fires it.
+        from tpu_ddp.fleet.resilience import ServeFaultInjector
+        monkeypatch.setenv("TPU_DDP_CHAOS_FAULTS", "replica-crash@2")
+        inj = ServeFaultInjector.from_env()
+        with pytest.raises(ReplicaCrashError):
+            inj.replica_step(2)
+        for s in range(3, 8):
+            inj.replica_step(s)               # silent forever after
+
+
+class TestKnobSurfaces:
+    @pytest.mark.parametrize("env,junk", [
+        ("TPU_DDP_FLEET_HEALTH_BACKOFF_MS", "fast"),
+        ("TPU_DDP_FLEET_HEALTH_BACKOFF_MS", "0"),      # must be > 0
+        ("TPU_DDP_FLEET_HEALTH_DEADLINE_MS", "soon"),
+        ("TPU_DDP_FLEET_HEALTH_DEADLINE_MS", "-1"),
+        ("TPU_DDP_FLEET_RETRY_BUDGET", "many"),
+        ("TPU_DDP_FLEET_RETRY_BUDGET", "-2"),
+        ("TPU_DDP_SERVE_QUEUE_LIMIT", "big"),
+        ("TPU_DDP_SERVE_QUEUE_LIMIT", "-1"),
+        ("TPU_DDP_SERVE_SHED_MS", "never"),
+        ("TPU_DDP_SERVE_SHED_MS", "-3"),
+    ])
+    def test_env_surface_rejects_junk(self, env, junk, monkeypatch):
+        from tpu_ddp.utils.config import TrainConfig
+        monkeypatch.setenv(env, junk)
+        with pytest.raises(ValueError, match=env):
+            TrainConfig()
+
+    def test_env_surface_parses_good_values(self, monkeypatch):
+        from tpu_ddp.utils.config import TrainConfig
+        monkeypatch.setenv("TPU_DDP_FLEET_HEALTH", "0")
+        monkeypatch.setenv("TPU_DDP_FLEET_HEALTH_BACKOFF_MS", "50")
+        monkeypatch.setenv("TPU_DDP_FLEET_HEALTH_DEADLINE_MS", "250")
+        monkeypatch.setenv("TPU_DDP_FLEET_RETRY_BUDGET", "1")
+        monkeypatch.setenv("TPU_DDP_SERVE_QUEUE_LIMIT", "64")
+        monkeypatch.setenv("TPU_DDP_SERVE_SHED_MS", "100")
+        cfg = TrainConfig()
+        assert cfg.fleet_health is False
+        assert cfg.fleet_probe_backoff_ms == 50.0
+        assert cfg.fleet_step_deadline_ms == 250.0
+        assert cfg.fleet_retry_budget == 1
+        assert cfg.serve_queue_limit == 64
+        assert cfg.serve_shed_ms == 100.0
+
+    def test_router_reads_config_knobs(self, model, params,
+                                        monkeypatch):
+        monkeypatch.setenv("TPU_DDP_FLEET_HEALTH", "0")
+        router = Router([ServeEngine(model, params, **GEOM)])
+        assert router.health_enabled is False
+        # Health off = fail-fast: the exception propagates.
+        crashy = _Crashy(ServeEngine(model, params, **GEOM), crash_at=1)
+        router = Router([crashy], health=False)
+        crashy.engine.submit(_prompt(5), 2)
+        with pytest.raises(ReplicaCrashError):
+            router.run()

@@ -97,18 +97,31 @@ def test_serve_knobs_registered_under_goodput_objective():
 
     fields = {"serve_slots", "serve_block_size", "serve_prefill_chunk",
               "serve_cache_dtype", "fleet_roles", "prefix_cache",
-              "router_policy", "kv_wire"}
+              "router_policy", "kv_wire",
+              # Fleet-resilience knobs (DESIGN.md §23): health and
+              # migration in the Router, shedding in the engine.
+              "fleet_health", "fleet_probe_backoff_ms",
+              "fleet_step_deadline_ms", "fleet_retry_budget",
+              "serve_queue_limit", "serve_shed_ms"}
     for f in fields:
         k = knob_by_field(f)
         assert k is not None and k.objective == "goodput", f
     assert knob_by_field("serve_block_size").env == "TPU_DDP_SERVE_BLOCK"
     assert knob_by_field("kv_wire").env == "TPU_DDP_KV_WIRE"
+    assert (knob_by_field("fleet_probe_backoff_ms").env
+            == "TPU_DDP_FLEET_HEALTH_BACKOFF_MS")
     # Cache dtype and the lossy KV wire change numerics -> semantic,
     # like act_dtype; the pure-scheduling knobs must not be.
     assert knob_by_field("serve_cache_dtype").semantic
     assert knob_by_field("kv_wire").semantic
     assert not knob_by_field("serve_slots").semantic
     assert not knob_by_field("fleet_roles").semantic
+    # Resilience knobs never change what a healthy run computes —
+    # migration replay is bitwise (tests/test_fleet_resilience.py) —
+    # so none of them are semantic.
+    for f in ("fleet_health", "fleet_retry_budget", "serve_queue_limit",
+              "serve_shed_ms"):
+        assert not knob_by_field(f).semantic, f
     cfg, ctx = TrainConfig(), Workload(platform="cpu")
     good = {k.field for k, _ in
             searchable_knobs(cfg, ctx, objective="goodput",

@@ -122,9 +122,10 @@ def _vgg():
 
 class TestGuardedTrainer:
     @pytest.mark.parametrize("strategy,use_mesh", [
-        ("none", False), ("all_reduce", True),
-        # zero adds only the partitioned-optimizer layout on top of the
-        # guard logic the two fast variants already pin down.
+        ("none", False),
+        # the sharded variants add only layout on top of the guard logic
+        # the fast unsharded variant already pins down.
+        pytest.param("all_reduce", True, marks=pytest.mark.slow),
         pytest.param("zero", True, marks=pytest.mark.slow)])
     def test_nan_batch_is_exact_noop(self, devices, strategy, use_mesh):
         """A poisoned batch leaves params AND optimizer state bitwise

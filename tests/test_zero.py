@@ -236,6 +236,8 @@ class TestZeRO1ModelParallel:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow  # ep sharding is orthogonal to the zero1 flat
+    # partition; dp x tp stays fast and moe/adafactor pin ep itself
     def test_dp_ep_zero1_matches_replicated_opt(self, devices):
         """dp2 x ep2 MoE with zero1 == same mesh with replicated
         optimizer (expert leaves' ep-sum/dp-mean algebra preserved)."""
@@ -367,6 +369,8 @@ class TestZeRO1Pipeline:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow  # triple compose; pp x zero1 and dp x tp x zero1
+    # each stay fast, the tp leg adds no new partition logic
     def test_pp_zero1_tp_matches_replicated_opt(self, devices):
         """dp2 x pp2 x tp2 (round-4: the multi-axis partition): stacked
         tp leaves' optimizer state lays out P((pp, mp, dp)) — 1/8 per

@@ -1,10 +1,17 @@
 """Serving fleet: prefill/decode disaggregation over an explicit KV
 edge (disagg.py), refcounted prefix caching over the paged pool
-(prefix.py), and a multi-replica router (router.py). docs/DESIGN.md
-§21."""
+(prefix.py), a multi-replica router (router.py), and the fleet
+resilience layer — replica health, deterministic request migration,
+and serve-side chaos (resilience.py). docs/DESIGN.md §21, §23."""
 
 from tpu_ddp.fleet.disagg import DisaggEngine, KVEdge, KVTransfer
 from tpu_ddp.fleet.prefix import PrefixHit, PrefixIndex
+from tpu_ddp.fleet.resilience import (
+    ReplicaCrashError,
+    ReplicaHealth,
+    ServeFaultInjector,
+    continuation_of,
+)
 from tpu_ddp.fleet.router import POLICIES, Router
 
 __all__ = [
@@ -14,5 +21,9 @@ __all__ = [
     "PrefixHit",
     "PrefixIndex",
     "POLICIES",
+    "ReplicaCrashError",
+    "ReplicaHealth",
     "Router",
+    "ServeFaultInjector",
+    "continuation_of",
 ]

@@ -35,6 +35,18 @@ Sampling stays stateless-keyed by (seed, position) on both sides, so
 any role split reproduces the single engine's tokens bitwise with
 ``kv_wire="none"`` — the parity acceptance criterion. Lossy wires
 round the shipped KV and are gated as semantic, like cache dtype.
+
+Degraded mode (docs/DESIGN.md §23): a transfer lost on the edge, or
+the prefill worker dying outright, must not wedge the pipeline. The
+decode worker owns a one-slot fallback scheduler (``dsched``) over its
+OWN pool and re-runs the lost request's prefill locally, chunked, with
+the same jitted prefill program at the decode pool's shapes — single-
+engine semantics, already bitwise-pinned, so degraded output equals
+healthy output token for token (recomputed KV is recomputed, not
+migrated). Prefill-worker death flips ``prefill_degraded``: every
+pending prompt (mid-prefill, queued, and in-flight edge transfers) is
+reaped and replayed locally, and later submits skip the dead role
+entirely. A warning marks each degradation; nothing is silently lost.
 """
 
 from __future__ import annotations
@@ -44,6 +56,7 @@ import functools
 import itertools
 import math
 import time
+import warnings
 from collections import deque
 from typing import Callable
 
@@ -170,6 +183,8 @@ class DisaggEngine:
                  cache_dtype: str | None = None,
                  kv_wire: str | None = None,
                  prefix_cache: bool | None = None,
+                 queue_limit: int | None = None,
+                 shed_ms: float | None = None,
                  metrics: MetricsLogger | None = None,
                  config=None):
         check_decodable(model)
@@ -215,6 +230,15 @@ class DisaggEngine:
             self.prefix = PrefixIndex(self.prefill_pool)
         self.psched = Scheduler(self.prefill_pool, 1, "continuous",
                                 prefix=self.prefix, role="prefill")
+        # Degraded-mode fallback: a one-slot scheduler over the DECODE
+        # pool that re-prefills requests whose edge transfer was lost
+        # or whose prefill worker died. It shares the decode pool, so
+        # the two schedulers are reservation peers — admitted-always-
+        # finish holds across both.
+        self.dsched = Scheduler(self.pool, 1, "continuous")
+        self.sched.peers = [self.dsched]
+        self.dsched.peers = [self.sched]
+        self.prefill_degraded = False
         self.edge = KVEdge(kv_wire if kv_wire is not None
                            else config.kv_wire)
         self.metrics = metrics if metrics is not None \
@@ -224,6 +248,20 @@ class DisaggEngine:
         self._adopt_decode = _build_adopt_decode_step(
             model, self.block_size, self.blocks_per_seq)
         self._rid = itertools.count()
+        self.queue_limit = int(queue_limit if queue_limit is not None
+                               else config.serve_queue_limit)
+        self.shed_ms = float(shed_ms if shed_ms is not None
+                             else config.serve_shed_ms)
+        if self.queue_limit < 0:
+            raise ValueError("queue_limit must be >= 0")
+        if self.shed_ms < 0:
+            raise ValueError("shed_ms must be >= 0")
+        self._step_n = 0
+        self.chaos = None
+        from tpu_ddp.fleet.resilience import (
+            ServeFaultInjector, serve_chaos_active)
+        if serve_chaos_active():
+            self.chaos = ServeFaultInjector.from_env()
 
     # ---- request lifecycle ---------------------------------------------
 
@@ -254,9 +292,38 @@ class DisaggEngine:
             raise ValueError(
                 f"request needs up to {dneed} decode KV blocks but "
                 f"the decode pool holds only {self.pool.total_usable}")
-        self.psched.enqueue(req)
         self.metrics.inc("serve_submitted")
+        qlen = len(self.dsched.queue) if self.prefill_degraded \
+            else len(self.psched.queue)
+        if self.queue_limit and qlen >= self.queue_limit:
+            self._shed(req)
+            return req
+        if self.prefill_degraded:
+            self.dsched.enqueue(req)  # the prefill role is gone
+        else:
+            self.psched.enqueue(req)
         return req
+
+    def _shed(self, req: Request) -> None:
+        req.shed = True
+        req.done = True
+        req.finished_at = time.perf_counter()
+        self.metrics.inc("serve_shed")
+
+    def _shed_expired(self) -> None:
+        """Deadline shedding over both admission queues. Only
+        requests that have not produced a token are sheddable — a
+        degraded-queue request replaying a lost transfer already
+        streamed its first token and must finish."""
+        if not self.shed_ms:
+            return
+        now = time.perf_counter()
+        for q in (self.psched.queue, self.dsched.queue):
+            expired = [r for r in q if not r.tokens
+                       and (now - r.submitted_at) * 1e3 > self.shed_ms]
+            for r in expired:
+                q.remove(r)
+                self._shed(r)
 
     def cancel(self, req: Request) -> bool:
         """Drop a request anywhere in the pipeline: queued, mid-
@@ -268,8 +335,10 @@ class DisaggEngine:
             pass
         elif req in self.psched.queue:
             self.psched.queue.remove(req)
+        elif req in self.dsched.queue:
+            self.dsched.queue.remove(req)
         else:
-            for sched in (self.psched, self.sched):
+            for sched in (self.psched, self.dsched, self.sched):
                 hit = False
                 for i, s in enumerate(sched.slots):
                     if s is not None and s.request is req:
@@ -289,14 +358,31 @@ class DisaggEngine:
     # ---- the iteration -------------------------------------------------
 
     def step(self) -> bool:
-        """One fleet iteration: each role advances once."""
-        admitted = self.psched.admit()
+        """One fleet iteration: each role advances once. Degraded
+        requests (lost transfer / dead prefill worker) re-prefill on
+        the decode worker, one chunk per step, yielding to healthy
+        prefill traffic when both exist."""
+        self._step_n += 1
+        if self.chaos is not None:
+            # May raise ReplicaCrashError — before any state mutation.
+            self.chaos.replica_step(self._step_n)
+        self._shed_expired()
+        admitted = list(self.psched.admit())
+        self._promote_degraded()
+        admitted += self.dsched.admit()
         did = False
 
         pi = self.psched.prefill_slot()
+        di = self.dsched.prefill_slot()
         if pi is not None:
             did = True
-            self._run_prefill_chunk(pi)
+            try:
+                self._run_prefill_chunk(pi)
+            except Exception as e:  # noqa: BLE001 — degrade, don't wedge
+                self._fail_prefill(e)
+        elif di is not None:
+            did = True
+            self._run_degraded_chunk(di)
 
         transfer = self._pop_adoptable()
         dslots = self.sched.decode_slots()
@@ -308,10 +394,11 @@ class DisaggEngine:
             self._run_decode_step(dslots)
 
         self.metrics.observe("serve_queue_depth",
-                             len(self.psched.queue))
+                             len(self.psched.queue)
+                             + len(self.dsched.queue))
         self.metrics.observe("serve_slot_occupancy",
                              self.sched.live / self.num_slots)
-        return did or bool(admitted)
+        return did or bool(admitted) or self.dsched.live > 0
 
     def run(self, max_steps: int | None = None) -> int:
         n = 0
@@ -325,11 +412,12 @@ class DisaggEngine:
 
     def outstanding(self) -> int:
         w = 0
-        for r in self.psched.queue:
-            w += len(r.prompt) + r.max_new_tokens
+        for q in (self.psched.queue, self.dsched.queue):
+            for r in q:
+                w += len(r.prompt) + r.max_new_tokens - len(r.tokens)
         for t in self.edge.queue:
             w += t.request.max_new_tokens - len(t.request.tokens)
-        for sched in (self.psched, self.sched):
+        for sched in (self.psched, self.dsched, self.sched):
             for s in sched.slots:
                 if s is not None:
                     w += (len(s.request.prompt) - s.prefill_done) \
@@ -414,20 +502,116 @@ class DisaggEngine:
             req.finished_at = now
             self.metrics.inc("serve_retired")
 
+    # ---- degraded mode -------------------------------------------------
+
+    def _degrade(self, req: Request) -> None:
+        """Queue ``req`` for local re-prefill on the decode worker."""
+        self.dsched.enqueue(req)
+        self.metrics.inc("fleet_degraded")
+
+    def _fail_prefill(self, exc: Exception) -> None:
+        """The prefill worker died mid-chunk: reap EVERYTHING it owned
+        — its slot, its queue, and every transfer still on the edge —
+        and replay all of it through local chunked prefill. The
+        prefill pool (and the prefix index rooted in it) dies with the
+        worker; later submits route straight to the fallback."""
+        warnings.warn(
+            f"prefill worker failed ({type(exc).__name__}: {exc}); "
+            "falling back to local chunked prefill on the decode "
+            "worker", stacklevel=3)
+        self.prefill_degraded = True
+        self.metrics.inc("fleet_prefill_failures")
+        harvested = []
+        for i, s in enumerate(self.psched.slots):
+            if s is not None:
+                harvested.append(s.request)
+                self.psched.retire(i)  # host bookkeeping; pool is dead
+        harvested.extend(self.psched.queue)
+        self.psched.queue.clear()
+        while self.edge.queue:  # reap pending-edge state
+            t = self.edge.queue.popleft()
+            self.edge.dropped += 1
+            harvested.append(t.request)
+        self.prefix = None  # rooted in the dead prefill pool
+        for req in sorted(harvested, key=lambda r: r.rid):
+            if not req.done:
+                self._degrade(req)
+
+    def _run_degraded_chunk(self, di: int) -> None:
+        """One local prefill chunk against the DECODE pool — the same
+        jitted prefill program at the decode pool's shapes, so the
+        recomputed KV (and the stateless-sampled first token) is
+        bitwise what the healthy path would have produced."""
+        s = self.dsched.slots[di]
+        req = s.request
+        start, C = s.prefill_done, self.prefill_chunk
+        chunk = np.zeros((1, C), np.int32)
+        piece = req.prompt[start:start + C]
+        chunk[0, :piece.size] = piece
+        k, v, tok, lp = self._prefill(
+            self.params, self.pool.k, self.pool.v,
+            jnp.asarray(self._table_for(s)), jnp.asarray(chunk),
+            jnp.int32(start), jnp.int32(req.prompt.size),
+            jnp.float32(req.temperature), jnp.int32(req.seed))
+        self.pool.commit(k, v)
+        s.prefill_done = min(start + C, int(req.prompt.size))
+        s.length = s.prefill_done
+        if s.prefill_done >= req.prompt.size:
+            if not req.tokens:
+                # Prefill-death replay: the first token was never
+                # emitted — emit it now (TTFT is prefill completion).
+                self._emit_first(req, int(tok), float(lp))
+            # else: edge-drop replay — the first token already
+            # streamed at _ship time; the recomputed sample is
+            # bitwise identical (stateless (seed, position) keying)
+            # and is dropped, never double-emitted.
+            s.phase = "decode"
+            s.generated = len(req.tokens)
+            s.pending_token = req.tokens[-1] if req.tokens else int(tok)
+            if req.done:  # max_new_tokens == 1 or instant EOS
+                self.dsched.retire(di)
+
+    def _promote_degraded(self) -> None:
+        """Hand a locally re-prefilled sequence to the decode
+        scheduler as soon as it has a free slot: ownership of the
+        blocks transfers (both schedulers draw on the decode pool),
+        and the slot starts in the decode phase exactly like an
+        adopted transfer."""
+        for i, s in enumerate(self.dsched.slots):
+            if s is not None and s.phase == "decode" \
+                    and self.sched.live < self.num_slots:
+                st = self.dsched.release(i)
+                self.sched.place(st.request, st.blocks, st.length,
+                                 st.pending_token)
+                self.metrics.inc("fleet_degraded_promoted")
+
     # ---- decode role ---------------------------------------------------
 
     def _pop_adoptable(self) -> KVTransfer | None:
         """FIFO edge delivery, gated by the decode scheduler's
-        reservation rule (a free slot AND the full worst case fits)."""
+        reservation rule (a free slot AND the full worst case fits).
+        A transfer lost in flight (the ``edge-drop`` chaos drill)
+        degrades to local re-prefill instead of vanishing."""
         if not self.edge.queue:
             return None
         if self.sched.live >= self.num_slots:
             return None
         t = self.edge.queue[0]
         need = self.sched.worst_case_blocks(t.request)
-        if need > self.pool.allocatable - self.sched.reserved_unallocated:
+        if need > self.sched.pool_budget:
             return None
-        return self.edge.pop()
+        t = self.edge.pop()
+        if self.chaos is not None \
+                and self.chaos.edge_drop_fires(self.edge.delivered):
+            warnings.warn(
+                f"KV transfer for request {t.request.rid} lost on the "
+                "edge; re-prefilling locally on the decode worker",
+                stacklevel=3)
+            self.edge.dropped += 1
+            self.metrics.inc("fleet_edge_failures")
+            self._degrade(t.request)
+            return None
+        return t
 
     def _land(self, t: KVTransfer, dslots: list) -> None:
         """Adopt a transfer's blocks into the decode pool — fused into
@@ -440,11 +624,12 @@ class DisaggEngine:
         if dslots:
             tables, lengths, last, temps, seeds = \
                 self._bank_inputs(dslots)
-            k, v, toks, lps = self._adopt_decode(
+            self._maybe_poison(dslots)
+            k, v, toks, lps, bad = self._adopt_decode(
                 self.params, self.pool.k, self.pool.v, adopt_ids,
                 ak, av, tables, lengths, last, temps, seeds)
             self.pool.commit(k, v)
-            self._emit_bank(dslots, toks, lps)
+            self._emit_bank(dslots, toks, lps, bad)
         else:
             self.pool.commit(
                 self.pool.k.at[:, adopt_ids].set(
@@ -473,22 +658,53 @@ class DisaggEngine:
                 jnp.asarray(last), jnp.asarray(temps),
                 jnp.asarray(seeds))
 
+    def _maybe_poison(self, dslots: list) -> None:
+        """The ``nonfinite-logits`` drill on the disagg decode worker
+        (see ServeEngine._maybe_poison): NaN one live request's
+        private last KV block host-side."""
+        if self.chaos is None or not dslots \
+                or not self.chaos.poison_fires(self._step_n):
+            return
+        s = self.sched.slots[dslots[0]]
+        blk = s.blocks[-1]
+        self.pool.v = self.pool.v.at[:, blk].set(jnp.nan)
+
     def _run_decode_step(self, dslots: list) -> None:
         from tpu_ddp.serve.engine import _build_decode_step
         tables, lengths, last, temps, seeds = self._bank_inputs(dslots)
+        self._maybe_poison(dslots)
         step = _build_decode_step(self.model, self.block_size,
                                   self.blocks_per_seq)
-        k, v, toks, lps = step(self.params, self.pool.k, self.pool.v,
-                               tables, lengths, last, temps, seeds)
+        k, v, toks, lps, bad = step(
+            self.params, self.pool.k, self.pool.v,
+            tables, lengths, last, temps, seeds)
         self.pool.commit(k, v)
-        self._emit_bank(dslots, toks, lps)
+        self._emit_bank(dslots, toks, lps, bad)
 
-    def _emit_bank(self, dslots: list, toks, lps) -> None:
+    def _emit_bank(self, dslots: list, toks, lps, bad) -> None:
         toks, lps = np.asarray(toks), np.asarray(lps)
+        bad = np.asarray(bad)
         for i in dslots:
             s = self.sched.slots[i]
-            s.length += 1
             req = s.request
+            if bad[i]:
+                # Quarantine the poisoned request, not the bank:
+                # scrub its private pages (a NaN'd page re-issued to
+                # another request would leak through zero-weight
+                # attention) and finish it flagged.
+                self.pool.scrub([b for b in s.blocks
+                                 if self.pool.refcount(b) == 1])
+                self.sched.retire(i)
+                req.quarantined = True
+                req.done = True
+                req.finished_at = time.perf_counter()
+                self.metrics.inc("serve_quarantined")
+                warnings.warn(
+                    f"request {req.rid}: non-finite logits at engine "
+                    f"step {self._step_n}; request quarantined",
+                    stacklevel=3)
+                continue
+            s.length += 1
             tok = int(toks[i])
             s.generated += 1
             s.pending_token = tok
@@ -506,8 +722,38 @@ class DisaggEngine:
     # ---- introspection -------------------------------------------------
 
     def accounting_ok(self) -> bool:
-        return (self.sched.accounting_ok()
-                and self.psched.accounting_ok())
+        # The decode pool has TWO schedulers drawing on it (sched +
+        # the degraded-prefill fallback), so its identity is checked
+        # over their joint holders. The prefill pool's check is
+        # skipped once its worker died — that hardware (and its
+        # accounting) is gone from the system.
+        holders = [s.blocks for s in self.sched.slots if s is not None]
+        holders += [s.blocks for s in self.dsched.slots
+                    if s is not None]
+        if not self.pool.refcount_ok(holders):
+            return False
+        return self.prefill_degraded or self.psched.accounting_ok()
+
+    def drain(self) -> list[Request]:
+        """Harvest every unfinished request from the whole pipeline
+        (queues, prefill slot, edge, fallback, decode slots) and
+        release all engine state — the router's failure-migration
+        hook. Submit order."""
+        reqs = list(self.psched.queue)
+        self.psched.queue.clear()
+        reqs.extend(self.dsched.queue)
+        self.dsched.queue.clear()
+        for sched in (self.psched, self.dsched, self.sched):
+            for i, s in enumerate(sched.slots):
+                if s is not None:
+                    reqs.append(s.request)
+                    sched.retire(i)
+        while self.edge.queue:
+            t = self.edge.queue.popleft()
+            self.edge.dropped += 1
+            reqs.append(t.request)
+        return sorted((r for r in reqs if not r.done),
+                      key=lambda r: r.rid)
 
     def lower_adopt_decode(self, n_blocks: int = 2):
         """``jit.lower`` the fused adopt+decode program for a
@@ -532,6 +778,19 @@ class DisaggEngine:
         """Compiled HLO of the fused adopt+decode program — what
         ``tpu_ddp/analysis`` (assert_transfer_overlap) scans."""
         return self.lower_adopt_decode(n_blocks).compile().as_text()
+
+    def lower_degraded_prefill(self):
+        """``jit.lower`` the degraded-mode local prefill: the SAME
+        prefill program traced at the DECODE pool's shapes (more
+        blocks than the prefill pool), i.e. a distinct compiled
+        program — the graph-audit cell for the fallback path."""
+        sds = jax.ShapeDtypeStruct
+        return self._prefill.lower(
+            self.params, self.pool.k, self.pool.v,
+            sds((self.blocks_per_seq,), jnp.int32),
+            sds((1, self.prefill_chunk), jnp.int32),
+            sds((), jnp.int32), sds((), jnp.int32),
+            sds((), jnp.float32), sds((), jnp.int32))
 
 
 __all__ = ["DisaggEngine", "KVEdge", "KVTransfer"]

@@ -2,11 +2,11 @@
 
 A fleet is N independent replicas (each a ``ServeEngine`` or
 ``DisaggEngine`` — the router is duck-typed over ``submit`` /
-``cancel`` / ``step`` plus the two router hooks ``outstanding()`` and
-``prefix_cached_len()``), and the router is the ONLY stateful thing
-above them: it picks a replica per request, remembers the assignment
-for ``cancel``, and fans ``step()`` across the fleet so ``run_load``
-drives a whole fleet exactly like one engine.
+``cancel`` / ``step`` plus the router hooks ``outstanding()``,
+``prefix_cached_len()``, and ``drain()``), and the router is the ONLY
+stateful thing above them: it picks a replica per request, remembers
+the assignment for ``cancel``, and fans ``step()`` across the fleet so
+``run_load`` drives a whole fleet exactly like one engine.
 
 Two policies (``TPU_DDP_ROUTER_POLICY``, tune/space.py "goodput"):
 
@@ -28,9 +28,31 @@ router only honors affinity while the favored replica's backlog stays
 within ``affinity_slack`` tokens of the least-loaded replica's;
 past that it falls back to least-loaded (cache hits are cheap to
 re-earn, head-of-line blocking is not).
+
+Fleet resilience (docs/DESIGN.md §23, ``TPU_DDP_FLEET_HEALTH``): every
+replica call is wrapped. A replica that raises out of ``step()`` (or
+overruns ``TPU_DDP_FLEET_HEALTH_DEADLINE_MS``) is marked unhealthy,
+its unfinished requests are harvested via ``drain()`` and replayed on
+survivors from ``prompt + tokens_so_far`` — bitwise identical to the
+undisturbed run, because sampling is stateless keyed on
+``fold_in(seed, position)``. Re-admission is by exponential-backoff
+probe (``TPU_DDP_FLEET_HEALTH_BACKOFF_MS``); a request that has
+already been replayed ``TPU_DDP_FLEET_RETRY_BUDGET`` times is shed
+rather than bounced forever. The accounting identity the chaos drills
+pin: ``completed + cancelled + shed == submitted`` — no request is
+ever lost, resurrected after cancel, or double-freed.
 """
 
 from __future__ import annotations
+
+import time
+import warnings
+from collections import deque
+
+import numpy as np
+
+from tpu_ddp.fleet.resilience import ReplicaHealth, continuation_of
+from tpu_ddp.serve.engine import Request
 
 POLICIES = ("least-loaded", "prefix-affinity")
 
@@ -39,7 +61,11 @@ class Router:
     """Front-end over a list of replicas; same surface as one engine."""
 
     def __init__(self, replicas, policy: str | None = None,
-                 affinity_slack: int = 256, config=None):
+                 affinity_slack: int = 256, health: bool | None = None,
+                 retry_budget: int | None = None,
+                 probe_backoff_ms: float | None = None,
+                 step_deadline_ms: float | None = None,
+                 clock=time.monotonic, config=None):
         if not replicas:
             raise ValueError("Router needs at least one replica")
         if config is None:
@@ -55,25 +81,86 @@ class Router:
         self.routed = [0] * len(self.replicas)
         self.affinity_hits = 0      # routed BY cached prefix (> 0 tokens)
         self._owner: dict[int, int] = {}   # id(request) -> replica index
+        # ---- health + migration state ----
+        self.health_enabled = bool(
+            health if health is not None else config.fleet_health)
+        self.retry_budget = int(
+            retry_budget if retry_budget is not None
+            else config.fleet_retry_budget)
+        backoff_ms = float(
+            probe_backoff_ms if probe_backoff_ms is not None
+            else config.fleet_probe_backoff_ms)
+        self.step_deadline_ms = float(
+            step_deadline_ms if step_deadline_ms is not None
+            else config.fleet_step_deadline_ms)
+        if self.retry_budget < 0:
+            raise ValueError("retry_budget must be >= 0")
+        if self.step_deadline_ms < 0:
+            raise ValueError("step_deadline_ms must be >= 0")
+        self._clock = clock
+        self.health = [ReplicaHealth(backoff_s=backoff_ms / 1e3,
+                                     clock=clock)
+                       for _ in self.replicas]
+        # Requests harvested off a failed replica, awaiting replay.
+        self._pending: deque = deque()
+        # id(original) -> [original, continuation, replica idx, synced]
+        self._migrating: dict[int, list] = {}
+        self._cont_to_orig: dict[int, Request] = {}
+        self._rid = -1  # router-issued rids are negative (no clash)
+        self.failovers = 0
+        self.readmitted = 0
+        self.migrated = 0   # replays that carried tokens already
+        self.retried = 0    # replays that had not produced a token
+        self.shed = 0       # retry budget exhausted
+        # Stamp each replica's chaos injector with its index so
+        # ``:rank=R`` fault specs target one replica of the fleet.
+        for i, r in enumerate(self.replicas):
+            ch = getattr(r, "chaos", None)
+            if ch is not None and hasattr(ch, "set_rank"):
+                ch.set_rank(i)
 
     # ---- placement -----------------------------------------------------
 
+    def _candidates(self) -> list[int]:
+        idxs = [i for i in range(len(self.replicas))
+                if self.health[i].healthy]
+        return idxs or list(range(len(self.replicas)))
+
     def pick(self, prompt) -> int:
         """The replica index ``submit`` would use for ``prompt`` —
-        split out so tests can interrogate placement decisions."""
-        loads = [r.outstanding() for r in self.replicas]
-        least = min(range(len(loads)), key=lambda i: (loads[i], i))
+        split out so tests can interrogate placement decisions.
+        Unhealthy replicas are never picked while a healthy one
+        exists."""
+        cand = self._candidates()
+        loads = {i: self.replicas[i].outstanding() for i in cand}
+        least = min(cand, key=lambda i: (loads[i], i))
         if self.policy == "least-loaded":
             return least
-        cached = [r.prefix_cached_len(prompt) for r in self.replicas]
-        best = max(range(len(cached)),
-                   key=lambda i: (cached[i], -loads[i], -i))
+        cached = {i: self.replicas[i].prefix_cached_len(prompt)
+                  for i in cand}
+        best = max(cand, key=lambda i: (cached[i], -loads[i], -i))
         if cached[best] > 0 and \
                 loads[best] - loads[least] <= self.affinity_slack:
             return best
         return least
 
     def submit(self, prompt, max_new_tokens: int, **kw):
+        if self.health_enabled and \
+                not any(h.healthy for h in self.health):
+            # Whole fleet dark: hold the request at the router and
+            # replay it the moment a probe re-admits a replica.
+            req = Request(rid=self._rid,
+                          prompt=np.asarray(prompt,
+                                            np.int32).reshape(-1),
+                          max_new_tokens=int(max_new_tokens),
+                          temperature=float(kw.get("temperature", 0.0)),
+                          seed=int(kw.get("seed", 0)),
+                          eos_id=kw.get("eos_id"),
+                          on_token=kw.get("on_token"),
+                          submitted_at=time.perf_counter())
+            self._rid -= 1
+            self._pending.append(req)
+            return req
         i = self.pick(prompt)
         if self.policy == "prefix-affinity" and \
                 self.replicas[i].prefix_cached_len(prompt) > 0:
@@ -84,18 +171,188 @@ class Router:
         return req
 
     def cancel(self, req) -> bool:
+        # A request parked in the retry/migration machinery owns no
+        # replica state under its own identity — cancel must neither
+        # resurrect it at the next resubmit nor double-free pages the
+        # failover drain already released.
+        if req.done:
+            return False
+        # Identity scan, NOT ``in``: Request is a dataclass whose
+        # generated __eq__ would compare prompt arrays elementwise on
+        # an rid collision (rids are per-replica counters).
+        if any(p is req for p in self._pending):
+            self._pending = deque(p for p in self._pending
+                                  if p is not req)
+            req.cancelled = True
+            req.done = True
+            req.finished_at = time.perf_counter()
+            return True
+        ent = self._migrating.pop(id(req), None)
+        if ent is not None:
+            orig, cont, i, _ = ent
+            self._cont_to_orig.pop(id(cont), None)
+            self.replicas[i].cancel(cont)
+            orig.cancelled = True
+            orig.done = True
+            orig.finished_at = time.perf_counter()
+            return True
         i = self._owner.get(id(req))
         if i is None:
             return False
         return self.replicas[i].cancel(req)
 
+    # ---- failure handling ----------------------------------------------
+
+    def _fail_replica(self, i: int, exc: Exception) -> None:
+        wait = self.health[i].mark_failure()
+        self.failovers += 1
+        warnings.warn(
+            f"replica {i} failed ({type(exc).__name__}: {exc}); "
+            f"marked unhealthy (probe in {wait:.2f}s), migrating its "
+            "in-flight requests", stacklevel=3)
+        harvested = self.replicas[i].drain() \
+            if hasattr(self.replicas[i], "drain") else []
+        for req in harvested:
+            orig = self._cont_to_orig.pop(id(req), None)
+            if orig is not None:
+                # The dying replica was itself running a migrated
+                # continuation: fold its progress into the original
+                # and re-pend THAT (the caller only knows orig).
+                ent = self._migrating.pop(id(orig), None)
+                if ent is not None:
+                    self._sync_entry(ent)
+                req = orig
+            if req.done or req.cancelled:
+                continue
+            if req.migrations >= self.retry_budget:
+                req.shed = True
+                req.done = True
+                req.finished_at = time.perf_counter()
+                self.shed += 1
+                continue
+            self._pending.append(req)
+
+    def _resubmit_pending(self) -> bool:
+        """Replay harvested requests on healthy replicas as
+        continuations from ``prompt + tokens_so_far`` — bitwise
+        identical to the undisturbed run (stateless sampling keyed on
+        (seed, position))."""
+        if not self._pending:
+            return False
+        healthy = [i for i in range(len(self.replicas))
+                   if self.health[i].healthy]
+        if not healthy:
+            return False
+        did = False
+        while self._pending:
+            orig = self._pending.popleft()
+            if orig.done or orig.cancelled:
+                continue  # cancelled while pending: never resurrect
+            prompt, budget = continuation_of(orig)
+            i = min(healthy,
+                    key=lambda j: (self.replicas[j].outstanding(), j))
+            try:
+                cont = self.replicas[i].submit(
+                    prompt, budget, temperature=orig.temperature,
+                    seed=orig.seed, eos_id=orig.eos_id)
+            except ValueError as e:
+                # An invalid held request (fleet was dark at submit,
+                # so validation never ran) surfaces here: shed it
+                # loudly instead of killing the drive loop.
+                warnings.warn(f"request {orig.rid}: replay rejected "
+                              f"({e}); shedding", stacklevel=3)
+                orig.shed = True
+                orig.done = True
+                orig.finished_at = time.perf_counter()
+                self.shed += 1
+                continue
+            orig.migrations += 1
+            if orig.tokens:
+                self.migrated += 1
+            else:
+                self.retried += 1
+            self.routed[i] += 1
+            self._owner[id(orig)] = i
+            self._migrating[id(orig)] = [orig, cont, i, 0]
+            self._cont_to_orig[id(cont)] = orig
+            did = True
+        return did
+
+    def _sync_entry(self, ent: list) -> None:
+        """Copy a continuation's fresh tokens onto the original handle
+        (streaming callbacks fire here — the caller never sees the
+        continuation object)."""
+        orig, cont, _, synced = ent
+        if len(cont.tokens) > synced:
+            now = time.perf_counter()
+            for t, lp in zip(cont.tokens[synced:],
+                             cont.logprobs[synced:]):
+                orig.tokens.append(int(t))
+                orig.logprobs.append(float(lp))
+                if orig.first_token_at is None:
+                    orig.first_token_at = now
+                if orig.on_token is not None:
+                    orig.on_token(int(t))
+            ent[3] = len(cont.tokens)
+
+    def _sync_migrations(self) -> None:
+        for key in list(self._migrating):
+            ent = self._migrating[key]
+            orig, cont, _, _ = ent
+            self._sync_entry(ent)
+            if cont.done:
+                del self._migrating[key]
+                self._cont_to_orig.pop(id(cont), None)
+                orig.shed = orig.shed or cont.shed
+                orig.quarantined = orig.quarantined or cont.quarantined
+                orig.done = True
+                orig.finished_at = time.perf_counter()
+
     # ---- the iteration (run_load drives this like one engine) ----------
+
+    def _step_replica(self, i: int) -> bool:
+        """One guarded replica step: exceptions and deadline overruns
+        become unhealthy state + migration instead of taking down the
+        fleet."""
+        r, h = self.replicas[i], self.health[i]
+        if not h.healthy:
+            if not h.probe_due():
+                return False
+            try:
+                worked = bool(r.step())
+            except Exception as e:  # noqa: BLE001 — probe failed
+                h.mark_failure()
+                return False
+            h.mark_recovered()
+            self.readmitted += 1
+            return worked
+        t0 = time.perf_counter()
+        try:
+            worked = bool(r.step())
+        except Exception as e:  # noqa: BLE001 — crash becomes failover
+            self._fail_replica(i, e)
+            return False
+        if self.step_deadline_ms and \
+                (time.perf_counter() - t0) * 1e3 > self.step_deadline_ms:
+            self._fail_replica(i, TimeoutError(
+                f"step() overran the {self.step_deadline_ms:.0f}ms "
+                "deadline"))
+            return False
+        return worked
 
     def step(self) -> bool:
         worked = False
-        for r in self.replicas:
-            worked |= bool(r.step())   # no short-circuit: step EVERY replica
-        return worked
+        if not self.health_enabled:
+            for r in self.replicas:
+                worked |= bool(r.step())  # step EVERY replica
+            return worked
+        for i in range(len(self.replicas)):
+            worked |= self._step_replica(i)
+        worked |= self._resubmit_pending()
+        self._sync_migrations()
+        # Unfinished router-held work keeps the drive loop alive even
+        # while every replica is backing off.
+        return worked or bool(self._pending) or bool(self._migrating)
 
     def run(self, max_steps: int | None = None) -> int:
         n = 0
@@ -108,7 +365,10 @@ class Router:
     # ---- introspection -------------------------------------------------
 
     def outstanding(self) -> int:
-        return sum(r.outstanding() for r in self.replicas)
+        w = sum(r.outstanding() for r in self.replicas)
+        for req in self._pending:
+            w += len(req.prompt) + req.max_new_tokens - len(req.tokens)
+        return w
 
     def accounting_ok(self) -> bool:
         return all(r.accounting_ok() for r in self.replicas)
@@ -117,7 +377,9 @@ class Router:
         per = []
         for i, r in enumerate(self.replicas):
             s = {"routed": self.routed[i],
-                 "outstanding": r.outstanding()}
+                 "outstanding": r.outstanding(),
+                 "health": self.health[i].state,
+                 "failures": self.health[i].failures}
             prefix = getattr(r, "prefix", None)
             if prefix is not None:
                 s["prefix"] = prefix.stats()
@@ -126,6 +388,14 @@ class Router:
                 "n_replicas": len(self.replicas),
                 "routed": list(self.routed),
                 "affinity_hits": self.affinity_hits,
+                "health_enabled": self.health_enabled,
+                "failovers": self.failovers,
+                "readmitted": self.readmitted,
+                "migrated": self.migrated,
+                "retried": self.retried,
+                "shed": self.shed,
+                "pending": len(self._pending),
+                "migrating": len(self._migrating),
                 "replicas": per}
 
 

@@ -679,6 +679,34 @@ def main(argv=None) -> int:
                         "still fall back to --max-restarts checkpoint "
                         "recovery. Sets TPU_DDP_ELASTIC_RESHARD for "
                         "every rank")
+    p.add_argument("--fleet-health", default=None, choices=("0", "1"),
+                   help="replica health tracking + deterministic "
+                        "request migration in the serving Router "
+                        "(tpu_ddp/fleet/router.py); '0' = fail-fast. "
+                        "Sets TPU_DDP_FLEET_HEALTH for every rank")
+    p.add_argument("--fleet-probe-backoff-ms", type=float, default=None,
+                   help="initial probe-re-admission backoff for an "
+                        "unhealthy replica, doubling per consecutive "
+                        "failure (default 200). Sets "
+                        "TPU_DDP_FLEET_HEALTH_BACKOFF_MS for every rank")
+    p.add_argument("--fleet-step-deadline-ms", type=float, default=None,
+                   help="per-replica step deadline; a step exceeding "
+                        "it counts as a failure (0 disables). Sets "
+                        "TPU_DDP_FLEET_HEALTH_DEADLINE_MS for every "
+                        "rank")
+    p.add_argument("--fleet-retry-budget", type=int, default=None,
+                   help="migrations allowed per request before the "
+                        "Router sheds it (default 3). Sets "
+                        "TPU_DDP_FLEET_RETRY_BUDGET for every rank")
+    p.add_argument("--serve-queue-limit", type=int, default=None,
+                   help="bounded serving admission queue; submits "
+                        "beyond this many waiting requests are shed "
+                        "(0 = unbounded). Sets TPU_DDP_SERVE_QUEUE_LIMIT "
+                        "for every rank")
+    p.add_argument("--serve-shed-ms", type=float, default=None,
+                   help="shed a queued request that has not started "
+                        "prefill after this many ms (0 disables). Sets "
+                        "TPU_DDP_SERVE_SHED_MS for every rank")
     p.add_argument("--autotune", default=None,
                    choices=("off", "cached", "search"),
                    help="perf-knob autotuning (tpu_ddp/tune/): 'cached' "
@@ -720,6 +748,35 @@ def main(argv=None) -> int:
         env["TPU_DDP_REMAT"] = args.remat
     if args.act_dtype is not None:
         env["TPU_DDP_ACT_DTYPE"] = args.act_dtype
+    if args.fleet_health is not None:
+        env["TPU_DDP_FLEET_HEALTH"] = args.fleet_health
+    if args.fleet_probe_backoff_ms is not None:
+        if args.fleet_probe_backoff_ms <= 0:
+            p.error(f"--fleet-probe-backoff-ms must be > 0, "
+                    f"got {args.fleet_probe_backoff_ms}")
+        env["TPU_DDP_FLEET_HEALTH_BACKOFF_MS"] = \
+            str(args.fleet_probe_backoff_ms)
+    if args.fleet_step_deadline_ms is not None:
+        if args.fleet_step_deadline_ms < 0:
+            p.error(f"--fleet-step-deadline-ms must be >= 0, "
+                    f"got {args.fleet_step_deadline_ms}")
+        env["TPU_DDP_FLEET_HEALTH_DEADLINE_MS"] = \
+            str(args.fleet_step_deadline_ms)
+    if args.fleet_retry_budget is not None:
+        if args.fleet_retry_budget < 0:
+            p.error(f"--fleet-retry-budget must be >= 0, "
+                    f"got {args.fleet_retry_budget}")
+        env["TPU_DDP_FLEET_RETRY_BUDGET"] = str(args.fleet_retry_budget)
+    if args.serve_queue_limit is not None:
+        if args.serve_queue_limit < 0:
+            p.error(f"--serve-queue-limit must be >= 0, "
+                    f"got {args.serve_queue_limit}")
+        env["TPU_DDP_SERVE_QUEUE_LIMIT"] = str(args.serve_queue_limit)
+    if args.serve_shed_ms is not None:
+        if args.serve_shed_ms < 0:
+            p.error(f"--serve-shed-ms must be >= 0, "
+                    f"got {args.serve_shed_ms}")
+        env["TPU_DDP_SERVE_SHED_MS"] = str(args.serve_shed_ms)
     if args.autotune is not None:
         env["TPU_DDP_AUTOTUNE"] = args.autotune
     if args.audit is not None:

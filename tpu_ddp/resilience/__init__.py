@@ -25,8 +25,8 @@ arXiv:2509.07003). Four pieces:
 """
 
 from tpu_ddp.resilience.chaos import (  # noqa: F401
-    FAULT_EXIT_CODE, FAULT_KINDS, FaultInjector, FaultSpec,
-    maybe_inject_failure)
+    FAULT_EXIT_CODE, FAULT_KINDS, SERVE_FAULT_KINDS, FaultInjector,
+    FaultSpec, maybe_inject_failure)
 from tpu_ddp.resilience.guard import (  # noqa: F401
     StepGuard, TrainingDivergedError)
 from tpu_ddp.resilience.integrity import (  # noqa: F401

@@ -60,6 +60,28 @@ FAULT_EXIT_CODE = 13
 FAULT_KINDS = ("hard-exit", "nan-grad", "stalled-step", "corrupt-ckpt",
                "slow-rank", "host-loss", "host-join")
 
+# Serve-side fault kinds (tpu_ddp/fleet/resilience.ServeFaultInjector):
+# the decode-path analog of the training kinds above, riding the same
+# spec grammar, seed, and sentinel machinery. ``rank`` is reused as
+# the REPLICA index (the router assigns it), and ``step`` is the
+# replica's engine-step counter (edge-drop counts edge deliveries
+# instead).
+#
+# ========================  =============================================
+# fault kind                recovery path it drills
+# ========================  =============================================
+# ``replica-crash``         router health tracking + deterministic
+#                           request migration to surviving replicas
+# ``slow-replica``          step-deadline overrun -> unhealthy ->
+#                           backoff probe re-admission
+# ``edge-drop``             disagg decode worker falls back to local
+#                           chunked prefill of the lost transfer
+# ``nonfinite-logits``      in-graph detection + per-request quarantine
+#                           (the decode analog of StepGuard)
+# ========================  =============================================
+SERVE_FAULT_KINDS = ("replica-crash", "slow-replica", "edge-drop",
+                     "nonfinite-logits")
+
 CHAOS_ENV = "TPU_DDP_CHAOS_FAULTS"
 
 
@@ -75,9 +97,10 @@ class FaultSpec:
     rank: int = 0
 
     def __post_init__(self):
-        if self.kind not in FAULT_KINDS:
-            raise ValueError(f"unknown fault kind {self.kind!r}; "
-                             f"available: {FAULT_KINDS}")
+        if self.kind not in FAULT_KINDS + SERVE_FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; available: "
+                f"{FAULT_KINDS + SERVE_FAULT_KINDS}")
         if (self.step is None) == (self.prob is None):
             raise ValueError(
                 f"fault {self.kind!r} needs exactly one of step/prob")

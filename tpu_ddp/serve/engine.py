@@ -41,6 +41,7 @@ import functools
 import itertools
 import math
 import time
+import warnings
 from typing import Callable
 
 import jax
@@ -76,6 +77,9 @@ class Request:
     logprobs: list = dataclasses.field(default_factory=list)
     done: bool = False
     cancelled: bool = False
+    shed: bool = False          # dropped by admission control (SLO)
+    quarantined: bool = False   # non-finite logits: request isolated
+    migrations: int = 0         # times replayed on another replica
     submitted_at: float = 0.0
     first_token_at: float | None = None
     finished_at: float | None = None
@@ -119,7 +123,14 @@ def decode_bank(model, block_size: int, blocks_per_seq: int, params,
     toks, lps = jax.vmap(
         lambda lg, t, sd, p: sample_token(model, lg, t, sd, p))(
             logits, temps, seeds, lengths + 1)
-    return pool_k, pool_v, toks, lps
+    # In-graph non-finite detection, the decode analog of StepGuard's
+    # gradient check: a slot whose logits went NaN/Inf (poisoned KV
+    # pages, numerical blow-up) is flagged so the host quarantines
+    # exactly that request — never the whole bank. Checking logits
+    # (not just the sampled logprob) catches an isolated Inf the
+    # sampled position might miss.
+    bad = ~(jnp.all(jnp.isfinite(logits), axis=-1) & jnp.isfinite(lps))
+    return pool_k, pool_v, toks, lps, bad
 
 
 # Both step builders are memoized on (model, block_size, blocks_per_seq)
@@ -201,6 +212,8 @@ class ServeEngine:
                  cache_dtype: str | None = None,
                  mode: str = "continuous",
                  prefix_cache: bool | None = None,
+                 queue_limit: int | None = None,
+                 shed_ms: float | None = None,
                  mesh=None,
                  metrics: MetricsLogger | None = None,
                  config=None):
@@ -253,6 +266,25 @@ class ServeEngine:
                                             self.blocks_per_seq)
         self._rid = itertools.count()
         self.config = config
+        # SLO-aware load shedding (docs/DESIGN.md §23): queue_limit
+        # bounds the admission queue (0 = unbounded, the default);
+        # shed_ms drops queued requests whose wait already blew the
+        # deadline (0 = off). Both shed honestly: the request handle
+        # comes back done+shed, and loadgen counts it against goodput.
+        self.queue_limit = int(queue_limit if queue_limit is not None
+                               else config.serve_queue_limit)
+        self.shed_ms = float(shed_ms if shed_ms is not None
+                             else config.serve_shed_ms)
+        if self.queue_limit < 0:
+            raise ValueError("queue_limit must be >= 0")
+        if self.shed_ms < 0:
+            raise ValueError("shed_ms must be >= 0")
+        self._step_n = 0
+        self.chaos = None
+        from tpu_ddp.fleet.resilience import (
+            ServeFaultInjector, serve_chaos_active)
+        if serve_chaos_active():
+            self.chaos = ServeFaultInjector.from_env()
         # TPU_DDP_AUDIT=warn|error: static donation/precision audit of
         # the two step programs before the engine takes traffic
         # (tpu_ddp/analysis/gate.py; shapes are fully static here).
@@ -333,9 +365,33 @@ class ServeEngine:
                       temperature=float(temperature), seed=int(seed),
                       eos_id=eos_id, on_token=on_token,
                       submitted_at=time.perf_counter())
-        self.sched.enqueue(req)
         self.metrics.inc("serve_submitted")
+        if self.queue_limit and len(self.sched.queue) >= self.queue_limit:
+            # Bounded admission queue: shed at the door rather than
+            # queueing work that can only finish past its deadline.
+            self._shed(req)
+            return req
+        self.sched.enqueue(req)
         return req
+
+    def _shed(self, req: Request) -> None:
+        req.shed = True
+        req.done = True
+        req.finished_at = time.perf_counter()
+        self.metrics.inc("serve_shed")
+
+    def _shed_expired(self) -> None:
+        """Deadline-based shedding: a request still queued (no block
+        held, no token emitted) past ``shed_ms`` is dropped — serving
+        it would only burn capacity on an already-missed SLO."""
+        if not self.shed_ms:
+            return
+        now = time.perf_counter()
+        expired = [r for r in self.sched.queue
+                   if (now - r.submitted_at) * 1e3 > self.shed_ms]
+        for r in expired:
+            self.sched.queue.remove(r)
+            self._shed(r)
 
     def cancel(self, req: Request) -> bool:
         """Drop a queued or live request; frees its blocks. Returns
@@ -362,6 +418,12 @@ class ServeEngine:
     def step(self) -> bool:
         """One engine iteration: admit, at most one prefill chunk, one
         whole-batch decode step. Returns whether any work ran."""
+        self._step_n += 1
+        if self.chaos is not None:
+            # May raise ReplicaCrashError — BEFORE any state mutation,
+            # so a router-harvested engine is always consistent.
+            self.chaos.replica_step(self._step_n)
+        self._shed_expired()
         admitted = self.sched.admit()
         for _ in admitted:
             self.metrics.inc("serve_admitted")
@@ -449,6 +511,22 @@ class ServeEngine:
             s.phase = "decode"
             self._emit(pi, int(tok), float(lp))  # the first token
 
+    def _maybe_poison(self, dslots: list[int]) -> None:
+        """The ``nonfinite-logits`` chaos drill: corrupt ONE live
+        request's private KV pages with NaN host-side. The poison
+        reaches the victim's logits through its own gathered cache
+        view only (disjoint block tables), so the in-graph ``bad``
+        flag must isolate exactly that slot."""
+        if self.chaos is None or not dslots \
+                or not self.chaos.poison_fires(self._step_n):
+            return
+        s = self.sched.slots[dslots[0]]
+        # The LAST block is always private (lazily allocated, or the
+        # CoW copy a prefix hit made) — never poison a block a prefix
+        # cache shares with innocent requests.
+        blk = s.blocks[-1]
+        self.pool.v = self.pool.v.at[:, blk].set(jnp.nan)
+
     def _run_decode_step(self, dslots: list[int]) -> None:
         S, BPS = self.num_slots, self.blocks_per_seq
         tables = np.zeros((S, BPS), np.int32)
@@ -464,15 +542,55 @@ class ServeEngine:
             last[i] = s.pending_token
             temps[i] = s.request.temperature
             seeds[i] = s.request.seed
-        k, v, toks, lps = self._decode(
+        self._maybe_poison(dslots)
+        k, v, toks, lps, bad = self._decode(
             self.params, self.pool.k, self.pool.v,
             jnp.asarray(tables), jnp.asarray(lengths),
             jnp.asarray(last), jnp.asarray(temps), jnp.asarray(seeds))
         self.pool.commit(k, v)
-        toks, lps = np.asarray(toks), np.asarray(lps)
+        toks, lps, bad = np.asarray(toks), np.asarray(lps), np.asarray(bad)
         for i in dslots:
+            if bad[i]:
+                self._quarantine(i)
+                continue
             self.sched.slots[i].length += 1
             self._emit(i, int(toks[i]), float(lps[i]))
+
+    def _quarantine(self, idx: int) -> None:
+        """Non-finite logits on slot ``idx``: isolate the request, not
+        the bank. Its private pages are scrubbed before they return to
+        the free list — a NaN'd V page re-issued to another request
+        would leak through zero-weight attention (0 * NaN = NaN) —
+        then the slot retires and the request finishes quarantined."""
+        s = self.sched.slots[idx]
+        req = s.request
+        self.pool.scrub([b for b in s.blocks
+                         if self.pool.refcount(b) == 1])
+        self.sched.retire(idx)
+        req.quarantined = True
+        req.done = True
+        req.finished_at = time.perf_counter()
+        self.metrics.inc("serve_quarantined")
+        warnings.warn(
+            f"request {req.rid}: non-finite logits at engine step "
+            f"{self._step_n}; request quarantined, pages scrubbed",
+            stacklevel=3)
+
+    def drain(self) -> list[Request]:
+        """Harvest every unfinished request and release all engine
+        state (slots retire, pages free back to THIS pool, queue
+        clears) — the router's failure-migration hook. Returns the
+        harvested requests in submit order so replay elsewhere
+        preserves FIFO fairness."""
+        reqs = []
+        for i, s in enumerate(self.sched.slots):
+            if s is not None:
+                reqs.append(s.request)
+                self.sched.retire(i)
+        reqs.extend(self.sched.queue)
+        self.sched.queue.clear()
+        return sorted((r for r in reqs if not r.done),
+                      key=lambda r: r.rid)
 
     def _emit(self, idx: int, tok: int, logprob: float) -> None:
         """Record one sampled token for slot ``idx``'s request: stream

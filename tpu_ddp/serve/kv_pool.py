@@ -46,6 +46,7 @@ from __future__ import annotations
 import math
 
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_ddp.memory.policy import resolve_act_dtype
 
@@ -199,6 +200,21 @@ class PagedKVPool:
                 return False
         unique = sum(1 for b in range(1, self.num_blocks) if counts[b])
         return self.free_count + unique == self.total_usable
+
+    def scrub(self, blocks) -> None:
+        """Zero the device pages of ``blocks``. Ordinary stale garbage
+        in a reused page is harmless (finite values beyond a query's
+        length get exactly-zero attention weight), but NON-FINITE
+        garbage is not: the V-side product ``0 * NaN = NaN`` leaks
+        through the causal mask into every query that merely shares
+        the page. Quarantine (serve/engine.py) therefore scrubs a
+        poisoned request's private pages before freeing them."""
+        blocks = list(blocks)
+        if not blocks:
+            return
+        ids = jnp.asarray(np.asarray(blocks, np.int32))
+        self.k = self.k.at[:, ids].set(0)
+        self.v = self.v.at[:, ids].set(0)
 
     # ---- device state --------------------------------------------------
 

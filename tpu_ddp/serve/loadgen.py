@@ -131,40 +131,70 @@ def run_load(engine, specs: list[RequestSpec], rate: float,
                 arrivals[nxt] - (time.perf_counter() - t0), 0.05)))
     t_end = time.perf_counter()
 
-    ttfts = np.array([h.ttft_s for h in handles]) * 1e3  # ms
-    n_tokens = np.array([len(h.tokens) for h in handles])
+    # Honest accounting under load shedding and failover: latency
+    # percentiles are over COMPLETED requests only (a shed request has
+    # no TTFT to measure — it shows up in slo_attained and the
+    # completed/shed/cancelled partition instead). Nothing is lost:
+    # completed + cancelled + shed == submitted in every run, and the
+    # chaos drills pin that identity.
+    completed = [h for h in handles
+                 if not h.cancelled and not getattr(h, "shed", False)]
+    n_shed = sum(bool(getattr(h, "shed", False)) for h in handles)
+    n_cancelled = sum(h.cancelled and not getattr(h, "shed", False)
+                      for h in handles)
+    n_quarantined = sum(bool(getattr(h, "quarantined", False))
+                        for h in handles)
+    n_migrations = sum(getattr(h, "migrations", 0) for h in handles)
+
+    ttfts = np.array([h.ttft_s for h in completed
+                      if h.ttft_s is not None]) * 1e3     # ms
+    n_tokens = np.array([len(h.tokens) for h in completed], dtype=int)
     e2es = np.array([h.finished_at - h.submitted_at
-                     for h in handles]) * 1e3             # ms
+                     for h in completed]) * 1e3            # ms
     # Per-request mean time per output token after the first;
     # single-token requests have no inter-token gap to measure.
     tpots = np.array([(h.finished_at - h.first_token_at)
                       / (len(h.tokens) - 1)
-                      for h in handles if len(h.tokens) > 1]) * 1e3
+                      for h in completed if len(h.tokens) > 1]) * 1e3
     makespan = t_end - t0
     if slo_ttft_ms is None:
-        good = n_tokens.sum()
+        good = n_tokens.sum() if n_tokens.size else 0
+        attained = None
     else:
-        good = n_tokens[ttfts <= slo_ttft_ms].sum()
+        good = n_tokens[ttfts <= slo_ttft_ms].sum() \
+            if n_tokens.size else 0
+        # Attainment is over SUBMITTED requests: a shed or failed
+        # request missed its SLO by definition.
+        attained = round(float((ttfts <= slo_ttft_ms).sum())
+                         / len(specs), 4) if len(specs) else None
+    pct = lambda a, q: (round(float(np.percentile(a, q)), 3)  # noqa: E731
+                        if a.size else None)
     return {
         "rate_rps": rate,
         "n_requests": len(specs),
-        "total_tokens": int(n_tokens.sum()),
+        "n_completed": len(completed),
+        "n_shed": int(n_shed),
+        "n_cancelled": int(n_cancelled),
+        "n_quarantined": int(n_quarantined),
+        "n_migrations": int(n_migrations),
+        "accounting_ok": (len(completed) + n_cancelled + n_shed
+                          == len(specs)),
+        "total_tokens": int(n_tokens.sum()) if n_tokens.size else 0,
         "makespan_s": round(makespan, 4),
-        "ttft_p50_ms": round(float(np.percentile(ttfts, 50)), 3),
-        "ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 3),
-        "ttft_mean_ms": round(float(ttfts.mean()), 3),
-        "e2e_p50_ms": round(float(np.percentile(e2es, 50)), 3),
-        "e2e_p99_ms": round(float(np.percentile(e2es, 99)), 3),
-        "tpot_p50_ms": (round(float(np.percentile(tpots, 50)), 3)
-                        if tpots.size else None),
-        "tpot_p99_ms": (round(float(np.percentile(tpots, 99)), 3)
-                        if tpots.size else None),
+        "ttft_p50_ms": pct(ttfts, 50),
+        "ttft_p99_ms": pct(ttfts, 99),
+        "ttft_mean_ms": (round(float(ttfts.mean()), 3)
+                         if ttfts.size else None),
+        "e2e_p50_ms": pct(e2es, 50),
+        "e2e_p99_ms": pct(e2es, 99),
+        "tpot_p50_ms": pct(tpots, 50),
+        "tpot_p99_ms": pct(tpots, 99),
         "tpot_mean_ms": (round(float(tpots.mean()), 3)
                          if tpots.size else None),
-        "tokens_per_sec": round(float(n_tokens.sum()) / makespan, 3),
+        "tokens_per_sec": round(float(n_tokens.sum() if n_tokens.size
+                                      else 0) / makespan, 3),
         "slo_ttft_ms": slo_ttft_ms,
-        "slo_attained": (None if slo_ttft_ms is None else
-                         round(float((ttfts <= slo_ttft_ms).mean()), 4)),
+        "slo_attained": attained,
         "goodput_tokens_per_sec": round(float(good) / makespan, 3),
     }
 

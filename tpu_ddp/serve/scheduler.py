@@ -90,6 +90,12 @@ class Scheduler:
         self.queue: deque = deque()
         self.slots: list[SlotState | None] = [None] * num_slots
         self._admit_seq = 0
+        # Other schedulers drawing on the SAME pool (the disagg
+        # degraded-prefill scheduler shares the decode pool): their
+        # unallocated reservations are subtracted from this
+        # scheduler's admission budget, so admitted-always-finish
+        # holds jointly.
+        self.peers: list = []
 
     # ---- queries -------------------------------------------------------
 
@@ -103,6 +109,14 @@ class Scheduler:
         the amount the admission check must treat as already spent."""
         return sum(s.reserved - len(s.blocks)
                    for s in self.slots if s is not None)
+
+    @property
+    def pool_budget(self) -> int:
+        """Blocks an admission here may draw on: the pool's
+        allocatable count minus every outstanding reservation — this
+        scheduler's AND its peers' on the same pool."""
+        return self.pool.allocatable - self.reserved_unallocated \
+            - sum(p.reserved_unallocated for p in self.peers)
 
     def worst_case_blocks(self, request) -> int:
         if self.role == "prefill":
@@ -164,7 +178,7 @@ class Scheduler:
                 draw += 1 if hit.cow else 0
                 draw += sum(self.pool.refcount(b) == 1
                             for b in hit.blocks)
-            if draw > self.pool.allocatable - self.reserved_unallocated:
+            if draw > self.pool_budget:
                 break  # FIFO: never skip the head
             self.queue.popleft()
             slot = SlotState(request=req, admit_seq=self._admit_seq,
@@ -226,6 +240,15 @@ class Scheduler:
         s = self.slots[idx]
         self.pool.free(s.blocks)
         self.slots[idx] = None
+
+    def release(self, idx: int) -> SlotState:
+        """Clear slot ``idx`` WITHOUT freeing its blocks — ownership
+        transfer, not retirement. The caller must hand the returned
+        state's blocks to another scheduler on the SAME pool (the
+        degraded-prefill -> decode handover) or free them itself."""
+        s = self.slots[idx]
+        self.slots[idx] = None
+        return s
 
     def accounting_ok(self) -> bool:
         """The page-pool invariant (§19, extended by §21 refcounts),

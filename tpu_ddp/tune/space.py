@@ -220,6 +220,58 @@ KNOBS: tuple[Knob, ...] = (
              "(parallel/compress.py EdgeCodec): 'bf16'/'int8' shrink "
              "the shipped KV payload but round it — semantic, gated "
              "like serve_cache_dtype"),
+    # Fleet-resilience knobs (fleet/resilience.py, DESIGN.md §23):
+    # replica health + migration are Router concerns, shedding is an
+    # engine admission concern — all measured by the same loadgen
+    # goodput harness (a shed request's tokens are not good tokens).
+    Knob("fleet_health", "fleet_health", "TPU_DDP_FLEET_HEALTH",
+         values=(False, True), flag="--fleet-health",
+         objective="goodput",
+         doc="replica health tracking in the Router "
+             "(fleet/router.py): step exceptions and deadline "
+             "overruns mark a replica unhealthy, its in-flight "
+             "requests migrate deterministically, and probe "
+             "re-admission follows exponential backoff; off = "
+             "fail-fast (a replica exception propagates)"),
+    Knob("fleet_probe_backoff_ms", "fleet_probe_backoff_ms",
+         "TPU_DDP_FLEET_HEALTH_BACKOFF_MS",
+         values=(50.0, 200.0, 1000.0), flag="--fleet-probe-backoff-ms",
+         objective="goodput",
+         doc="initial probe-re-admission backoff for an unhealthy "
+             "replica, doubling per consecutive failure (capped): "
+             "short backoff re-admits flapping replicas faster but "
+             "burns steps probing a dead one"),
+    Knob("fleet_step_deadline_ms", "fleet_step_deadline_ms",
+         "TPU_DDP_FLEET_HEALTH_DEADLINE_MS",
+         values=(0.0, 250.0, 1000.0), flag="--fleet-step-deadline-ms",
+         objective="goodput",
+         doc="per-replica step deadline: a step exceeding this is "
+             "treated as a failure (slow replica == dead replica, the "
+             "serving mirror of the heartbeat stall detector); 0 "
+             "disables the deadline"),
+    Knob("fleet_retry_budget", "fleet_retry_budget",
+         "TPU_DDP_FLEET_RETRY_BUDGET",
+         values=(0, 1, 3), flag="--fleet-retry-budget",
+         objective="goodput",
+         doc="migrations allowed per request before the Router sheds "
+             "it instead of re-queueing (a request that has killed N "
+             "replicas is suspect — the serving analog of StepGuard's "
+             "max-bad-steps budget)"),
+    Knob("serve_queue_limit", "serve_queue_limit",
+         "TPU_DDP_SERVE_QUEUE_LIMIT",
+         values=(0, 64, 256), flag="--serve-queue-limit",
+         objective="goodput",
+         doc="bounded admission queue: submits beyond this many "
+             "waiting requests are shed at the door (engine.py); 0 = "
+             "unbounded. Under overload shedding keeps TTFT of "
+             "admitted requests inside the SLO instead of letting the "
+             "whole queue miss it"),
+    Knob("serve_shed_ms", "serve_shed_ms", "TPU_DDP_SERVE_SHED_MS",
+         values=(0.0, 100.0, 500.0), flag="--serve-shed-ms",
+         objective="goodput",
+         doc="queue-deadline shedding: a request still waiting (no "
+             "prefill started) this many ms after submission is shed "
+             "(its TTFT SLO is already lost); 0 disables"),
 )
 
 # Model-level knobs are baked into get_model() before the Trainer ever

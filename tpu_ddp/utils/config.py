@@ -34,6 +34,20 @@ def _env_bool(name: str, default: bool) -> bool:
                      f"(1/0/true/false/yes/no/on/off)")
 
 
+def _env_num(name: str, conv, default):
+    """Parse a numeric env var; unset -> default, junk -> ValueError
+    naming the variable (a typo'd knob silently running the default
+    would be the worst kind of drift)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return conv(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected "
+                         f"{conv.__name__}") from None
+
+
 @dataclasses.dataclass
 class TrainConfig:
     """One training run's configuration (defaults = the reference's)."""
@@ -197,6 +211,34 @@ class TrainConfig:
     # "bf16", "int8". Lossy formats round the shipped KV, so the knob
     # is semantic (gated like cache dtype). Env: TPU_DDP_KV_WIRE.
     kv_wire: str = "none"
+
+    # Fleet resilience (tpu_ddp/fleet/resilience.py, docs/DESIGN.md
+    # §23). Replica health tracking in the router: a replica raising
+    # out of step() goes unhealthy and its in-flight requests migrate
+    # to survivors. Env: TPU_DDP_FLEET_HEALTH.
+    fleet_health: bool = True
+    # Exponential-backoff base for probing an unhealthy replica
+    # (doubles per consecutive failure, capped at 30s). Env:
+    # TPU_DDP_FLEET_HEALTH_BACKOFF_MS.
+    fleet_probe_backoff_ms: float = 200.0
+    # Per-replica step() wall-clock deadline; an overrun marks the
+    # replica unhealthy like a crash (0 = off — CPU test hosts jitter
+    # far past any useful default). Env:
+    # TPU_DDP_FLEET_HEALTH_DEADLINE_MS.
+    fleet_step_deadline_ms: float = 0.0
+    # Times one request may be replayed after replica failures before
+    # the router sheds it instead of bouncing it forever. Env:
+    # TPU_DDP_FLEET_RETRY_BUDGET.
+    fleet_retry_budget: int = 3
+    # Bounded admission queue per engine: submits past this depth are
+    # shed at the door (0 = unbounded). Env:
+    # TPU_DDP_SERVE_QUEUE_LIMIT.
+    serve_queue_limit: int = 0
+    # Deadline-based shedding: a request still queued (no token, no
+    # block) past this many ms is dropped — serving it would only burn
+    # capacity on an already-missed SLO (0 = off). Env:
+    # TPU_DDP_SERVE_SHED_MS.
+    serve_shed_ms: float = 0.0
 
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
@@ -417,6 +459,42 @@ class TrainConfig:
             raise ValueError(
                 f"kv_wire={self.kv_wire!r}: expected none|bf16|int8 "
                 "(TPU_DDP_KV_WIRE)")
+        self.fleet_health = _env_bool("TPU_DDP_FLEET_HEALTH",
+                                      self.fleet_health)
+        self.fleet_probe_backoff_ms = _env_num(
+            "TPU_DDP_FLEET_HEALTH_BACKOFF_MS", float,
+            self.fleet_probe_backoff_ms)
+        if self.fleet_probe_backoff_ms <= 0:
+            raise ValueError(
+                f"fleet_probe_backoff_ms must be > 0, got "
+                f"{self.fleet_probe_backoff_ms} "
+                "(TPU_DDP_FLEET_HEALTH_BACKOFF_MS)")
+        self.fleet_step_deadline_ms = _env_num(
+            "TPU_DDP_FLEET_HEALTH_DEADLINE_MS", float,
+            self.fleet_step_deadline_ms)
+        if self.fleet_step_deadline_ms < 0:
+            raise ValueError(
+                f"fleet_step_deadline_ms must be >= 0, got "
+                f"{self.fleet_step_deadline_ms} "
+                "(TPU_DDP_FLEET_HEALTH_DEADLINE_MS)")
+        self.fleet_retry_budget = _env_num(
+            "TPU_DDP_FLEET_RETRY_BUDGET", int, self.fleet_retry_budget)
+        if self.fleet_retry_budget < 0:
+            raise ValueError(
+                f"fleet_retry_budget must be >= 0, got "
+                f"{self.fleet_retry_budget} (TPU_DDP_FLEET_RETRY_BUDGET)")
+        self.serve_queue_limit = _env_num(
+            "TPU_DDP_SERVE_QUEUE_LIMIT", int, self.serve_queue_limit)
+        if self.serve_queue_limit < 0:
+            raise ValueError(
+                f"serve_queue_limit must be >= 0, got "
+                f"{self.serve_queue_limit} (TPU_DDP_SERVE_QUEUE_LIMIT)")
+        self.serve_shed_ms = _env_num(
+            "TPU_DDP_SERVE_SHED_MS", float, self.serve_shed_ms)
+        if self.serve_shed_ms < 0:
+            raise ValueError(
+                f"serve_shed_ms must be >= 0, got "
+                f"{self.serve_shed_ms} (TPU_DDP_SERVE_SHED_MS)")
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.
