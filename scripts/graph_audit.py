@@ -20,8 +20,9 @@ Cells: the six sync rungs (none/gather_scatter/all_reduce/fused/zero/
 fsdp) on a tiny VGG at dp=4, the compressed fused rungs (bf16/int8),
 the bucketized-overlap rung, both MPMD stage programs at pp=2, the
 serving engine's decode + prefill steps, the fleet's adopt-decode
-repack, and a live dp4->dp2 redistribute bracketed by fingerprints of
-both trainers' programs.
+repack, both weight-streaming programs (the publisher's delta pack and
+the subscriber's donating apply), and a live dp4->dp2 redistribute
+bracketed by fingerprints of both trainers' programs.
 
 All claims are compiled-HLO claims, valid on any backend; CI runs a
 reduced subset (tests/test_graph_audit.py). Exit 1 on ANY finding.
@@ -213,6 +214,29 @@ def audit_fleet_cell():
     ]
 
 
+def audit_publish_cells():
+    """Both weight-streaming jit surfaces (tpu_ddp/publish/): the
+    trainer-side delta pack and the engine-side donating apply. The
+    apply's donation IS the zero-copy flip claim — an unaliased live
+    tree would copy the whole model every version."""
+    import jax
+
+    from tpu_ddp.publish.publisher import Publisher
+    from tpu_ddp.publish.subscriber import Subscriber
+    from tpu_ddp.serve.engine import ServeEngine
+
+    model = _tiny_lm()
+    params = model.init(jax.random.key(0))
+    pub = Publisher(publish_every=1, wire="none", bucket_mb=1)
+    pub.ensure_plan(jax.tree.map(lambda x: jax.device_get(x), params))
+    engine = ServeEngine(model, params, **GEOM)
+    sub = Subscriber(engine)
+    return [
+        _program_audit("publish/push", pub.lower_push_step),
+        _program_audit("publish/apply", sub.lower_apply_step),
+    ]
+
+
 def audit_redistribute_cell():
     """Fingerprint the dp=4 source and dp=2 destination train programs
     around a LIVE redistribute: the two fleets' programs legitimately
@@ -257,6 +281,7 @@ def build_cells(only=None):
     specs.append(("mpmd", audit_mpmd_cells))
     specs.append(("serve", audit_serve_cells))
     specs.append(("fleet", audit_fleet_cell))
+    specs.append(("publish", audit_publish_cells))
     specs.append(("redistribute", audit_redistribute_cell))
     if only is not None:
         specs = [(n, t) for n, t in specs

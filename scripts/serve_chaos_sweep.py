@@ -25,6 +25,14 @@ nonfinite-logits  one live request's KV pages are NaN-poisoned; the
                   in-graph finiteness mask quarantines exactly that
                   request, its batchmates keep bitwise-exact streams,
                   and the scrubbed pages are safely reusable
+publisher-death   the weight-streaming publisher dies mid-run; the
+                  subscriber keeps serving its last-good version
+                  (warned + counted) and token streams stay bitwise
+                  equal to that version's undisturbed run
+push-stall        a weight push stalls in flight; the trainer's
+                  staleness gate blocks until the push flushes, no
+                  update is rejected, and the engine converges to the
+                  final version bitwise
 ================  ====================================================
 
 Every drill additionally pins the accounting identity
@@ -245,11 +253,112 @@ def drill_nonfinite_logits(ctx, cell: dict) -> bool:
     return ok
 
 
+def drill_publisher_death(ctx, cell: dict) -> bool:
+    """The weight-streaming publisher dies at its second push; the
+    subscriber keeps serving the last applied (= last-good) version,
+    loudly, and its streams stay bitwise equal to that version's."""
+    import jax
+
+    from tpu_ddp.publish import Publisher, attach
+    from tpu_ddp.serve import ServeEngine
+
+    model, params, baseline = ctx
+    os.environ[CHAOS_ENV] = "publisher-death@2"
+    try:
+        eng = ServeEngine(model, params, **GEOM)
+        pub = Publisher(publish_every=1, wire="none", bucket_mb=1)
+        subs = attach(pub, eng, name="sub")
+        # Push 1: the engine's own params (f32 — the wire round-trip
+        # is exact, so version 1 serves bitwise the baseline weights).
+        u1 = pub.publish(params=params, step=1)
+        while subs[0].lag:
+            eng.step()
+        # Push 2: a perturbed tree — chaos kills the publisher first.
+        pert = jax.tree.map(lambda x: x + 0.25, params)
+        u2 = pub.publish(params=pert, step=2)
+    finally:
+        del os.environ[CHAOS_ENV]
+    ok = _check(cell, "first_push_applied",
+                u1 is not None and eng.param_version == 1)
+    ok &= _check(cell, "publisher_died_at_push_2",
+                 u2 is None and pub.dead and pub.deaths == 1)
+    ok &= _check(cell, "loss_counted_not_crashed",
+                 subs[0].publisher_lost_n == 1,
+                 subs[0].stats())
+    # Serving survives on last-good: streams bitwise equal the
+    # version-1 weights (== the undisturbed baseline params).
+    hs = _submit_mixed(eng)
+    eng.run()
+    ok &= _check(cell, "serves_last_good_bitwise",
+                 [list(h.tokens) for h in hs] == baseline
+                 and eng.param_version == 1)
+    ok &= _check(cell, "tokens_stamped_with_last_good",
+                 all(v == 1 for h in hs for v in h.token_versions))
+    ok &= _identity(cell, hs)
+    ok &= _check(cell, "pool_accounting_ok", eng.accounting_ok())
+    return ok
+
+
+def drill_push_stall(ctx, cell: dict) -> bool:
+    """The second push stalls in flight; later pushes queue behind it
+    (order holds, nothing is rejected), the trainer's staleness gate
+    blocks until the backlog flushes, and the engine converges to the
+    final version bitwise."""
+    import types
+
+    import jax
+    import numpy as np
+
+    from tpu_ddp.publish import Publisher, attach, tree_digests
+    from tpu_ddp.serve import ServeEngine
+
+    model, params, baseline = ctx
+    os.environ[CHAOS_ENV] = "push-stall@2"
+    try:
+        eng = ServeEngine(model, params, **GEOM)
+        pub = Publisher(publish_every=1, wire="none",
+                        max_staleness_steps=1, bucket_mb=1)
+        subs = attach(pub, eng, name="sub")
+        p = params
+        for step in range(1, 5):
+            p = jax.tree.map(lambda x: x + 0.01, p)
+            pub.after_step(types.SimpleNamespace(params=p, step=step),
+                           step)
+    finally:
+        del os.environ[CHAOS_ENV]
+    ok = _check(cell, "stall_injected", pub.stalls == 1, pub.stats())
+    ok &= _check(cell, "stalled_push_flushed_not_lost",
+                 pub.stall_events == 1 and not pub._stalled)
+    ok &= _check(cell, "staleness_gate_blocked_trainer",
+                 pub.gate_blocks >= 1, pub.gate_blocks)
+    ok &= _check(cell, "ordered_delivery_nothing_rejected",
+                 subs[0].rejected == 0, subs[0].stats())
+    # Drain staging, then the engine must serve the FINAL version
+    # bitwise: digests equal on both ends of the edge.
+    while subs[0].lag:
+        eng.step()
+    ok &= _check(cell, "engine_caught_up_to_final_version",
+                 eng.param_version == pub.version == 4)
+    ok &= _check(
+        cell, "served_params_bitwise_equal_published",
+        tree_digests(jax.tree.map(np.asarray, eng.params))
+        == subs[0].store.digests)
+    hs = _submit_mixed(eng)
+    eng.run()
+    ok &= _check(cell, "tokens_stamped_with_final_version",
+                 all(v == 4 for h in hs for v in h.token_versions))
+    ok &= _identity(cell, hs)
+    ok &= _check(cell, "pool_accounting_ok", eng.accounting_ok())
+    return ok
+
+
 DRILLS = {
     "replica-crash": drill_replica_crash,
     "slow-replica": drill_slow_replica,
     "edge-drop": drill_edge_drop,
     "nonfinite-logits": drill_nonfinite_logits,
+    "publisher-death": drill_publisher_death,
+    "push-stall": drill_push_stall,
 }
 assert set(DRILLS) == set(SERVE_FAULT_KINDS), \
     "a serve fault kind exists without a sweep drill"

@@ -102,7 +102,10 @@ def test_serve_knobs_registered_under_goodput_objective():
               # migration in the Router, shedding in the engine.
               "fleet_health", "fleet_probe_backoff_ms",
               "fleet_step_deadline_ms", "fleet_retry_budget",
-              "serve_queue_limit", "serve_shed_ms"}
+              "serve_queue_limit", "serve_shed_ms",
+              # Weight-streaming knobs (DESIGN.md §24): publish cadence
+              # and wire on the trainer, staleness gate across both.
+              "publish_every", "publish_wire", "max_staleness_steps"}
     for f in fields:
         k = knob_by_field(f)
         assert k is not None and k.objective == "goodput", f
@@ -110,10 +113,15 @@ def test_serve_knobs_registered_under_goodput_objective():
     assert knob_by_field("kv_wire").env == "TPU_DDP_KV_WIRE"
     assert (knob_by_field("fleet_probe_backoff_ms").env
             == "TPU_DDP_FLEET_HEALTH_BACKOFF_MS")
+    assert (knob_by_field("max_staleness_steps").env
+            == "TPU_DDP_PUBLISH_MAX_STALENESS")
     # Cache dtype and the lossy KV wire change numerics -> semantic,
     # like act_dtype; the pure-scheduling knobs must not be.
     assert knob_by_field("serve_cache_dtype").semantic
     assert knob_by_field("kv_wire").semantic
+    assert knob_by_field("publish_wire").semantic
+    assert not knob_by_field("publish_every").semantic
+    assert not knob_by_field("max_staleness_steps").semantic
     assert not knob_by_field("serve_slots").semantic
     assert not knob_by_field("fleet_roles").semantic
     # Resilience knobs never change what a healthy run computes —
@@ -128,12 +136,16 @@ def test_serve_knobs_registered_under_goodput_objective():
                              include_semantic=True)}
     # At the default config the coupled fleet knobs collapse to single
     # candidates (kv_wire needs a disagg edge, prefix-affinity needs a
-    # cache — tune/space.py violations) and drop out of the space.
-    assert good == fields - {"router_policy", "kv_wire"}
+    # cache, the publish wire and gate need a publish cadence —
+    # tune/space.py violations) and drop out of the space.
+    assert good == fields - {"router_policy", "kv_wire",
+                             "publish_wire", "max_staleness_steps"}
     step = {k.field for k, _ in searchable_knobs(cfg, ctx)}
     assert not (step & fields)
-    # With the edge and the cache on, the whole fleet space opens up.
-    fleet_cfg = TrainConfig(fleet_roles="disagg", prefix_cache=True)
+    # With the edge, the cache, and a publish cadence on, the whole
+    # fleet space opens up.
+    fleet_cfg = TrainConfig(fleet_roles="disagg", prefix_cache=True,
+                            publish_every=1)
     good = {k.field for k, _ in
             searchable_knobs(fleet_cfg, ctx, objective="goodput",
                              include_semantic=True)}

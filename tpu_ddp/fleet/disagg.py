@@ -71,7 +71,7 @@ from tpu_ddp.serve.engine import (
     _build_prefill_step,
     decode_bank,
 )
-from tpu_ddp.serve.kv_pool import PagedKVPool
+from tpu_ddp.serve.kv_pool import PagedKVPool, pin_committed
 from tpu_ddp.serve.scheduler import Scheduler
 from tpu_ddp.utils.metrics import MetricsLogger
 
@@ -192,7 +192,7 @@ class DisaggEngine:
             from tpu_ddp.utils.config import TrainConfig
             config = TrainConfig()
         self.model = model
-        self.params = jax.tree.map(jnp.asarray, params)
+        self.params = pin_committed(jax.tree.map(jnp.asarray, params))
         self.num_slots = int(num_slots if num_slots is not None
                              else config.serve_slots)
         self.block_size = int(block_size if block_size is not None
@@ -257,6 +257,12 @@ class DisaggEngine:
         if self.shed_ms < 0:
             raise ValueError("shed_ms must be >= 0")
         self._step_n = 0
+        # Weight streaming (tpu_ddp/publish/): both roles serve ONE
+        # ``self.params`` tree, passed per call to every jitted
+        # program (prefill, degraded prefill, decode, adopt+decode) —
+        # a subscriber flip swaps all of them at once, between steps.
+        self.param_version = 0
+        self.subscriber = None
         self.chaos = None
         from tpu_ddp.fleet.resilience import (
             ServeFaultInjector, serve_chaos_active)
@@ -366,6 +372,12 @@ class DisaggEngine:
         if self.chaos is not None:
             # May raise ReplicaCrashError — before any state mutation.
             self.chaos.replica_step(self._step_n)
+        if self.subscriber is not None:
+            # Weight streaming: stage/flip between steps (see
+            # ServeEngine.step) — prefill and decode roles flip
+            # together, so a request never prefills on one version
+            # and starts decoding on another within one step.
+            self.subscriber.on_engine_step()
         self._shed_expired()
         admitted = list(self.psched.admit())
         self._promote_degraded()
@@ -407,6 +419,14 @@ class DisaggEngine:
                 break
             n += 1
         return n
+
+    def swap_params(self, params, version: int) -> None:
+        """Atomic weight flip for BOTH roles (see
+        ServeEngine.swap_params): one tree feeds prefill, degraded
+        prefill, decode and adopt+decode, so a single swap keeps every
+        program on the same version from the next step on."""
+        self.params = params
+        self.param_version = int(version)
 
     # ---- router hooks --------------------------------------------------
 
@@ -490,6 +510,7 @@ class DisaggEngine:
     def _emit_first(self, req: Request, tok: int, lp: float) -> None:
         req.tokens.append(tok)
         req.logprobs.append(lp)
+        req.token_versions.append(self.param_version)
         now = time.perf_counter()
         req.first_token_at = now
         self.metrics.observe("serve_ttft_ms",
@@ -710,6 +731,7 @@ class DisaggEngine:
             s.pending_token = tok
             req.tokens.append(tok)
             req.logprobs.append(float(lps[i]))
+            req.token_versions.append(self.param_version)
             if req.on_token is not None:
                 req.on_token(tok)
             if s.generated >= req.max_new_tokens \

@@ -174,6 +174,30 @@ class ServeFaultInjector(FaultInjector):
                 return True
         return False
 
+    def publisher_death_fires(self, push_n: int) -> bool:
+        """True when the ``push_n``-th weight publish must find the
+        publisher dead (subscribers then keep serving last-good and
+        count the loss; nothing crashes)."""
+        for spec in self.specs:
+            if spec.kind == "publisher-death" \
+                    and self._fires(spec, push_n):
+                self._announce(spec, push_n)
+                self._mark_sentinel(spec, push_n)
+                return True
+        return False
+
+    def push_stall_fires(self, push_n: int) -> bool:
+        """True when the ``push_n``-th weight push must stall in
+        flight (delivery delayed until the trainer's staleness gate
+        flushes it — a delay drill, not a loss drill)."""
+        for spec in self.specs:
+            if spec.kind == "push-stall" \
+                    and self._fires(spec, push_n):
+                self._announce(spec, push_n)
+                self._mark_sentinel(spec, push_n)
+                return True
+        return False
+
     def poison_fires(self, step: int) -> bool:
         """True when this engine step must corrupt one live request's
         KV pages with NaN (the ``nonfinite-logits`` drill: the decode

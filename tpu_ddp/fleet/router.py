@@ -285,14 +285,18 @@ class Router:
         orig, cont, _, synced = ent
         if len(cont.tokens) > synced:
             now = time.perf_counter()
-            for t, lp in zip(cont.tokens[synced:],
-                             cont.logprobs[synced:]):
-                orig.tokens.append(int(t))
-                orig.logprobs.append(float(lp))
+            vers = getattr(cont, "token_versions", None)
+            for j in range(synced, len(cont.tokens)):
+                orig.tokens.append(int(cont.tokens[j]))
+                orig.logprobs.append(float(cont.logprobs[j]))
+                if vers is not None and j < len(vers):
+                    # Version stamps migrate with the tokens: the
+                    # caller's atomic-cutover view survives failover.
+                    orig.token_versions.append(int(vers[j]))
                 if orig.first_token_at is None:
                     orig.first_token_at = now
                 if orig.on_token is not None:
-                    orig.on_token(int(t))
+                    orig.on_token(int(cont.tokens[j]))
             ent[3] = len(cont.tokens)
 
     def _sync_migrations(self) -> None:
@@ -362,6 +366,19 @@ class Router:
             n += 1
         return n
 
+    # ---- weight streaming ----------------------------------------------
+
+    def subscribe(self, publisher) -> list:
+        """Fleet-wide version fan-out (tpu_ddp/publish/): give every
+        replica its own subscriber on ``publisher``'s edge. One
+        publish then reaches the whole fleet; replicas flip
+        independently between their own steps (each stages one bucket
+        per step), and ``stats()`` reports the per-replica versions —
+        the publisher's staleness gate bounds how far they may trail
+        the trainer."""
+        from tpu_ddp.publish.subscriber import attach
+        return attach(publisher, self, name="replica")
+
     # ---- introspection -------------------------------------------------
 
     def outstanding(self) -> int:
@@ -383,6 +400,9 @@ class Router:
             prefix = getattr(r, "prefix", None)
             if prefix is not None:
                 s["prefix"] = prefix.stats()
+            if getattr(r, "subscriber", None) is not None:
+                s["param_version"] = r.param_version
+                s["publish_lag"] = r.subscriber.lag
             per.append(s)
         return {"policy": self.policy,
                 "n_replicas": len(self.replicas),

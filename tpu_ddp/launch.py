@@ -707,6 +707,22 @@ def main(argv=None) -> int:
                    help="shed a queued request that has not started "
                         "prefill after this many ms (0 disables). Sets "
                         "TPU_DDP_SERVE_SHED_MS for every rank")
+    p.add_argument("--publish-every", type=int, default=None,
+                   help="publish a versioned weight update to "
+                        "subscribed serving engines every this many "
+                        "trainer steps (0 = off). Sets "
+                        "TPU_DDP_PUBLISH_EVERY for every rank")
+    p.add_argument("--publish-wire", default=None,
+                   choices=("none", "bf16", "int8"),
+                   help="wire format for pushed weight deltas "
+                        "(tpu_ddp/publish/): dense f32, bf16, or "
+                        "error-feedback int8. Sets TPU_DDP_PUBLISH_WIRE "
+                        "for every rank")
+    p.add_argument("--publish-max-staleness", type=int, default=None,
+                   help="steps the trainer may run ahead of the "
+                        "slowest subscriber before publishing blocks "
+                        "(0 = unbounded). Sets "
+                        "TPU_DDP_PUBLISH_MAX_STALENESS for every rank")
     p.add_argument("--autotune", default=None,
                    choices=("off", "cached", "search"),
                    help="perf-knob autotuning (tpu_ddp/tune/): 'cached' "
@@ -777,6 +793,19 @@ def main(argv=None) -> int:
             p.error(f"--serve-shed-ms must be >= 0, "
                     f"got {args.serve_shed_ms}")
         env["TPU_DDP_SERVE_SHED_MS"] = str(args.serve_shed_ms)
+    if args.publish_every is not None:
+        if args.publish_every < 0:
+            p.error(f"--publish-every must be >= 0, "
+                    f"got {args.publish_every}")
+        env["TPU_DDP_PUBLISH_EVERY"] = str(args.publish_every)
+    if args.publish_wire is not None:
+        env["TPU_DDP_PUBLISH_WIRE"] = args.publish_wire
+    if args.publish_max_staleness is not None:
+        if args.publish_max_staleness < 0:
+            p.error(f"--publish-max-staleness must be >= 0, "
+                    f"got {args.publish_max_staleness}")
+        env["TPU_DDP_PUBLISH_MAX_STALENESS"] = \
+            str(args.publish_max_staleness)
     if args.autotune is not None:
         env["TPU_DDP_AUTOTUNE"] = args.autotune
     if args.audit is not None:

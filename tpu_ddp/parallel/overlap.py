@@ -158,6 +158,17 @@ class BucketPlan:
                 "bucket_bytes": [s * 4 for s in sizes],
                 "bucket_leaf_counts": [len(b) for b in self.buckets]}
 
+    def fingerprint(self) -> tuple:
+        """Hashable layout identity: leaf shapes/dtypes + the bucket
+        cuts. Two plans with equal fingerprints partition equal-layout
+        trees identically — the weight-streaming publisher compares
+        fingerprints to detect a layout change (→ full-tensor push)
+        and subscribers reject updates built against a foreign layout
+        (tpu_ddp/publish/)."""
+        return (tuple((m.shape, str(np.dtype(m.dtype)))
+                      for m in self.metas),
+                self.buckets)
+
 
 class OverlapSync:
     """Bucketed in-backward gradient sync for one replicated rung.

@@ -1,0 +1,35 @@
+"""Live train→serve weight streaming (docs/DESIGN.md §24).
+
+``store``      — params as a versioned, atomically-swappable resource
+``publisher``  — trainer-side snapshot → delta → bucket → compress → ship
+``subscriber`` — engine-side staged apply + atomic version flip
+``rollout``    — the closed generate → score → train → publish loop
+"""
+
+from tpu_ddp.publish.publisher import PUBLISH_WIRES, Publisher, WeightUpdate
+from tpu_ddp.publish.rollout import (
+    Rollout,
+    make_prompts,
+    run_online_loop,
+)
+from tpu_ddp.publish.store import (
+    StaleVersionError,
+    VersionedParams,
+    tree_digests,
+)
+from tpu_ddp.publish.subscriber import Subscriber, apply_delta, attach
+
+__all__ = [
+    "PUBLISH_WIRES",
+    "Publisher",
+    "Rollout",
+    "StaleVersionError",
+    "Subscriber",
+    "VersionedParams",
+    "WeightUpdate",
+    "apply_delta",
+    "attach",
+    "make_prompts",
+    "run_online_loop",
+    "tree_digests",
+]

@@ -1,0 +1,411 @@
+"""Trainer-side weight streaming: snapshot, delta, bucket, compress,
+ship.
+
+Every ``publish_every`` trainer steps the publisher snapshots the
+params in CANONICAL host form (``params_to_host`` — the same portable
+seam checkpoints and live resharding use, so any training strategy
+feeds any serving layout), diffs them against the last *published*
+reconstruction, chunks the delta along :class:`BucketPlan` bucket
+boundaries (parallel/overlap.py — the same size-targeted partition the
+in-backward gradient sync uses), and compresses each bucket with the
+:class:`EdgeCodec` wire formats (parallel/compress.py, ``none`` /
+``bf16`` / ``int8``).
+
+Two invariants make lossy wires safe along a trajectory:
+
+- **Reconstruction tracking** — the publisher's baseline for the next
+  delta is what the SUBSCRIBERS decoded (``last + decode(encode(new -
+  last))``), never the raw trainer params. Publisher and subscriber
+  therefore hold bitwise-identical trees at every version (pinned by
+  the per-leaf sha256 digests each update carries), and quantization
+  error never compounds silently.
+- **Error feedback** — the ``int8`` wire rides the EF variant: each
+  push's quantization error is carried into the next delta (per
+  bucket, like the per-edge MPMD residuals), so the served weights
+  converge to the trained ones instead of random-walking away.
+
+Full-tensor fallback: the first push, or any bucket-layout change
+(leaf shapes/dtypes — a resumed trainer with a different model), ships
+full values instead of deltas and resets the per-bucket codecs.
+
+Staleness: ``max_staleness_steps`` bounds how far training may run
+ahead of the slowest subscriber (measured in trainer steps since the
+oldest unapplied publish). ``after_step`` — the train-loop hook —
+blocks at the gate, pumping local subscribers when attached in-process
+(a stalled push is a *delay*: it flushes when the gate drains).
+
+Chaos (resilience/chaos.py, ``TPU_DDP_CHAOS_FAULTS``):
+``publisher-death@N`` kills the publisher at its N-th push (nothing
+further is delivered; subscribers are notified and keep serving their
+last-good version); ``push-stall@N`` holds the N-th push undelivered
+until the staleness gate flushes it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_ddp.parallel.compress import EdgeCodec
+from tpu_ddp.parallel.overlap import BucketPlan
+from tpu_ddp.publish.store import tree_digests
+
+PUBLISH_WIRES = ("none", "bf16", "int8")
+
+
+@dataclasses.dataclass(frozen=True)
+class WeightUpdate:
+    """One push on the weight-streaming edge: per-bucket wire payloads
+    plus the metadata a subscriber needs to verify and flip."""
+
+    version: int           # monotonic publish id (1-based)
+    step: int              # trainer step the snapshot was taken at
+    kind: str              # "full" (first push / layout change) | "delta"
+    wires: tuple           # one EdgeCodec wire dict per bucket
+    nbytes: int            # payload bytes actually shipped
+    digests: tuple         # per-leaf sha256 of the POST-apply params
+    layout: tuple          # BucketPlan fingerprint (shapes/dtypes/cuts)
+    bucket_mb: float       # plan parameter (subscriber rebuilds plan)
+    strategy: str          # source ShardingPlan strategy (provenance)
+
+
+def _build_pack(plan: BucketPlan):
+    """The push-side jitted program: per-bucket f32 deltas, flattened
+    and concatenated at the plan's boundaries. Module-path function
+    (named ``push_pack``) so ``no_retrace`` can watch its compiles and
+    graph_audit can register its lowering."""
+
+    def push_pack(new_leaves, last_leaves):
+        out = []
+        for idxs in plan.buckets:
+            parts = [(new_leaves[i].astype(jnp.float32)
+                      - last_leaves[i].astype(jnp.float32)).reshape(-1)
+                     for i in idxs]
+            out.append(parts[0] if len(parts) == 1
+                       else jnp.concatenate(parts))
+        return tuple(out)
+
+    return jax.jit(push_pack)
+
+
+class Publisher:
+    """The trainer side of the weight-streaming edge.
+
+    Knob defaults come from ``TrainConfig`` (``TPU_DDP_PUBLISH_EVERY``
+    / ``TPU_DDP_PUBLISH_WIRE`` / ``TPU_DDP_PUBLISH_MAX_STALENESS``,
+    registered in tune/space.py); explicit arguments win.
+    ``publish_every == 0`` leaves the publisher inert (``maybe_publish``
+    is a no-op) — the live-streaming analogue of ``ckpt_every_iters=0``.
+    """
+
+    def __init__(self, trainer=None, *, publish_every: int | None = None,
+                 wire: str | None = None,
+                 max_staleness_steps: int | None = None,
+                 bucket_mb: float = 4, config=None):
+        if config is None:
+            from tpu_ddp.utils.config import TrainConfig
+            config = TrainConfig()
+        self.trainer = trainer
+        self.publish_every = int(publish_every if publish_every is not None
+                                 else config.publish_every)
+        self.wire = str(wire if wire is not None else config.publish_wire)
+        self.max_staleness_steps = int(
+            max_staleness_steps if max_staleness_steps is not None
+            else config.max_staleness_steps)
+        if self.publish_every < 0:
+            raise ValueError("publish_every must be >= 0")
+        if self.wire not in PUBLISH_WIRES:
+            raise ValueError(f"publish_wire={self.wire!r}: expected "
+                             "none|bf16|int8")
+        if self.max_staleness_steps < 0:
+            raise ValueError("max_staleness_steps must be >= 0")
+        self.bucket_mb = bucket_mb
+        self.subscribers: list = []
+        self.version = 0
+        self.dead = False
+        # In-process catch-up hook: attach() points this at the
+        # subscribed engines' step() so the staleness gate can pump
+        # them instead of sleeping (a real deployment leaves it None).
+        self.drive = None
+        self._plan = None
+        self._pack = None
+        self._codecs = None
+        self._treedef = None
+        self._last = None            # reconstruction leaves (host np)
+        self._push_n = 0
+        self._version_steps: dict = {}   # version -> trainer step
+        self._stalled: list = []
+        self.full_pushes = 0
+        self.delta_pushes = 0
+        self.stalls = 0
+        self.deaths = 0
+        self.gate_blocks = 0
+        self.stall_events = 0
+        self.chaos = None
+        from tpu_ddp.fleet.resilience import (ServeFaultInjector,
+                                              serve_chaos_active)
+        if serve_chaos_active():
+            self.chaos = ServeFaultInjector.from_env()
+
+    # ---- wiring --------------------------------------------------------
+
+    def connect(self, subscriber) -> None:
+        self.subscribers.append(subscriber)
+
+    # ---- snapshot / plan -----------------------------------------------
+
+    def _snapshot(self, state):
+        """Canonical host-numpy params for ``state`` — the portable
+        form any training strategy can produce (fused/ZeRO/FSDP/
+        pipeline all land here via their trainer's params_to_host)."""
+        if self.trainer is not None \
+                and hasattr(self.trainer, "params_to_host"):
+            return self.trainer.params_to_host(state)
+        return jax.tree.map(np.asarray, state.params)
+
+    def ensure_plan(self, host_params) -> BucketPlan:
+        """(Re)build the bucket plan + pack program + per-bucket codecs
+        for ``host_params``'s layout. Idempotent while the layout holds;
+        a layout change resets everything (next push goes full)."""
+        plan = BucketPlan(host_params, self.bucket_mb)
+        if self._plan is not None \
+                and plan.fingerprint() == self._plan.fingerprint():
+            return self._plan
+        self._plan = plan
+        self._pack = _build_pack(plan)
+        # int8 rides ERROR FEEDBACK here (unlike the one-shot KV edge):
+        # deltas form a trajectory, and the residual is what keeps the
+        # served weights converging to the trained ones. One codec per
+        # bucket — each carries its own residual, sized to its payload.
+        self._codecs = tuple(
+            EdgeCodec(self.wire, seed=b) for b in range(plan.n_buckets))
+        self._treedef = plan.treedef
+        self._last = None
+        return plan
+
+    def lower_push_step(self):
+        """``jit.lower`` the pack program at the plan's leaf shapes —
+        the push-side graph-audit surface. Requires a plan (publish
+        once, or call :meth:`ensure_plan` with a params template)."""
+        if self._plan is None:
+            raise ValueError("no bucket plan yet: publish once or call "
+                             "ensure_plan(params) first")
+        sds = tuple(jax.ShapeDtypeStruct(m.shape, m.dtype)
+                    for m in self._plan.metas)
+        return self._pack.lower(sds, sds)
+
+    # ---- publishing ----------------------------------------------------
+
+    def maybe_publish(self, state, step: int | None = None):
+        """The ``publish_every`` cadence: publish when due, else None."""
+        if not self.publish_every or self.dead:
+            return None
+        step = int(state.step if step is None else step)
+        if step % self.publish_every:
+            return None
+        return self.publish(state=state, step=step)
+
+    def publish(self, state=None, step: int | None = None, *,
+                params=None):
+        """Snapshot → delta → bucket → compress → deliver. Returns the
+        :class:`WeightUpdate` (None when chaos killed the publisher).
+        ``params`` (a host tree) bypasses the trainer snapshot — the
+        drills and sweeps push synthetic trees through the real path."""
+        self._push_n += 1
+        if self.dead:
+            return None
+        if self.chaos is not None \
+                and self.chaos.publisher_death_fires(self._push_n):
+            self.dead = True
+            self.deaths += 1
+            warnings.warn(
+                f"chaos: publisher died at push {self._push_n}; "
+                "subscribers keep serving their last-good version",
+                stacklevel=2)
+            for s in self.subscribers:
+                s.publisher_lost()
+            return None
+        if params is None:
+            params = self._snapshot(state)
+        step = int(state.step if step is None and state is not None
+                   else (step or 0))
+        host = jax.tree.map(np.asarray, params)
+        plan = self.ensure_plan(host)
+        new_leaves = jax.tree.leaves(host)
+        if self._last is None:
+            update = self._publish_full(plan, new_leaves, step)
+            self.full_pushes += 1
+        else:
+            update = self._publish_delta(plan, new_leaves, step)
+            self.delta_pushes += 1
+        self._version_steps[update.version] = step
+        if self.chaos is not None \
+                and self.chaos.push_stall_fires(self._push_n):
+            warnings.warn(
+                f"chaos: push of version {update.version} stalled in "
+                "flight; delivery is delayed, not lost",
+                stacklevel=2)
+            self.stalls += 1
+            self._stalled.append(update)
+            return update
+        if self._stalled:
+            # Deliveries are ordered: a push behind a stalled one must
+            # not overtake it (the subscriber would reject the gap).
+            # The next successful push is also when the stalled one
+            # clears — a stall is a transport delay, and the transport
+            # just demonstrated recovery.
+            self._flush_stalled()
+        self._deliver(update)
+        return update
+
+    def _publish_full(self, plan, new_leaves, step) -> WeightUpdate:
+        """Full-tensor push: first contact and layout changes. Resets
+        the per-bucket codecs (a fresh baseline owes no residual)."""
+        for c in self._codecs:
+            c.reset()
+        wires, nbytes, recon = [], 0, [None] * len(plan.metas)
+        for b, idxs in enumerate(plan.buckets):
+            payload = np.concatenate(
+                [np.asarray(new_leaves[i], np.float32).ravel()
+                 for i in idxs])
+            wire, n = self._codecs[b].encode(payload)
+            wires.append(wire)
+            nbytes += n
+            dec = np.asarray(EdgeCodec.decode(wire), np.float32)
+            off = 0
+            for i in idxs:
+                m = plan.metas[i]
+                recon[i] = dec[off:off + m.size].reshape(
+                    m.shape).astype(m.dtype)
+                off += m.size
+        return self._finish(plan, recon, "full", wires, nbytes, step)
+
+    def _publish_delta(self, plan, new_leaves, step) -> WeightUpdate:
+        """Delta push along the trajectory: pack on device (the jitted
+        ``push_pack`` program), encode per bucket, and advance the
+        reconstruction by the DECODED delta — exactly what every
+        subscriber computes, so both ends stay bitwise equal."""
+        payloads = self._pack(tuple(new_leaves), tuple(self._last))
+        wires, nbytes, recon = [], 0, [None] * len(plan.metas)
+        for b, idxs in enumerate(plan.buckets):
+            wire, n = self._codecs[b].encode(np.asarray(payloads[b]))
+            wires.append(wire)
+            nbytes += n
+            dec = np.asarray(EdgeCodec.decode(wire), np.float32)
+            off = 0
+            for i in idxs:
+                m = plan.metas[i]
+                d = dec[off:off + m.size].reshape(m.shape)
+                recon[i] = (np.asarray(self._last[i], np.float32)
+                            + d).astype(m.dtype)
+                off += m.size
+        return self._finish(plan, recon, "delta", wires, nbytes, step)
+
+    def _finish(self, plan, recon, kind, wires, nbytes,
+                step) -> WeightUpdate:
+        self._last = recon
+        self.version += 1
+        tree = jax.tree.unflatten(self._treedef, recon)
+        strategy = "none"
+        if self.trainer is not None \
+                and hasattr(self.trainer, "sharding_plan"):
+            strategy = self.trainer.sharding_plan().strategy
+        return WeightUpdate(
+            version=self.version, step=step, kind=kind,
+            wires=tuple(wires), nbytes=int(nbytes),
+            digests=tree_digests(tree), layout=plan.fingerprint(),
+            bucket_mb=self.bucket_mb, strategy=strategy)
+
+    def _deliver(self, update) -> None:
+        for s in self.subscribers:
+            s.deliver(update)
+
+    def _flush_stalled(self) -> None:
+        stalled, self._stalled = self._stalled, []
+        self.stall_events += len(stalled)
+        for update in stalled:
+            warnings.warn(
+                f"publish: stalled push of version {update.version} "
+                "cleared; delivering", stacklevel=3)
+            self._deliver(update)
+
+    # ---- staleness gate ------------------------------------------------
+
+    def staleness(self, step: int) -> int:
+        """Trainer steps since the oldest publish the SLOWEST
+        subscriber has not applied yet (0 when everyone is current)."""
+        if not self.subscribers or not self._version_steps:
+            return 0
+        slowest = min(s.applied_version for s in self.subscribers)
+        pending = [s for v, s in self._version_steps.items()
+                   if v > slowest]
+        if not pending:
+            # Everyone is current; drop the applied-version history.
+            self._version_steps = {self.version:
+                                   self._version_steps[self.version]}
+            return 0
+        return max(0, int(step) - min(pending))
+
+    def gate(self, step: int) -> bool:
+        """False when training must pause for subscribers to catch up
+        (``max_staleness_steps == 0`` disables the gate)."""
+        if not self.max_staleness_steps:
+            return True
+        return self.staleness(step) <= self.max_staleness_steps
+
+    def wait_until_fresh(self, step: int, drive=None,
+                         timeout_s: float = 5.0) -> int:
+        """Block until the gate opens: flush stalled pushes (a stall
+        is a delay, not a loss), pump ``drive`` (attached in-process
+        engines) or sleep, and bail with a warning after ``timeout_s``
+        — a dead fleet must degrade training, never deadlock it."""
+        drive = drive if drive is not None else self.drive
+        if self.gate(step):
+            return 0
+        self.gate_blocks += 1
+        spins = 0
+        t0 = time.perf_counter()
+        while not self.gate(step):
+            if self._stalled:
+                self._flush_stalled()
+            if drive is not None:
+                drive()
+            else:
+                time.sleep(1e-3)
+            spins += 1
+            if time.perf_counter() - t0 > timeout_s:
+                warnings.warn(
+                    f"publish: subscribers still "
+                    f"{self.staleness(step)} steps stale after "
+                    f"{timeout_s:.1f}s; proceeding", stacklevel=2)
+                break
+        return spins
+
+    def after_step(self, state, step: int) -> None:
+        """The train-loop hook (train/engine.py train_epoch, the
+        rollout loop): publish on cadence, then respect the gate."""
+        self.maybe_publish(state, step)
+        if self.max_staleness_steps:
+            self.wait_until_fresh(step)
+
+    # ---- stats ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        sent = sum(c.bytes_sent for c in self._codecs or ())
+        dense = sum(c.bytes_dense for c in self._codecs or ())
+        return {"wire": self.wire, "version": self.version,
+                "full_pushes": self.full_pushes,
+                "delta_pushes": self.delta_pushes,
+                "bytes_sent": sent, "bytes_dense": dense,
+                "ratio": dense / sent if sent else 1.0,
+                "stalls": self.stalls, "stall_events": self.stall_events,
+                "gate_blocks": self.gate_blocks, "deaths": self.deaths,
+                "subscribers": len(self.subscribers)}
+
+
+__all__ = ["PUBLISH_WIRES", "Publisher", "WeightUpdate"]

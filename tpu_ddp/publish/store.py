@@ -1,0 +1,105 @@
+"""Versioned parameter store: params as a swappable resource.
+
+The round-12 engines took params as a constructor argument and never
+touched them again; weight streaming (veScale, arxiv 2509.07003) needs
+the opposite — parameters as a *versioned resource* that an engine can
+atomically flip while requests are in flight. This module is the
+resource half of the contract:
+
+- **monotonic version ids** — every committed tree carries the version
+  the publisher stamped it with; commits must strictly increase, so a
+  reordered or replayed push can never roll a subscriber backwards.
+- **last-good retention** — the previously committed version survives
+  each commit as a HOST-side copy (device buffers of the old version
+  are donated into the swap, see publish/subscriber.py, so retention
+  on-device would force a copy). A corrupt push rolls back to it.
+- **per-leaf sha256 digests** — the same ``leaf_digest`` the verified
+  checkpoints ride (resilience/integrity.py): the publisher digests
+  its post-push reconstruction, the subscriber digests its staged
+  tree, and a flip only commits when they agree bitwise.
+
+The store itself is engine-agnostic: it holds trees and versions. The
+engine coupling (flip between decode steps, never mid-forward) lives
+in :class:`tpu_ddp.publish.subscriber.Subscriber`.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from tpu_ddp.resilience.integrity import leaf_digest
+
+
+def tree_digests(tree) -> tuple:
+    """Per-leaf sha256 digests in ``jax.tree.flatten`` order — the
+    checkpoint-integrity primitive applied leaf-by-leaf to a live
+    tree. Publisher and subscriber both digest their own copy; equal
+    tuples mean bitwise-identical parameters."""
+    return tuple(leaf_digest(x) for x in jax.tree.leaves(tree))
+
+
+class StaleVersionError(ValueError):
+    """A commit tried to move the store backwards (or sideways) in
+    version order — the replayed/reordered-push failure mode."""
+
+
+class VersionedParams:
+    """One engine's parameters as a versioned resource.
+
+    ``live`` is whatever the engine serves from (a device tree);
+    ``host`` is the canonical host-numpy mirror the digests and the
+    delta arithmetic run over. ``commit`` swaps both and retains the
+    previous (version, host) pair as last-good.
+    """
+
+    def __init__(self, live, version: int = 0, host=None):
+        self.live = live
+        self.version = int(version)
+        self.host = (jax.tree.map(np.asarray, live)
+                     if host is None else host)
+        self.digests = tree_digests(self.host)
+        self._last_good = None    # (version, host tree, digests)
+
+    @property
+    def last_good_version(self) -> int | None:
+        return self._last_good[0] if self._last_good else None
+
+    def commit(self, live, version: int, host, digests=None) -> None:
+        """Atomically advance to ``version``. The outgoing version is
+        retained host-side for :meth:`rollback`; versions must be
+        strictly monotonic (a stale push must never be committed)."""
+        version = int(version)
+        if version <= self.version:
+            raise StaleVersionError(
+                f"commit of version {version} onto version "
+                f"{self.version}: versions must strictly increase")
+        self._last_good = (self.version, self.host, self.digests)
+        self.live = live
+        self.host = host
+        self.digests = (tree_digests(host) if digests is None
+                        else tuple(digests))
+        self.version = version
+
+    def rollback(self):
+        """Restore the retained last-good version: returns its
+        ``(version, host_tree)`` for the caller to re-place on device
+        (placement is the subscriber's job — it knows the engine's
+        shardings). Raises when nothing is retained."""
+        if self._last_good is None:
+            raise ValueError("no last-good version retained")
+        version, host, digests = self._last_good
+        self._last_good = None
+        self.live = None
+        self.host = host
+        self.digests = digests
+        self.version = version
+        return version, host
+
+    def verify(self) -> bool:
+        """Recompute the host mirror's digests against the stored
+        ones — the integrity self-check (bit rot / bad apply)."""
+        return tree_digests(self.host) == self.digests
+
+
+__all__ = ["StaleVersionError", "VersionedParams", "tree_digests"]

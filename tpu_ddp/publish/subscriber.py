@@ -1,0 +1,264 @@
+"""Engine-side weight streaming: stage between decode steps, flip
+atomically.
+
+A :class:`Subscriber` sits between one engine (``ServeEngine`` or
+``DisaggEngine``) and a :class:`~tpu_ddp.publish.publisher.Publisher`.
+Delivered updates queue in an inbox; the engine calls
+:meth:`on_engine_step` at the top of every ``step()``, and the
+subscriber decodes AT MOST ONE bucket per call into a host-side
+staging copy — streaming work spread across decode gaps, never a long
+pause. When the last bucket lands, the staged tree's per-leaf sha256
+digests are checked against the update's (the publisher digested its
+own reconstruction — agreement means bitwise-identical params on both
+ends), and only then does the version flip.
+
+The flip is atomic with respect to the token stream: it happens
+BETWEEN engine steps, so an in-flight request samples token ``t`` on
+version N and token ``t+1`` on version N+1 — never a mixed forward.
+Engines stamp every emitted token with the serving version
+(``Request.token_versions``), which is what the atomic-cutover
+assertions in tests and loadgen check.
+
+The staging→live swap does not copy: delta flips run the jitted
+``apply_delta`` program with the old live tree DONATED, so XLA writes
+the new version into the old version's buffers (pinned by
+``donation_report``/``runtime_donation_check`` in tests and the graph
+audit). Last-good retention is therefore HOST-side, in the
+:class:`VersionedParams` store — the donated device buffers are gone
+by design, and rollback re-places the retained host tree.
+
+Rejection paths (all warn + count, never crash serving): a digest
+mismatch, a delta that skips a version (a late joiner or a
+post-rollback subscriber needs a full push — ``Publisher.force_full``)
+and a bucket-layout mismatch all drop the update and keep serving the
+current version.
+"""
+
+from __future__ import annotations
+
+import warnings
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_ddp.parallel.compress import EdgeCodec
+from tpu_ddp.parallel.overlap import BucketPlan
+from tpu_ddp.publish.store import VersionedParams, tree_digests
+
+
+def apply_delta(live, delta):
+    """live tree + f32 delta tree -> next version, per leaf in f32 then
+    cast back — the same arithmetic the publisher's reconstruction and
+    the subscriber's host mirror run in numpy, so device and host stay
+    bitwise equal. ``live`` is donated at the jit boundary."""
+    return jax.tree.map(
+        lambda p, d: (p.astype(jnp.float32) + d).astype(p.dtype),
+        live, delta)
+
+
+# One jitted program for every subscriber (jax.jit caches per input
+# avals/treedef): the staging->live swap. Donating the live tree is
+# what makes the flip zero-copy — the old version's buffers become the
+# new version's.
+_APPLY = jax.jit(apply_delta, donate_argnums=(0,))
+
+
+class Subscriber:
+    """One engine's end of the weight-streaming edge."""
+
+    def __init__(self, engine, name: str = "sub"):
+        self.engine = engine
+        self.name = name
+        self.store = VersionedParams(
+            engine.params, version=getattr(engine, "param_version", 0))
+        self._inbox: deque = deque()
+        self._staging = None      # (update, [decoded|None]*B, next_idx)
+        self._plan = None
+        self.applied_version = self.store.version
+        self.applied_step = -1
+        self.applied = 0
+        self.full_applied = 0
+        self.rejected = 0
+        self.publisher_lost_n = 0
+        self.needs_full = False
+
+    # ---- publisher-facing ----------------------------------------------
+
+    def deliver(self, update) -> None:
+        """The wire hop: enqueue; application happens between the
+        engine's decode steps, never here."""
+        self._inbox.append(update)
+
+    def publisher_lost(self) -> None:
+        """The publisher died (chaos or real): keep serving the
+        current (last-good) version, loudly."""
+        self.publisher_lost_n += 1
+        warnings.warn(
+            f"publish[{self.name}]: publisher lost; continuing to "
+            f"serve version {self.applied_version}", stacklevel=3)
+
+    @property
+    def lag(self) -> int:
+        """Updates delivered but not yet fully applied."""
+        return len(self._inbox) + (1 if self._staging else 0)
+
+    # ---- engine-facing -------------------------------------------------
+
+    def on_engine_step(self) -> None:
+        """Called by the engine at the top of ``step()``: decode at
+        most one bucket into staging; flip when the update completes.
+        Bounded work per call — streaming never stalls the bank."""
+        if self._staging is None:
+            if not self._inbox:
+                return
+            update = self._inbox.popleft()
+            if not self._admit(update):
+                return
+            self._staging = (update, [None] * len(update.wires), 0)
+        update, decoded, b = self._staging
+        decoded[b] = np.asarray(
+            EdgeCodec.decode(update.wires[b]), np.float32)
+        if b + 1 < len(decoded):
+            self._staging = (update, decoded, b + 1)
+            return
+        self._staging = None
+        self._flip(update, decoded)
+
+    def _admit(self, update) -> bool:
+        """Order + layout checks before any decode work."""
+        if update.kind == "delta" \
+                and (self.needs_full
+                     or update.version != self.applied_version + 1):
+            self.rejected += 1
+            self.needs_full = True
+            warnings.warn(
+                f"publish[{self.name}]: delta for version "
+                f"{update.version} does not extend applied version "
+                f"{self.applied_version}; dropped (a full push "
+                "resyncs)", stacklevel=3)
+            return False
+        if self._plan is None \
+                or self._plan.fingerprint() != update.layout:
+            plan = BucketPlan(self.store.host, update.bucket_mb)
+            if plan.fingerprint() != update.layout:
+                self.rejected += 1
+                warnings.warn(
+                    f"publish[{self.name}]: update layout does not "
+                    "match this engine's parameters; dropped",
+                    stacklevel=3)
+                return False
+            self._plan = plan
+        return True
+
+    # ---- the flip ------------------------------------------------------
+
+    def _flip(self, update, decoded) -> None:
+        plan = self._plan
+        old_host = jax.tree.leaves(self.store.host)
+        new_host = [None] * len(plan.metas)
+        delta = [None] * len(plan.metas)
+        for b, idxs in enumerate(plan.buckets):
+            off = 0
+            for i in idxs:
+                m = plan.metas[i]
+                d = decoded[b][off:off + m.size].reshape(m.shape)
+                off += m.size
+                if update.kind == "full":
+                    new_host[i] = d.astype(m.dtype)
+                else:
+                    delta[i] = d
+                    new_host[i] = (np.asarray(old_host[i], np.float32)
+                                   + d).astype(m.dtype)
+        host_tree = jax.tree.unflatten(plan.treedef, new_host)
+        if tree_digests(host_tree) != update.digests:
+            self.rejected += 1
+            self.needs_full = True
+            warnings.warn(
+                f"publish[{self.name}]: digest mismatch on version "
+                f"{update.version}; keeping last-good version "
+                f"{self.applied_version}", stacklevel=3)
+            return
+        live = self.engine.params
+        shardings = jax.tree.map(lambda x: x.sharding, live)
+        if update.kind == "full":
+            new_live = jax.tree.map(
+                jax.device_put, host_tree, shardings)
+        else:
+            delta_tree = jax.tree.unflatten(plan.treedef, delta)
+            delta_dev = jax.tree.map(
+                jax.device_put, delta_tree, shardings)
+            # Drop every live reference before the donating call so
+            # the staging->live swap aliases instead of copying.
+            self.engine.params = None
+            self.store.live = None
+            new_live = _APPLY(live, delta_dev)
+            del live
+        self.store.commit(new_live, update.version, host_tree,
+                          update.digests)
+        self.engine.swap_params(new_live, update.version)
+        self.applied_version = update.version
+        self.applied_step = update.step
+        self.applied += 1
+        if update.kind == "full":
+            self.full_applied += 1
+            self.needs_full = False
+
+    def rollback(self) -> int:
+        """Re-place the retained last-good version and serve it. The
+        next delta is rejected until a full push resyncs."""
+        version, host = self.store.rollback()
+        shardings = jax.tree.map(lambda x: x.sharding,
+                                 self.engine.params)
+        live = jax.tree.map(jax.device_put, host, shardings)
+        self.store.live = live
+        self.engine.swap_params(live, version)
+        self.applied_version = version
+        self.needs_full = True
+        return version
+
+    def lower_apply_step(self):
+        """``jit.lower`` the donating apply program at this engine's
+        param shapes — the apply-side graph-audit surface."""
+        sds = lambda x: jax.ShapeDtypeStruct(  # noqa: E731
+            jnp.shape(x), jnp.result_type(x))
+        live = jax.tree.map(sds, self.engine.params)
+        delta = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.float32),
+            self.engine.params)
+        return _APPLY.lower(live, delta)
+
+    def stats(self) -> dict:
+        return {"name": self.name, "version": self.applied_version,
+                "step": self.applied_step, "applied": self.applied,
+                "full_applied": self.full_applied,
+                "rejected": self.rejected, "lag": self.lag,
+                "publisher_lost": self.publisher_lost_n,
+                "last_good": self.store.last_good_version}
+
+
+def attach(publisher, target, name: str = "sub") -> list:
+    """Wire ``target`` onto ``publisher``'s edge. ``target`` is one
+    engine, or a fleet Router — then every replica gets its own
+    subscriber (fleet-wide version fan-out: one publish reaches all
+    replicas; ``Router.stats()`` reports the per-replica versions).
+    Also points the publisher's in-process catch-up hook at the
+    subscribed engines so the staleness gate can pump them."""
+    engines = getattr(target, "replicas", None) or [target]
+    subs = []
+    for i, eng in enumerate(engines):
+        sub = Subscriber(
+            eng, name=f"{name}{i}" if len(engines) > 1 else name)
+        eng.subscriber = sub
+        publisher.connect(sub)
+        subs.append(sub)
+    if publisher.drive is None:
+        def drive(engines=tuple(engines)):
+            for eng in engines:
+                eng.step()
+        publisher.drive = drive
+    return subs
+
+
+__all__ = ["Subscriber", "apply_delta", "attach"]

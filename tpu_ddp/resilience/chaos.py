@@ -78,9 +78,19 @@ FAULT_KINDS = ("hard-exit", "nan-grad", "stalled-step", "corrupt-ckpt",
 #                           chunked prefill of the lost transfer
 # ``nonfinite-logits``      in-graph detection + per-request quarantine
 #                           (the decode analog of StepGuard)
+# ``publisher-death``       weight-streaming publisher dies mid-run:
+#                           subscribers keep serving the last-good
+#                           version (warned + counted, never crashed)
+# ``push-stall``            a weight push is delayed in flight: the
+#                           trainer's max_staleness_steps gate blocks
+#                           until the stalled update is delivered
 # ========================  =============================================
+#
+# The publish kinds count PUSHES, not engine steps: ``step`` in the
+# spec is the 1-based push ordinal (``publisher-death@2`` kills the
+# publisher on its second publish).
 SERVE_FAULT_KINDS = ("replica-crash", "slow-replica", "edge-drop",
-                     "nonfinite-logits")
+                     "nonfinite-logits", "publisher-death", "push-stall")
 
 CHAOS_ENV = "TPU_DDP_CHAOS_FAULTS"
 

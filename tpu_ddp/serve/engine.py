@@ -56,7 +56,7 @@ from tpu_ddp.models.decode import (
     project_qkv,
     sample_token,
 )
-from tpu_ddp.serve.kv_pool import PagedKVPool
+from tpu_ddp.serve.kv_pool import PagedKVPool, pin_committed
 from tpu_ddp.serve.scheduler import Scheduler
 from tpu_ddp.utils.metrics import MetricsLogger
 
@@ -75,6 +75,11 @@ class Request:
     on_token: Callable[[int], None] | None = None
     tokens: list = dataclasses.field(default_factory=list)
     logprobs: list = dataclasses.field(default_factory=list)
+    # Param version each token was sampled under (tpu_ddp/publish/):
+    # one stamp per token — a weight-stream flip lands BETWEEN engine
+    # steps, so no token ever mixes versions, and the stream's stamps
+    # are non-decreasing (loadgen.assert_atomic_cutover pins both).
+    token_versions: list = dataclasses.field(default_factory=list)
     done: bool = False
     cancelled: bool = False
     shed: bool = False          # dropped by admission control (SLO)
@@ -222,7 +227,7 @@ class ServeEngine:
             from tpu_ddp.utils.config import TrainConfig
             config = TrainConfig()
         self.model = model
-        self.params = jax.tree.map(jnp.asarray, params)
+        self.params = pin_committed(jax.tree.map(jnp.asarray, params))
         self.num_slots = int(num_slots if num_slots is not None
                              else config.serve_slots)
         self.block_size = int(block_size if block_size is not None
@@ -280,6 +285,14 @@ class ServeEngine:
         if self.shed_ms < 0:
             raise ValueError("shed_ms must be >= 0")
         self._step_n = 0
+        # Weight streaming (tpu_ddp/publish/): the served version id
+        # and the subscriber that advances it. ``swap_params`` is the
+        # ONLY mutation path for ``self.params`` after construction —
+        # both jitted step programs take params as an ARGUMENT (never
+        # closed over, never donated), so a shape/dtype-identical swap
+        # reuses the compiled programs by construction.
+        self.param_version = 0
+        self.subscriber = None
         self.chaos = None
         from tpu_ddp.fleet.resilience import (
             ServeFaultInjector, serve_chaos_active)
@@ -423,6 +436,12 @@ class ServeEngine:
             # May raise ReplicaCrashError — BEFORE any state mutation,
             # so a router-harvested engine is always consistent.
             self.chaos.replica_step(self._step_n)
+        if self.subscriber is not None:
+            # Weight streaming: stage at most one delta bucket, flip
+            # the version when an update completes — BETWEEN steps, so
+            # a flip is atomic at token granularity (the token this
+            # step samples is entirely on the flipped-to version).
+            self.subscriber.on_engine_step()
         self._shed_expired()
         admitted = self.sched.admit()
         for _ in admitted:
@@ -454,6 +473,16 @@ class ServeEngine:
                 break
             n += 1
         return n
+
+    def swap_params(self, params, version: int) -> None:
+        """Atomically flip the served weights to ``params`` at
+        ``version`` (tpu_ddp/publish/subscriber.py calls this between
+        steps). The tree must match the current layout bitwise in
+        shapes/dtypes — then both compiled step programs are reused
+        as-is (params are a jit *argument*, pinned by the no-retrace
+        test), and the very next decode step samples on ``version``."""
+        self.params = params
+        self.param_version = int(version)
 
     # ---- router hooks --------------------------------------------------
 
@@ -601,6 +630,7 @@ class ServeEngine:
         s.pending_token = tok
         req.tokens.append(tok)
         req.logprobs.append(logprob)
+        req.token_versions.append(self.param_version)
         now = time.perf_counter()
         if req.first_token_at is None:
             req.first_token_at = now

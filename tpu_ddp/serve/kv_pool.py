@@ -45,10 +45,22 @@ from __future__ import annotations
 
 import math
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from tpu_ddp.memory.policy import resolve_act_dtype
+
+
+def pin_committed(tree):
+    """``device_put`` every leaf onto its own sharding — a no-move
+    commit. jit cache keys distinguish committed from uncommitted
+    arguments, and a weight-streaming flip (publish/subscriber.py)
+    always yields committed params; engine state that starts
+    uncommitted would therefore force a one-time recompile of the step
+    programs on the first request after a flip. Pinning at
+    construction keeps one cache key for the engine's whole life."""
+    return jax.tree.map(lambda x: jax.device_put(x, x.sharding), tree)
 
 
 class PagedKVPool:
@@ -76,8 +88,8 @@ class PagedKVPool:
         self.dtype = resolve_act_dtype(cache_dtype, model.compute_dtype)
         shape = (model.num_layers, num_blocks, block_size,
                  model.kv_heads, model.head_dim)
-        self.k = jnp.zeros(shape, self.dtype)
-        self.v = jnp.zeros(shape, self.dtype)
+        self.k = pin_committed(jnp.zeros(shape, self.dtype))
+        self.v = pin_committed(jnp.zeros(shape, self.dtype))
         # LIFO free list: recently-freed (still-hot) pages are reused
         # first. Block 0 is never a member.
         self._free = list(range(num_blocks - 1, 0, -1))

@@ -105,6 +105,29 @@ def poisson_arrivals(n: int, rate: float, seed: int = 0) -> np.ndarray:
     return np.cumsum(rng.exponential(1.0 / rate, size=n))
 
 
+def assert_atomic_cutover(requests) -> None:
+    """Pin the weight-streaming cutover contract (docs/DESIGN.md §24)
+    over finished requests: every token carries exactly one param
+    version stamp, and stamps never decrease within a request — a
+    request may SPAN versions (token t on N, token t+1 on N+1) but no
+    token is ever produced by a mixed forward, and an engine never
+    steps backwards through versions mid-request."""
+    for req in requests:
+        vers = getattr(req, "token_versions", None)
+        if vers is None:
+            continue
+        if len(vers) != len(req.tokens):
+            raise AssertionError(
+                f"request {getattr(req, 'rid', '?')}: "
+                f"{len(req.tokens)} tokens but {len(vers)} version "
+                "stamps — a token sampled without a version")
+        for a, b in zip(vers, vers[1:]):
+            if b < a:
+                raise AssertionError(
+                    f"request {getattr(req, 'rid', '?')}: param "
+                    f"version went backwards ({a} -> {b}) mid-request")
+
+
 def run_load(engine, specs: list[RequestSpec], rate: float,
              seed: int = 0, slo_ttft_ms: float | None = None) -> dict:
     """Drive ``engine`` with ``specs`` arriving Poisson at ``rate``;
@@ -157,6 +180,16 @@ def run_load(engine, specs: list[RequestSpec], rate: float,
                       / (len(h.tokens) - 1)
                       for h in completed if len(h.tokens) > 1]) * 1e3
     makespan = t_end - t0
+    # Weight-streaming provenance (tpu_ddp/publish/): each completed
+    # request reports the param version(s) its tokens sampled under,
+    # and the atomic-cutover contract is asserted on every run — a
+    # live-published run that violated it would fail its benchmark.
+    assert_atomic_cutover(completed)
+    all_vers = [v for h in completed
+                for v in getattr(h, "token_versions", ())]
+    n_spanning = sum(
+        1 for h in completed
+        if len(set(getattr(h, "token_versions", ()))) > 1)
     if slo_ttft_ms is None:
         good = n_tokens.sum() if n_tokens.size else 0
         attained = None
@@ -195,6 +228,9 @@ def run_load(engine, specs: list[RequestSpec], rate: float,
                                       else 0) / makespan, 3),
         "slo_ttft_ms": slo_ttft_ms,
         "slo_attained": attained,
+        "param_version_min": (int(min(all_vers)) if all_vers else None),
+        "param_version_max": (int(max(all_vers)) if all_vers else None),
+        "n_version_spanning": int(n_spanning),
         "goodput_tokens_per_sec": round(float(good) / makespan, 3),
     }
 

@@ -275,6 +275,7 @@ class Trainer:
                 stacklevel=2)
         self._overlap = None
         self._sharded_update = None
+        self._publisher = None
         if self._overlap_active:
             self._build_overlap()
         if mesh is not None:
@@ -459,6 +460,26 @@ class Trainer:
         if comp_state is not None:
             tree["comp_state"] = to_np(comp_state)
         return tree
+
+    def params_to_host(self, state: TrainState) -> dict:
+        """Canonical host numpy params only — the snapshot surface the
+        weight-streaming publisher (tpu_ddp/publish/) feeds on every
+        ``publish_every`` steps. A params-only subset of
+        :meth:`state_to_host`: optimizer/compression state never
+        crosses the train→serve boundary."""
+        params = state.params
+        if self.mesh is not None and self.is_fsdp:
+            from tpu_ddp.utils.checkpoint import gather_tree_to_host
+            params = gather_tree_to_host(params, self._repl_sharding)
+        if self.is_fsdp:
+            params = self.zero3.unshard_host(params)
+        return jax.tree.map(np.asarray, params)
+
+    def attach_publisher(self, publisher) -> None:
+        """Hook a :class:`tpu_ddp.publish.Publisher` into the training
+        loop: ``train_epoch`` calls ``publisher.after_step`` once per
+        step (publish on cadence, then block on the staleness gate)."""
+        self._publisher = publisher
 
     def state_from_host(self, host: dict) -> TrainState:
         """Place a canonical host tree onto THIS trainer's mesh, laid
@@ -1507,6 +1528,12 @@ class Trainer:
             # a crash-step checkpoint is always on disk. (Chaos always
             # runs at depth 0, so harv_step is the just-completed step.)
             injector.after_step(harv_step, ckpt_dir)
+            # Weight streaming (tpu_ddp/publish/): publish on cadence,
+            # then block on the staleness gate. Snapshots the CURRENT
+            # state like the checkpoint cadence above — same depth
+            # reasoning applies.
+            if self._publisher is not None:
+                self._publisher.after_step(state, harv_step)
 
         for it, item in enumerate(stream, start=start_iter):
             if cfg.max_iters is not None and it >= cfg.max_iters:

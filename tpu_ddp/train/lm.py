@@ -280,6 +280,26 @@ class _MeshTrainer:
         from tpu_ddp.utils.checkpoint import gather_tree_to_host
         return gather_tree_to_host(tree, NamedSharding(self.mesh, P()))
 
+    def params_to_host(self, state):
+        """Canonical host numpy params only — the snapshot surface the
+        weight-streaming publisher (tpu_ddp/publish/) feeds. Mirrors
+        the params half of :meth:`save_checkpoint`: FSDP unshards to
+        canonical shapes, interleaved pipelines unpermute to dense
+        layer order, so any training layout publishes the same tree."""
+        params = self._gather_to_host(state.params)
+        if getattr(self, "is_fsdp", False):
+            params = self.zero3.unshard_host(params)
+        if hasattr(self, "canonical_params"):
+            params = self.canonical_params(params)
+        return jax.tree.map(np.asarray, params)
+
+    def attach_publisher(self, publisher) -> None:
+        """Scenario loops (tpu_ddp/publish/rollout.py) drive
+        ``publisher.after_step`` directly; this mirror of the engine
+        Trainer hook exists so either trainer slots into launch
+        plumbing unchanged."""
+        self._publisher = publisher
+
     def _to_canonical_host(self, params, opt_state):
         """Trainer layout -> canonical on-disk layout (identity here;
         the interleaved pipeline unpermutes its stacked layer rows)."""

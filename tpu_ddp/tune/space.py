@@ -272,6 +272,26 @@ KNOBS: tuple[Knob, ...] = (
          doc="queue-deadline shedding: a request still waiting (no "
              "prefill started) this many ms after submission is shed "
              "(its TTFT SLO is already lost); 0 disables"),
+    Knob("publish_every", "publish_every", "TPU_DDP_PUBLISH_EVERY",
+         values=(0, 1, 4, 16), flag="--publish-every",
+         objective="goodput",
+         doc="trainer-step cadence for pushing versioned weight "
+             "updates to subscribed serving engines (tpu_ddp/publish/); "
+             "0 = off. More frequent pushes keep served weights "
+             "fresher but spend decode-step time staging buckets"),
+    Knob("publish_wire", "publish_wire", "TPU_DDP_PUBLISH_WIRE",
+         values=("none", "bf16", "int8"), flag="--publish-wire",
+         objective="goodput", semantic=True,
+         doc="wire format for pushed weight deltas (EdgeCodec "
+             "vocabulary). Lossy wires round the served weights, so "
+             "the knob is semantic like kv_wire"),
+    Knob("max_staleness_steps", "max_staleness_steps",
+         "TPU_DDP_PUBLISH_MAX_STALENESS",
+         values=(0, 2, 8), flag="--publish-max-staleness",
+         objective="goodput",
+         doc="steps the trainer may run ahead of the slowest "
+             "subscriber before its publish gate blocks; 0 = "
+             "unbounded (fully async)"),
 )
 
 # Model-level knobs are baked into get_model() before the Trainer ever
@@ -424,6 +444,18 @@ def violations(assignment: Mapping, ctx: Workload) -> list[str]:
             "router_policy='prefix-affinity' without prefix_cache — "
             "every replica reports a zero-length cached prefix, so "
             "routing degenerates to least-loaded (duplicate cell)")
+    # Publish knobs (tpu_ddp/publish/) — mirror Publisher's guards.
+    if get("publish_every", 0) == 0:
+        if get("publish_wire", "none") != "none":
+            bad.append(
+                f"publish_wire={get('publish_wire')!r} with "
+                "publish_every=0 — no push ever encodes, so the cell "
+                "duplicates the default")
+        if get("max_staleness_steps", 0) != 0:
+            bad.append(
+                f"max_staleness_steps={get('max_staleness_steps')} "
+                "with publish_every=0 — the gate only arms on "
+                "publish, so the cell duplicates the default")
     # Pipeline knobs (round 10) — mirror PipelineLMTrainer's guards.
     sched = get("pp_schedule", "gpipe")
     virt = get("pp_virtual", 1)

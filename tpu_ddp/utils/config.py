@@ -240,6 +240,20 @@ class TrainConfig:
     # TPU_DDP_SERVE_SHED_MS.
     serve_shed_ms: float = 0.0
 
+    # Live train->serve weight streaming (tpu_ddp/publish/,
+    # docs/DESIGN.md §24). Publish a versioned weight update to
+    # subscribed serving engines every this many trainer steps
+    # (0 = off). Env: TPU_DDP_PUBLISH_EVERY.
+    publish_every: int = 0
+    # Wire format for the pushed param deltas, riding the same
+    # EdgeCodec vocabulary as kv_wire: "none" (dense f32), "bf16",
+    # "int8" (error-feedback quantization). Env: TPU_DDP_PUBLISH_WIRE.
+    publish_wire: str = "none"
+    # How many steps the trainer may run ahead of the slowest
+    # subscriber's applied version before its publish gate blocks
+    # (0 = unbounded; fully async). Env: TPU_DDP_PUBLISH_MAX_STALENESS.
+    max_staleness_steps: int = 0
+
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
     max_iters: int | None = None
@@ -495,6 +509,29 @@ class TrainConfig:
             raise ValueError(
                 f"serve_shed_ms must be >= 0, got "
                 f"{self.serve_shed_ms} (TPU_DDP_SERVE_SHED_MS)")
+        self.publish_every = _env_num(
+            "TPU_DDP_PUBLISH_EVERY", int, self.publish_every)
+        if self.publish_every < 0:
+            raise ValueError(
+                f"publish_every must be >= 0, got "
+                f"{self.publish_every} (TPU_DDP_PUBLISH_EVERY)")
+        env_pw = os.environ.get("TPU_DDP_PUBLISH_WIRE")
+        if env_pw:
+            self.publish_wire = env_pw
+        # Mirrors publish/publisher.py PUBLISH_WIRES (the publisher
+        # re-validates at construction).
+        if self.publish_wire not in ("none", "bf16", "int8"):
+            raise ValueError(
+                f"publish_wire={self.publish_wire!r}: expected "
+                "none|bf16|int8 (TPU_DDP_PUBLISH_WIRE)")
+        self.max_staleness_steps = _env_num(
+            "TPU_DDP_PUBLISH_MAX_STALENESS", int,
+            self.max_staleness_steps)
+        if self.max_staleness_steps < 0:
+            raise ValueError(
+                f"max_staleness_steps must be >= 0, got "
+                f"{self.max_staleness_steps} "
+                "(TPU_DDP_PUBLISH_MAX_STALENESS)")
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.
