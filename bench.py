@@ -848,6 +848,92 @@ def run_fleet_resilience_probe(n_requests: int = 24) -> dict:
     return out
 
 
+def run_fleet_autoscale_probe(n_boots: int = 5) -> dict:
+    """Autoscale reaction-time probe (tpu_ddp/fleet/autoscale.py,
+    DESIGN.md §25): how fast a scale-up decision becomes a SERVING
+    replica. Boot-from-push (factory engine + ``Publisher.bootstrap``
+    full push, the Autoscaler's path) vs checkpoint restart
+    (``ServeEngine.from_checkpoint``), medians over ``n_boots`` boots
+    on this chip. The recorded claims are ``push_faster`` — the push
+    path must beat the restart — and the structural half: a pushed
+    boot joins at the fleet's CURRENT published version while the
+    restart serves the stale on-disk save and would still need a
+    catch-up push before it matched the fleet."""
+    import shutil
+    import statistics
+    import tempfile
+    import time as _time
+
+    import jax
+
+    from scripts.serve_sweep import build_engine
+    from tpu_ddp.publish.publisher import Publisher
+    from tpu_ddp.publish.subscriber import Subscriber, attach
+    from tpu_ddp.serve import ServeEngine
+    from tpu_ddp.utils.checkpoint import save_checkpoint
+
+    seed_eng = build_engine()
+    model, params = seed_eng.model, seed_eng.params
+    geom = dict(num_slots=seed_eng.num_slots,
+                block_size=seed_eng.block_size,
+                prefill_chunk=seed_eng.prefill_chunk)
+    current = jax.tree.map(lambda x: x + 0.01, params)
+
+    ckpt = tempfile.mkdtemp(prefix="bench-autoscale-ckpt-")
+    try:
+        # The on-disk artifact is a train-time save of the ORIGINAL
+        # params; the fleet has since moved to `current` via the
+        # publisher — exactly the gap a restarted replica wakes into.
+        save_checkpoint(ckpt, {"params": params}, 0)
+        pub = Publisher(publish_every=1, wire="none", bucket_mb=0.25)
+        seed_sub = attach(pub, seed_eng, name="seed")[0]
+        seed_eng.subscriber = seed_sub
+        pub.publish(params=current, step=1)
+        while seed_sub.lag:
+            seed_eng.step()
+
+        def push_boot():
+            t0 = _time.perf_counter()
+            eng = ServeEngine(model, params, **geom)
+            sub = Subscriber(eng, name="boot")
+            eng.subscriber = sub
+            pub.connect(sub)
+            pub.bootstrap(sub)
+            while sub.lag:
+                eng.step()
+            dt = _time.perf_counter() - t0
+            pub.subscribers.remove(sub)
+            return dt, eng
+
+        def ckpt_boot():
+            t0 = _time.perf_counter()
+            eng = ServeEngine.from_checkpoint(model, ckpt, **geom)
+            return _time.perf_counter() - t0, eng
+
+        push_boot(), ckpt_boot()        # warm both paths once
+        push_ts, push_engs = zip(*(push_boot()
+                                   for _ in range(n_boots)))
+        ckpt_ts, ckpt_engs = zip(*(ckpt_boot()
+                                   for _ in range(n_boots)))
+    finally:
+        shutil.rmtree(ckpt, ignore_errors=True)
+    push_med = statistics.median(push_ts)
+    ckpt_med = statistics.median(ckpt_ts)
+    return {
+        "push_boot_s_median": round(push_med, 5),
+        "ckpt_restart_s_median": round(ckpt_med, 5),
+        "push_boot_s": sorted(round(t, 5) for t in push_ts),
+        "ckpt_restart_s": sorted(round(t, 5) for t in ckpt_ts),
+        "push_faster": bool(push_med < ckpt_med),
+        "push_joins_at_current_version": bool(
+            all(e.param_version == pub.version for e in push_engs)),
+        "ckpt_restart_is_stale": bool(
+            all(e.param_version == 0 for e in ckpt_engs)),
+        "publisher_version": pub.version,
+        "bootstraps": pub.bootstraps,
+    }
+
+
 def run_graph_audit_probe() -> dict:
     """Static graph audit (tpu_ddp/analysis/) on THIS backend's
     compiled programs, through the committed sweep's own cell protocol
@@ -1043,6 +1129,10 @@ def main() -> dict:
     # 3 replicas chaos-crashed mid-load vs healthy — the >= 0.55 ratio
     # plus backoff re-admission are the recorded claims.
     extra["fleet_resilience"] = _sub(run_fleet_resilience_probe)
+    # Autoscale probe (fleet/autoscale.py): scale-up reaction time,
+    # boot-from-push vs checkpoint restart — push must be faster AND
+    # join at the fleet's current published version.
+    extra["fleet_autoscale"] = _sub(run_fleet_autoscale_probe)
     # Graph-audit probe (tpu_ddp/analysis/): donation/precision/
     # lockstep-determinism verdicts on this chip's own lowered step
     # programs (TPU schedules emit async collective pairs the CPU

@@ -88,8 +88,14 @@ def build_engine(mode: str = "continuous", **knobs):
     # scripts/serve_chaos_sweep.py and tests/test_fleet_resilience.py.
     geom["queue_limit"] = knobs.pop("serve_queue_limit", 0)
     geom["shed_ms"] = knobs.pop("serve_shed_ms", 0.0)
+    # tenant_classes IS an engine admission parameter (WFQ in the
+    # scheduler); the autoscale knobs are control-plane concerns with
+    # no single-engine meaning — dropped here, exercised by
+    # scripts/fleet_autoscale_sweep.py and tests/test_fleet_autoscale.py.
+    geom["tenant_classes"] = knobs.pop("tenant_classes", None)
     for k in ("fleet_health", "fleet_probe_backoff_ms",
-              "fleet_step_deadline_ms", "fleet_retry_budget"):
+              "fleet_step_deadline_ms", "fleet_retry_budget",
+              "fleet_autoscale", "scale_cooldown_ms"):
         knobs.pop(k, None)
     if roles == "disagg":
         from tpu_ddp.fleet import DisaggEngine

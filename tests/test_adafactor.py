@@ -595,6 +595,8 @@ class TestFactoredZeRO1Partitioned:
         assert spec[0] == MODEL_AXIS and spec[-1] == DATA_AXIS, spec
         assert vr.addressable_shards[0].data.size == vr.size // 4
 
+    @pytest.mark.slow  # the factored-state roundtrip is pinned fast by
+    # TestTrainerIntegration::test_checkpoint_roundtrip; this adds tp
     def test_tp_checkpoint_roundtrip_same_layout(self, devices,
                                                  tmp_path):
         """Per-cell factored state is layout-coupled: the SAME dp x tp

@@ -1,0 +1,464 @@
+"""Autoscaling multi-tenant fleet (tpu_ddp/fleet/autoscale.py,
+tpu_ddp/serve/scheduler.py tenancy, docs/DESIGN.md §25): the replica
+lifecycle control plane plus SLO classes.
+
+The bars are the ones the fleet was built on, now under elasticity:
+
+- **Bitwise parity across lifecycle.** A scale-down drain migrates
+  every unfinished stream via ``continuation_of`` — tokens identical
+  to the undisturbed run, zero dropped, zero shed.
+- **Per-tenant identity.** ``completed + cancelled + shed ==
+  submitted`` holds PER TENANT through mixed cancel/shed/drain storms,
+  and a cancel storm leaves no ghost load in the autoscaler's backlog
+  signal (the regression this PR's Router.cancel fix pins).
+- **Namespace isolation.** Bitwise-identical prompts under different
+  tenants share NOTHING: zero cross-namespace cached tokens, identical
+  output streams.
+- **Zero new jit surfaces.** Booting a replica reuses the memoized
+  step builders (no compile-cache growth) and the committed
+  graph-audit artifact stays at 19 programs.
+"""
+
+import json
+import os
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_ddp.fleet import Autoscaler, Router
+from tpu_ddp.models.transformer import make_transformer
+from tpu_ddp.serve import (
+    ServeEngine,
+    TenantClass,
+    make_shared_prefix_workload,
+    make_trace,
+    parse_tenant_classes,
+    run_trace,
+)
+
+GEOM = dict(num_slots=4, block_size=8, prefill_chunk=8)
+
+MIXED = [(0, 5, 6, 0.0), (1, 9, 5, 0.0), (2, 12, 4, 0.7),
+         (3, 8, 6, 1.0)]
+
+
+@pytest.fixture(scope="module")
+def model():
+    return make_transformer("TransformerLM-tiny", max_seq_len=64,
+                            compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.key(0))
+
+
+def _prompt(n, seed=0, vocab=1024):
+    return jax.random.randint(jax.random.key(seed), (n,), 0,
+                              vocab).tolist()
+
+
+def _submit_mixed(target, tenants=("gold", "silver", "bronze",
+                                   "gold")):
+    return [target.submit(_prompt(L, seed=ps), n, temperature=t,
+                          seed=i, tenant=tenants[i])
+            for i, (ps, L, n, t) in enumerate(MIXED)]
+
+
+# ---------------------------------------------------------------------------
+# Tenant classes: parsing + config/env surfaces
+# ---------------------------------------------------------------------------
+
+def test_tenant_class_parsing():
+    classes = parse_tenant_classes(
+        "gold=3:250:4096,silver=2:500,bronze=1")
+    assert classes["gold"] == TenantClass("gold", 3, 250.0, 4096)
+    assert classes["silver"].weight == 2
+    assert classes["silver"].deadline_ms == 500.0
+    assert classes["bronze"].token_budget == 0
+    assert parse_tenant_classes("") == {}
+    assert parse_tenant_classes(None) == {}
+
+
+@pytest.mark.parametrize("bad", [
+    "gold",                       # no '='
+    "gold=",                      # no weight
+    "gold=fast",                  # non-numeric weight
+    "gold=3:a",                   # non-numeric deadline
+    "gold=3:250:4096:9",          # too many fields
+    "gold=3,gold=2",              # duplicate class
+])
+def test_tenant_class_parsing_rejects_junk(bad):
+    with pytest.raises(ValueError):
+        parse_tenant_classes(bad)
+
+
+def test_config_env_knobs(monkeypatch):
+    from tpu_ddp.utils.config import TrainConfig
+
+    monkeypatch.setenv("TPU_DDP_FLEET_AUTOSCALE", "1")
+    monkeypatch.setenv("TPU_DDP_SCALE_COOLDOWN_MS", "250")
+    monkeypatch.setenv("TPU_DDP_TENANT_CLASSES",
+                       "gold=3,bronze=1")
+    cfg = TrainConfig()
+    assert cfg.fleet_autoscale is True
+    assert cfg.scale_cooldown_ms == 250.0
+    assert cfg.tenant_classes == "gold=3,bronze=1"
+
+
+@pytest.mark.parametrize("env,val", [
+    ("TPU_DDP_FLEET_AUTOSCALE", "knob-audit-junk"),
+    ("TPU_DDP_SCALE_COOLDOWN_MS", "knob-audit-junk"),
+    ("TPU_DDP_SCALE_COOLDOWN_MS", "0"),
+    ("TPU_DDP_SCALE_COOLDOWN_MS", "-5"),
+    ("TPU_DDP_TENANT_CLASSES", "knob-audit-junk"),
+])
+def test_config_env_rejects_junk(monkeypatch, env, val):
+    from tpu_ddp.utils.config import TrainConfig
+
+    monkeypatch.setenv(env, val)
+    with pytest.raises(ValueError, match=env):
+        TrainConfig()
+
+
+# ---------------------------------------------------------------------------
+# Chaos grammar: the two load-surge kinds
+# ---------------------------------------------------------------------------
+
+def test_chaos_parse_load_kinds():
+    from tpu_ddp.resilience.chaos import parse_faults
+
+    fc, ts = parse_faults("flash-crowd@3,tenant-storm@5:tenant=bronze")
+    assert fc.kind == "flash-crowd" and fc.step == 3 \
+        and fc.tenant is None
+    assert ts.kind == "tenant-storm" and ts.step == 5 \
+        and ts.tenant == "bronze"
+
+
+def test_chaos_tenant_rules():
+    from tpu_ddp.resilience.chaos import parse_faults
+
+    with pytest.raises(ValueError):
+        parse_faults("tenant-storm@5")          # storm needs a tenant
+    with pytest.raises(ValueError):
+        parse_faults("flash-crowd@3:tenant=a")  # crowd is tenant-less
+    with pytest.raises(ValueError):
+        parse_faults("replica-crash@3:tenant=a")
+
+
+# ---------------------------------------------------------------------------
+# WFQ + class-aware shedding
+# ---------------------------------------------------------------------------
+
+def test_wfq_serves_heavier_class_first(model, params):
+    """With every slot contended, stride scheduling admits gold 3x as
+    often as bronze — gold finishes strictly earlier on average."""
+    eng = ServeEngine(model, params,
+                      tenant_classes="gold=3,bronze=1", **GEOM)
+    hs = {}
+    for t in ("gold", "bronze"):
+        hs[t] = [eng.submit(_prompt(5, seed=k), 6, tenant=t)
+                 for k in range(8)]
+    order = []
+    while eng.step():
+        for t, lst in hs.items():
+            for h in lst:
+                if h.done and (t, id(h)) not in order:
+                    order.append((t, id(h)))
+    rank = {key: i for i, key in enumerate(order)}
+    mean_gold = sum(rank[("gold", id(h))]
+                    for h in hs["gold"]) / len(hs["gold"])
+    mean_bronze = sum(rank[("bronze", id(h))]
+                      for h in hs["bronze"]) / len(hs["bronze"])
+    assert mean_gold < mean_bronze
+    assert eng.tenant_accounting_ok() and eng.accounting_ok()
+
+
+def test_shed_hits_lowest_class_first(model, params):
+    """A full admission queue evicts bronze to admit gold — never the
+    other way around."""
+    eng = ServeEngine(model, params, queue_limit=6,
+                      tenant_classes="gold=4,bronze=1", **GEOM)
+    bronze = [eng.submit(_prompt(5, seed=k), 4, tenant="bronze")
+              for k in range(16)]
+    gold = [eng.submit(_prompt(5, seed=100 + k), 4, tenant="gold")
+            for k in range(4)]
+    eng.run()
+    stats = eng.tenant_stats()
+    assert stats["gold"]["shed"] == 0
+    assert stats["bronze"]["shed"] >= 1
+    assert sum(h.shed for h in bronze) == stats["bronze"]["shed"]
+    assert all(not h.shed for h in gold)
+    assert eng.tenant_accounting_ok() and eng.accounting_ok()
+
+
+# ---------------------------------------------------------------------------
+# Ghost-load regression: cancel storms and the backlog signal
+# ---------------------------------------------------------------------------
+
+def test_cancel_storm_leaves_no_ghost_load(model, params):
+    """The scale-up signal is outstanding-per-replica: a tenant that
+    cancels its whole burst must vanish from the backlog, or the
+    autoscaler boots replicas for load that no longer exists."""
+    router = Router([ServeEngine(model, params, **GEOM)
+                     for _ in range(2)])
+    auto = Autoscaler(router, lambda: ServeEngine(model, params,
+                                                  **GEOM),
+                      min_replicas=1, max_replicas=3,
+                      up_tokens_per_replica=8.0,
+                      down_tokens_per_replica=2.0,
+                      cooldown_ms=1e9, enabled=True)
+    keep = [auto.submit(_prompt(5, seed=k), 4, tenant="steady")
+            for k in range(2)]
+    storm = [auto.submit(_prompt(5, seed=50 + k), 4, tenant="storm")
+             for k in range(12)]
+    before = auto.outstanding_by_tenant()   # token-weighted backlog
+    assert before.get("storm", 0) > 5 * before.get("steady", 1)
+    for h in storm:
+        assert auto.cancel(h)
+    by = auto.outstanding_by_tenant()
+    assert by.get("storm", 0) == 0          # the regression pin
+    # The scale-up signal sees ONLY the surviving tenant's tokens.
+    assert auto.router.outstanding() == by.get("steady", 0)
+    assert auto.load_per_replica() <= before["steady"]
+    auto.run()
+    assert all(h.done and not h.cancelled for h in keep)
+    assert auto.outstanding() == 0
+    assert auto.tenant_accounting_ok() and auto.accounting_ok()
+
+
+# ---------------------------------------------------------------------------
+# Identity + parity across the scale-down drain
+# ---------------------------------------------------------------------------
+
+def test_identity_and_parity_across_drain(model, params):
+    """Mixed cancel + shed + scale-down drain: per-tenant identity
+    holds everywhere and migrated streams stay bitwise identical."""
+    def factory():
+        return ServeEngine(model, params, **GEOM)
+
+    eng = factory()
+    base = _submit_mixed(eng)
+    eng.run()
+    baseline = [list(h.tokens) for h in base]
+
+    router = Router([factory(), factory()])
+    auto = Autoscaler(router, factory, min_replicas=1, max_replicas=2,
+                      enabled=False)
+    hs = _submit_mixed(auto)
+    extra = auto.submit(_prompt(6, seed=9), 5, tenant="bronze")
+    for _ in range(3):
+        auto.step()          # partway into decode on both replicas
+    assert auto.cancel(extra)
+    retired = auto.scale_down()
+    assert retired is not None
+    assert len(router.replicas) == 1
+    auto.run()
+    assert [list(h.tokens) for h in hs] == baseline
+    assert not any(h.shed or h.cancelled for h in hs)
+    assert extra.cancelled and not extra.shed
+    assert auto.scale_downs == 1
+    assert auto.migrated_on_drain >= 1   # drain caught live streams
+    assert auto.tenant_accounting_ok() and auto.accounting_ok()
+    by = auto.outstanding_by_tenant()
+    assert all(v == 0 for v in by.values())
+
+
+# ---------------------------------------------------------------------------
+# Namespace isolation
+# ---------------------------------------------------------------------------
+
+def test_tenant_prefix_namespace_isolation(model, params):
+    """Bitwise-identical prompts under different tenants: zero
+    cross-namespace cached tokens, bitwise-identical outputs."""
+    eng = ServeEngine(model, params, prefix_cache=True,
+                      tenant_classes="a=1,b=1", **GEOM)
+    specs = make_shared_prefix_workload(4, vocab_size=1024, seed=4,
+                                        prefix_len=16)
+
+    def wave(tenant):
+        hs = [eng.submit(sp.prompt, sp.max_new_tokens,
+                         temperature=sp.temperature, seed=sp.seed,
+                         tenant=tenant) for sp in specs]
+        eng.run()
+        return hs
+
+    a1 = wave("a")
+    assert eng.prefix_cached_len(specs[0].prompt, tenant="a") > 0
+    assert eng.prefix_cached_len(specs[0].prompt, tenant="b") == 0
+    b1 = wave("b")
+    assert [list(h.tokens) for h in a1] == [list(h.tokens)
+                                            for h in b1]
+    assert eng.tenant_accounting_ok() and eng.accounting_ok()
+
+
+# ---------------------------------------------------------------------------
+# Scale-up: boot-from-push, current version, zero new compiles
+# ---------------------------------------------------------------------------
+
+def test_scale_up_boots_current_version_no_new_compiles(model, params):
+    from tpu_ddp.publish.publisher import Publisher
+    from tpu_ddp.publish.subscriber import attach
+    from tpu_ddp.serve.engine import (
+        _build_decode_step,
+        _build_prefill_step,
+    )
+
+    def factory():
+        return ServeEngine(model, params, **GEOM)
+
+    pub = Publisher(publish_every=1, wire="none", bucket_mb=0.25)
+    seed_eng = factory()
+    seed_eng.subscriber = attach(pub, seed_eng, name="seed")[0]
+    current = jax.tree.map(lambda x: x + 0.01, params)
+    pub.publish(params=current, step=1)
+    while seed_eng.subscriber.lag:
+        seed_eng.step()
+
+    router = Router([seed_eng])
+    auto = Autoscaler(router, factory, publisher=pub,
+                      min_replicas=1, max_replicas=2, enabled=False)
+    d0 = _build_decode_step.cache_info().currsize
+    p0 = _build_prefill_step.cache_info().currsize
+    booted = auto.scale_up()
+    assert booted is not None
+    # Same geometry -> the memoized step builders are reused: booting
+    # a replica compiles NOTHING new (the graph-audit pin).
+    assert _build_decode_step.cache_info().currsize == d0
+    assert _build_prefill_step.cache_info().currsize == p0
+    assert booted.param_version == pub.version == 1
+    assert auto.scale_ups == 1 and len(auto.boot_s) == 1
+    assert pub.bootstraps == 1
+    # The booted replica serves the CURRENT fleet weights bitwise.
+    h0 = seed_eng.submit(_prompt(6, seed=3), 5)
+    seed_eng.run()
+    h1 = booted.submit(_prompt(6, seed=3), 5)
+    booted.run()
+    assert list(h0.tokens) == list(h1.tokens)
+
+
+def test_graph_audit_n_programs_pinned():
+    """Autoscaling added ZERO new jit surfaces: the committed audit
+    artifact still fingerprints exactly 19 programs."""
+    art = pathlib.Path(__file__).resolve().parents[1] / \
+        "experiments" / "graph_audit.json"
+    audit = json.loads(art.read_text())
+    assert audit["n_programs"] == 19
+    assert len(audit["cells"]) == 19
+
+
+# ---------------------------------------------------------------------------
+# Day-in-the-life traces
+# ---------------------------------------------------------------------------
+
+def test_make_trace_is_deterministic_and_shaped():
+    kw = dict(duration_s=20.0, base_rate=2.0, peak_rate=20.0,
+              vocab_size=512, seed=3,
+              tenant_mix={"gold": 1, "bronze": 2},
+              flash_crowds=((9.0, 11.0, 3.0),))
+    t1, t2 = make_trace(**kw), make_trace(**kw)
+    assert t1 == t2                       # pure function of its args
+    assert all(0 <= ev.at_s < 20.0 for ev in t1)
+    assert {ev.spec.tenant for ev in t1} == {"gold", "bronze"}
+    # The flash-crowd window is ~3x denser than the same-width window
+    # straddling the trough (the trace actually HAS a day shape).
+    mid = sum(9.0 <= ev.at_s < 11.0 for ev in t1)
+    edge = sum(ev.at_s < 1.0 or ev.at_s >= 19.0 for ev in t1)
+    assert mid > 2 * max(1, edge)
+
+
+def test_make_trace_rejects_junk():
+    with pytest.raises(ValueError):
+        make_trace(duration_s=0.0, base_rate=1.0, peak_rate=2.0,
+                   vocab_size=64)
+    with pytest.raises(ValueError):
+        make_trace(duration_s=5.0, base_rate=3.0, peak_rate=2.0,
+                   vocab_size=64)        # peak below base
+    with pytest.raises(ValueError):
+        make_trace(duration_s=5.0, base_rate=1.0, peak_rate=2.0,
+                   vocab_size=64, flash_crowds=((4.0, 3.0, 2.0),))
+
+
+def test_run_trace_virtual_clock_drives_autoscaler(model, params):
+    """run_trace replays on the fleet-parallel virtual clock: the
+    Autoscaler's replica-second integral ticks in TRACE time (bounded
+    by capacity x makespan), per-tenant identity holds, and zero SLO
+    inversions are recorded."""
+    def factory():
+        return ServeEngine(model, params,
+                           tenant_classes="gold=3,bronze=1", **GEOM)
+
+    trace = make_trace(duration_s=1.5, base_rate=10.0, peak_rate=60.0,
+                       vocab_size=1024, seed=5,
+                       tenant_mix={"gold": 1, "bronze": 1},
+                       prompt_len=(4, 9), max_new=(3, 6))
+    router = Router([factory()])
+    auto = Autoscaler(router, factory, min_replicas=1, max_replicas=2,
+                      up_tokens_per_replica=8.0,
+                      down_tokens_per_replica=2.0, hold_steps=2,
+                      cooldown_ms=50.0, enabled=True)
+    m = run_trace(auto, trace, slo_ttft_ms=1e4,
+                  class_weights={"gold": 3, "bronze": 1})
+    assert m["n_requests"] == len(trace)
+    assert m["accounting_ok"] and m["tenant_accounting_ok"]
+    assert m["slo_inversions"] == 0
+    assert m["n_completed"] + m["n_shed"] + m["n_cancelled"] \
+        == len(trace)
+    assert set(m["tenants"]) == {"gold", "bronze"}
+    # The controller clock was swapped to trace time: the integral
+    # can never exceed max_replicas x virtual makespan.
+    assert 0 < m["replica_seconds"] <= 2 * m["makespan_s"] + 1e-6
+    assert "autoscale" in m
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler guard rails
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_validates_knobs(model, params):
+    router = Router([ServeEngine(model, params, **GEOM)])
+
+    def factory():
+        return ServeEngine(model, params, **GEOM)
+
+    with pytest.raises(ValueError):
+        Autoscaler(router, factory, min_replicas=0)
+    with pytest.raises(ValueError):
+        Autoscaler(router, factory, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        Autoscaler(router, factory, up_tokens_per_replica=4.0,
+                   down_tokens_per_replica=8.0)
+    with pytest.raises(ValueError):
+        Autoscaler(router, factory, cooldown_ms=0.0)
+    with pytest.raises(ValueError):
+        Autoscaler(router, factory, hold_steps=0)
+
+
+def test_autoscaler_hysteresis_and_cooldown(model, params):
+    """hold_steps consecutive observations are required to act, and
+    the cooldown blocks back-to-back actions on a fake clock."""
+    def factory():
+        return ServeEngine(model, params, **GEOM)
+
+    clk = [0.0]
+    router = Router([factory()])
+    auto = Autoscaler(router, factory, min_replicas=1, max_replicas=3,
+                      up_tokens_per_replica=2.0,
+                      down_tokens_per_replica=0.5, hold_steps=3,
+                      cooldown_ms=1000.0, enabled=True,
+                      clock=lambda: clk[0])
+    for k in range(8):
+        auto.submit(_prompt(5, seed=k), 4)
+    auto._tick(); auto._tick()
+    assert len(router.replicas) == 1      # 2 < hold_steps observations
+    auto._tick()
+    assert len(router.replicas) == 2      # third consecutive -> act
+    auto._tick(); auto._tick(); auto._tick()
+    assert len(router.replicas) == 2      # cooldown holds at t=0
+    clk[0] = 1.5                          # 1500 ms later
+    auto._tick(); auto._tick(); auto._tick()
+    assert len(router.replicas) == 3
+    auto.run()
+    assert auto.accounting_ok()

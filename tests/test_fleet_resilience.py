@@ -392,7 +392,10 @@ class TestChaosSpecs:
                                                        monkeypatch):
         from tpu_ddp.resilience.chaos import SERVE_FAULT_KINDS, FaultSpec
         for kind in SERVE_FAULT_KINDS:
-            FaultSpec(kind=kind, step=3)
+            # tenant-storm is the one kind scoped to a tenant; it
+            # refuses to parse without one (DESIGN.md §25).
+            tenant = "gold" if kind == "tenant-storm" else None
+            FaultSpec(kind=kind, step=3, tenant=tenant)
         with pytest.raises(ValueError, match="unknown fault kind"):
             FaultSpec(kind="replica-typo", step=3)
         # A mixed train+serve spec string: the serve injector ignores

@@ -69,6 +69,8 @@ class TestFSDPEquivalence:
         assert 0.0 <= out["test_accuracy"] <= 1.0
         assert np.isfinite(out["test_loss"])
 
+    @pytest.mark.slow  # same-layout fsdp roundtrip is pinned fast by
+    # TestLMFSDP::test_checkpoint_roundtrip on the identical save path
     def test_checkpoint_roundtrip(self, devices, tmp_path):
         tr = _trainer(devices, "fsdp", dp=4)
         state = tr.init_state()

@@ -105,7 +105,10 @@ def test_serve_knobs_registered_under_goodput_objective():
               "serve_queue_limit", "serve_shed_ms",
               # Weight-streaming knobs (DESIGN.md §24): publish cadence
               # and wire on the trainer, staleness gate across both.
-              "publish_every", "publish_wire", "max_staleness_steps"}
+              "publish_every", "publish_wire", "max_staleness_steps",
+              # Autoscaling knobs (DESIGN.md §25): replica lifecycle in
+              # the Autoscaler, SLO classes in the scheduler's WFQ.
+              "fleet_autoscale", "scale_cooldown_ms", "tenant_classes"}
     for f in fields:
         k = knob_by_field(f)
         assert k is not None and k.objective == "goodput", f
@@ -130,22 +133,29 @@ def test_serve_knobs_registered_under_goodput_objective():
     for f in ("fleet_health", "fleet_retry_budget", "serve_queue_limit",
               "serve_shed_ms"):
         assert not knob_by_field(f).semantic, f
+    # Autoscaling never changes what any one request computes — drain
+    # migration is bitwise and WFQ only reorders admission — so the
+    # whole control plane is pure scheduling.
+    for f in ("fleet_autoscale", "scale_cooldown_ms", "tenant_classes"):
+        assert not knob_by_field(f).semantic, f
     cfg, ctx = TrainConfig(), Workload(platform="cpu")
     good = {k.field for k, _ in
             searchable_knobs(cfg, ctx, objective="goodput",
                              include_semantic=True)}
     # At the default config the coupled fleet knobs collapse to single
     # candidates (kv_wire needs a disagg edge, prefix-affinity needs a
-    # cache, the publish wire and gate need a publish cadence —
-    # tune/space.py violations) and drop out of the space.
+    # cache, the publish wire and gate need a publish cadence, the
+    # scale cooldown needs a live autoscaler — tune/space.py
+    # violations) and drop out of the space.
     assert good == fields - {"router_policy", "kv_wire",
-                             "publish_wire", "max_staleness_steps"}
+                             "publish_wire", "max_staleness_steps",
+                             "scale_cooldown_ms"}
     step = {k.field for k, _ in searchable_knobs(cfg, ctx)}
     assert not (step & fields)
-    # With the edge, the cache, and a publish cadence on, the whole
-    # fleet space opens up.
+    # With the edge, the cache, a publish cadence, and the autoscaler
+    # on, the whole fleet space opens up.
     fleet_cfg = TrainConfig(fleet_roles="disagg", prefix_cache=True,
-                            publish_every=1)
+                            publish_every=1, fleet_autoscale=True)
     good = {k.field for k, _ in
             searchable_knobs(fleet_cfg, ctx, objective="goodput",
                              include_semantic=True)}

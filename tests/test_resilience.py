@@ -180,6 +180,9 @@ class TestGuardedTrainer:
         assert metrics.counters["step_skipped"] == 1
         assert tr.guard.consecutive == 0  # healthy step after the skip
 
+    @pytest.mark.slow  # raise-after-k is pinned fast at the unit level
+    # (test_raises_after_k_consecutive) and the epoch/guard integration
+    # by test_epoch_counts_skips_in_metrics; this composes the two
     def test_epoch_raises_after_k_bad_steps(self, devices):
         x, y = _batch()
         cfg = TrainConfig(global_batch_size=8, guard_max_bad_steps=2)

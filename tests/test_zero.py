@@ -345,6 +345,8 @@ class TestZeRO1Pipeline:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow  # cross-layout zero1 restore is pinned fast by
+    # test_dp_tp_zero1_checkpoint_into_replicated; this adds the pp axis
     def test_pp_zero1_checkpoint_into_replicated(self, devices,
                                                  tmp_path):
         import jax.numpy as jnp
@@ -391,8 +393,8 @@ class TestZeRO1Pipeline:
         ln = mu["blocks"]["ln1"]["scale"]  # stacked (L, dm), pp only
         assert ln.sharding.spec == P((PIPE_AXIS, DATA_AXIS))
 
-    @pytest.mark.slow  # canonicalization is covered fast by the pp and
-    # tp checkpoint tests; this pins the three-axis composition only
+    @pytest.mark.slow  # canonicalization is covered fast by the dp-tp
+    # checkpoint test; this pins the three-axis composition only
     def test_pp_zero1_tp_checkpoint_into_replicated(self, devices,
                                                     tmp_path):
         """The P((pp, mp, dp)) state canonicalizes: a plain replicated

@@ -1,11 +1,14 @@
 """Serving fleet: prefill/decode disaggregation over an explicit KV
-edge (disagg.py), refcounted prefix caching over the paged pool
-(prefix.py), a multi-replica router (router.py), and the fleet
-resilience layer — replica health, deterministic request migration,
-and serve-side chaos (resilience.py). docs/DESIGN.md §21, §23."""
+edge (disagg.py), refcounted prefix caching with per-tenant namespaces
+over the paged pool (prefix.py), a multi-replica router (router.py),
+the fleet resilience layer — replica health, deterministic request
+migration, and serve-side chaos (resilience.py) — and the autoscaling
+replica lifecycle control plane (autoscale.py). docs/DESIGN.md §21,
+§23, §25."""
 
+from tpu_ddp.fleet.autoscale import Autoscaler
 from tpu_ddp.fleet.disagg import DisaggEngine, KVEdge, KVTransfer
-from tpu_ddp.fleet.prefix import PrefixHit, PrefixIndex
+from tpu_ddp.fleet.prefix import PrefixDirectory, PrefixHit, PrefixIndex
 from tpu_ddp.fleet.resilience import (
     ReplicaCrashError,
     ReplicaHealth,
@@ -15,9 +18,11 @@ from tpu_ddp.fleet.resilience import (
 from tpu_ddp.fleet.router import POLICIES, Router
 
 __all__ = [
+    "Autoscaler",
     "DisaggEngine",
     "KVEdge",
     "KVTransfer",
+    "PrefixDirectory",
     "PrefixHit",
     "PrefixIndex",
     "POLICIES",

@@ -1,0 +1,284 @@
+"""Autoscaling replica lifecycle: the control plane over the Router.
+
+The fleet so far is a static-N replica set; production load is diurnal
+with flash crowds. This module closes the loop: an :class:`Autoscaler`
+watches the router's backlog and walks replicas through the §25 state
+machine —
+
+    scale decision -> (up) boot-from-push -> join
+                   -> (down) drain -> migrate -> retire
+
+**Scale-up is boot-from-push, never checkpoint restart.** A new
+replica is constructed from the engine factory (its jitted programs
+come out of the same-geometry ``lru_cache`` — ZERO new compiles, the
+graph-audit pin), wired onto the Publisher's edge, and seeded by
+``Publisher.bootstrap``: the CURRENT reconstruction ships as one full
+``none``-wire update at the current version, so the replica joins the
+fleet serving bitwise the same weights as everyone else. The measured
+boot time (factory + bootstrap + staged catch-up) is the reaction-time
+number ``bench.py`` compares against ``ServeEngine.from_checkpoint``.
+
+**Scale-down is drain -> migrate -> retire.** The victim (the least
+loaded healthy replica) is drained via the router's GRACEFUL path:
+every unfinished stream re-pends as a ``continuation_of`` replay —
+bitwise identical tokens, zero dropped streams, no retry-budget shed
+(the budget guards crash loops, not planned lifecycle) — and only then
+is the replica removed and its subscriber detached.
+
+**Hysteresis + cooldown so flash crowds don't thrash.** Scaling needs
+``hold`` CONSECUTIVE over/under-threshold observations (separate up/
+down thresholds form the hysteresis band) and at least ``cooldown_ms``
+since the last action (``TPU_DDP_SCALE_COOLDOWN_MS``) — a one-step
+spike buys nothing, and boot/drain churn would burn the very capacity
+scaling is meant to add.
+
+**Breaker-tripped replicas are excluded from capacity math.** The load
+signal is backlog per HEALTHY replica: a fleet of 3 with 2 breakers
+open is a fleet of 1 for scaling purposes, so the controller adds
+capacity instead of waiting for probes that may never succeed.
+
+The Autoscaler mirrors the engine drive surface (``submit`` /
+``cancel`` / ``step`` / ``run`` / ``outstanding`` /
+``accounting_ok``), so ``loadgen.run_load`` / ``run_trace`` drive an
+autoscaling fleet exactly like one engine.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+
+
+class Autoscaler:
+    """Replica-count controller over one :class:`Router`.
+
+    ``engine_factory`` returns a fresh, empty replica (same model and
+    cache geometry as the fleet — geometry is what makes the compile
+    cache shared). ``publisher`` (optional) seeds booted replicas via
+    :meth:`Publisher.bootstrap`; without one, booted replicas serve
+    the factory's params (version 0).
+    """
+
+    def __init__(self, router, engine_factory, publisher=None, *,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 up_tokens_per_replica: float = 256.0,
+                 down_tokens_per_replica: float = 32.0,
+                 hold_steps: int = 3, cooldown_ms: float | None = None,
+                 enabled: bool | None = None, clock=time.monotonic,
+                 config=None):
+        if config is None:
+            from tpu_ddp.utils.config import TrainConfig
+            config = TrainConfig()
+        self.router = router
+        self.factory = engine_factory
+        self.publisher = publisher
+        self.enabled = bool(enabled if enabled is not None
+                            else config.fleet_autoscale)
+        self.cooldown_ms = float(cooldown_ms if cooldown_ms is not None
+                                 else config.scale_cooldown_ms)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_tokens = float(up_tokens_per_replica)
+        self.down_tokens = float(down_tokens_per_replica)
+        self.hold_steps = int(hold_steps)
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if not self.down_tokens < self.up_tokens:
+            raise ValueError(
+                "down_tokens_per_replica must be < up_tokens_per_replica "
+                "(the gap IS the hysteresis band)")
+        if self.hold_steps < 1:
+            raise ValueError("hold_steps must be >= 1")
+        if self.cooldown_ms <= 0:
+            raise ValueError("cooldown_ms must be > 0")
+        self._clock = clock
+        self._last_action_at = None   # no cooldown before the first act
+        self._up_streak = 0
+        self._down_streak = 0
+        # Lifecycle counters + the replica-second integral the sweep's
+        # goodput-per-replica acceptance check divides by.
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.migrated_on_drain = 0
+        self.boot_s: list[float] = []
+        self.events: list[dict] = []
+        self._rs_integral = 0.0
+        self._rs_last = self._clock()
+
+    # ---- load signal ---------------------------------------------------
+
+    def _healthy(self) -> list[int]:
+        return [i for i in range(len(self.router.replicas))
+                if self.router.health[i].healthy]
+
+    def capacity(self) -> int:
+        """Replicas that count: healthy (breaker closed) only."""
+        return len(self._healthy())
+
+    def load_per_replica(self) -> float:
+        """Fleet backlog divided by HEALTHY capacity — tripped
+        breakers concentrate load on the survivors, and the signal
+        must say so."""
+        return self.router.outstanding() / max(1, self.capacity())
+
+    # ---- the control loop ----------------------------------------------
+
+    def step(self) -> bool:
+        """One fleet step + one controller tick."""
+        worked = bool(self.router.step())
+        self._tick()
+        return worked
+
+    def run(self, max_steps: int | None = None) -> int:
+        n = 0
+        while max_steps is None or n < max_steps:
+            if not self.step():
+                break
+            n += 1
+        return n
+
+    def _tick(self) -> None:
+        now = self._clock()
+        self._rs_integral += self.capacity() * (now - self._rs_last)
+        self._rs_last = now
+        if not self.enabled:
+            return
+        load = self.load_per_replica()
+        if load > self.up_tokens:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif load < self.down_tokens:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._down_streak = 0
+        if self._last_action_at is not None and \
+                (now - self._last_action_at) * 1e3 < self.cooldown_ms:
+            return
+        if self._up_streak >= self.hold_steps \
+                and len(self.router.replicas) < self.max_replicas:
+            self.scale_up()
+        elif self._down_streak >= self.hold_steps \
+                and self.capacity() > self.min_replicas \
+                and len(self.router.replicas) > self.min_replicas:
+            self.scale_down()
+
+    def _acted(self, action: str, **detail) -> None:
+        self._last_action_at = self._clock()
+        self._up_streak = self._down_streak = 0
+        self.events.append(dict(action=action,
+                                n_replicas=len(self.router.replicas),
+                                **detail))
+
+    # ---- scale-up: boot from the publisher's full-push path ------------
+
+    def scale_up(self):
+        """Boot a replica and join it to the fleet. Returns it."""
+        t0 = time.perf_counter()
+        eng = self.factory()
+        if self.publisher is not None:
+            from tpu_ddp.publish.subscriber import Subscriber
+            sub = Subscriber(eng, name=f"boot{self.scale_ups}")
+            eng.subscriber = sub
+            self.publisher.connect(sub)
+            if self.publisher.bootstrap(sub) is not None:
+                # Stage the boot push to completion BEFORE taking
+                # traffic: the replica joins already serving the
+                # fleet's current version, so routing to it can never
+                # regress a stream's param_version.
+                while sub.lag:
+                    eng.step()
+        boot_s = time.perf_counter() - t0
+        self.boot_s.append(boot_s)
+        self.scale_ups += 1
+        i = self.router.add_replica(eng)
+        self._acted("scale-up", replica=i, boot_s=boot_s,
+                    version=getattr(eng, "param_version", 0))
+        return eng
+
+    # ---- scale-down: drain -> migrate -> retire ------------------------
+
+    def scale_down(self):
+        """Retire the least-loaded healthy replica. Its unfinished
+        streams migrate as bitwise continuations (zero dropped).
+        Returns the removed engine, or None if nothing was eligible."""
+        healthy = self._healthy()
+        if len(self.router.replicas) <= self.min_replicas \
+                or not healthy:
+            return None
+        victim = min(healthy,
+                     key=lambda i: (self.router.replicas[i].outstanding(),
+                                    i))
+        migrated = self.router.drain_replica(victim)
+        eng = self.router.remove_replica(victim)
+        self.migrated_on_drain += migrated
+        sub = getattr(eng, "subscriber", None)
+        if self.publisher is not None and sub is not None:
+            try:
+                self.publisher.subscribers.remove(sub)
+            except ValueError:
+                warnings.warn("autoscale: retired replica's subscriber "
+                              "was not on the publisher's edge",
+                              stacklevel=2)
+        self.scale_downs += 1
+        self._acted("scale-down", replica=victim, migrated=migrated)
+        return eng
+
+    # ---- engine drive surface (run_load / run_trace) -------------------
+
+    def submit(self, prompt, max_new_tokens: int, **kw):
+        return self.router.submit(prompt, max_new_tokens, **kw)
+
+    def cancel(self, req) -> bool:
+        return self.router.cancel(req)
+
+    def outstanding(self) -> int:
+        return self.router.outstanding()
+
+    def outstanding_by_tenant(self) -> dict:
+        return self.router.outstanding_by_tenant()
+
+    def accounting_ok(self) -> bool:
+        return self.router.accounting_ok()
+
+    def tenant_accounting_ok(self) -> bool:
+        return self.router.tenant_accounting_ok()
+
+    def set_clock(self, clock) -> None:
+        """Swap the control-plane clock mid-life — ``run_trace`` hands
+        the controller its fleet-parallel VIRTUAL clock so cooldown
+        windows and the replica-second integral tick in trace time,
+        not wall time. Resets the integral's last sample and the
+        cooldown anchor to the new clock's epoch (already-accumulated
+        replica-seconds are kept)."""
+        self._clock = clock
+        self._rs_last = clock()
+        self._last_action_at = None
+
+    # ---- introspection -------------------------------------------------
+
+    def replica_seconds(self) -> float:
+        """∫ capacity dt over the drive so far — the denominator of
+        goodput-per-replica-second."""
+        now = self._clock()
+        return self._rs_integral + self.capacity() * (now - self._rs_last)
+
+    def stats(self) -> dict:
+        return {"enabled": self.enabled,
+                "n_replicas": len(self.router.replicas),
+                "capacity": self.capacity(),
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "migrated_on_drain": self.migrated_on_drain,
+                "boot_s": list(self.boot_s),
+                "replica_seconds": self.replica_seconds(),
+                "cooldown_ms": self.cooldown_ms,
+                "events": list(self.events),
+                "router": self.router.stats()}
+
+
+__all__ = ["Autoscaler"]

@@ -72,7 +72,11 @@ from tpu_ddp.serve.engine import (
     decode_bank,
 )
 from tpu_ddp.serve.kv_pool import PagedKVPool, pin_committed
-from tpu_ddp.serve.scheduler import Scheduler
+from tpu_ddp.serve.scheduler import (
+    Scheduler,
+    parse_tenant_classes,
+    tenant_of,
+)
 from tpu_ddp.utils.metrics import MetricsLogger
 
 
@@ -185,6 +189,7 @@ class DisaggEngine:
                  prefix_cache: bool | None = None,
                  queue_limit: int | None = None,
                  shed_ms: float | None = None,
+                 tenant_classes: str | None = None,
                  metrics: MetricsLogger | None = None,
                  config=None):
         check_decodable(model)
@@ -228,8 +233,17 @@ class DisaggEngine:
         if prefix_cache:
             from tpu_ddp.fleet.prefix import PrefixIndex
             self.prefix = PrefixIndex(self.prefill_pool)
+        # Tenant classes (§25) apply at the ADMISSION scheduler — the
+        # prefill role's queue is where disagg requests wait. Degraded
+        # mode trades WFQ for liveness (the fallback queue is FIFO):
+        # with the prefill worker dead, draining anything beats
+        # draining fairly.
+        tc = (tenant_classes if tenant_classes is not None
+              else config.tenant_classes)
+        self.tenants = parse_tenant_classes(tc) or None
         self.psched = Scheduler(self.prefill_pool, 1, "continuous",
-                                prefix=self.prefix, role="prefill")
+                                prefix=self.prefix, role="prefill",
+                                tenants=self.tenants)
         # Degraded-mode fallback: a one-slot scheduler over the DECODE
         # pool that re-prefills requests whose edge transfer was lost
         # or whose prefill worker died. It shares the decode pool, so
@@ -274,7 +288,8 @@ class DisaggEngine:
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
                eos_id: int | None = None,
-               on_token: Callable[[int], None] | None = None) -> Request:
+               on_token: Callable[[int], None] | None = None,
+               tenant: str = "default") -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold >= 1 token")
@@ -286,10 +301,13 @@ class DisaggEngine:
                              f"max_seq_len={self.model.max_seq_len}")
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
+        if not tenant:
+            raise ValueError("tenant must be a non-empty string")
         req = Request(rid=next(self._rid), prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), seed=int(seed),
                       eos_id=eos_id, on_token=on_token,
+                      tenant=str(tenant),
                       submitted_at=time.perf_counter())
         # Decode-side feasibility must hold too, or the transfer could
         # never be adopted and would head-block the edge forever.
@@ -444,11 +462,35 @@ class DisaggEngine:
                         + (s.request.max_new_tokens - s.generated)
         return w
 
-    def prefix_cached_len(self, prompt) -> int:
+    def prefix_cached_len(self, prompt, tenant: str = "default") -> int:
         if self.prefix is None:
             return 0
         return self.prefix.cached_len(
-            np.asarray(prompt, np.int32).reshape(-1))
+            np.asarray(prompt, np.int32).reshape(-1), ns=tenant)
+
+    def outstanding_by_tenant(self) -> dict[str, int]:
+        """``outstanding()`` by tenant (see ServeEngine) — computed
+        live over queues, edge and slots, so cancels leave no ghost
+        load in the autoscaler's backlog signal."""
+        out: dict[str, int] = {}
+
+        def add(t, w):
+            out[t] = out.get(t, 0) + w
+
+        for q in (self.psched.queue, self.dsched.queue):
+            for r in q:
+                add(tenant_of(r),
+                    len(r.prompt) + r.max_new_tokens - len(r.tokens))
+        for t in self.edge.queue:
+            add(tenant_of(t.request),
+                t.request.max_new_tokens - len(t.request.tokens))
+        for sched in (self.psched, self.dsched, self.sched):
+            for s in sched.slots:
+                if s is not None:
+                    add(tenant_of(s.request),
+                        (len(s.request.prompt) - s.prefill_done)
+                        + (s.request.max_new_tokens - s.generated))
+        return out
 
     # ---- prefill role --------------------------------------------------
 
@@ -504,7 +546,8 @@ class DisaggEngine:
             self.metrics.inc("fleet_shipped")
             self.metrics.observe("fleet_wire_bytes", n_k + n_v)
         if self.prefix is not None:
-            self.prefix.register(req.prompt, s.blocks)
+            self.prefix.register(req.prompt, s.blocks,
+                                 ns=tenant_of(req))
         self.psched.retire(pi)
 
     def _emit_first(self, req: Request, tok: int, lp: float) -> None:

@@ -40,12 +40,32 @@ Design points, in the order they bite:
 ``plan`` is pure (the router probes it for prefix-affinity routing);
 ``share`` is the effectful twin the scheduler calls once per
 admission, and is where hit statistics accrue.
+
+**Tenant namespaces (§25).** Chain keys are rooted at
+``("ns", tenant)`` instead of ``None``, so two tenants submitting
+token-identical prompts occupy DISJOINT key chains: tenant A's cache
+can never serve tenant B — not as a policy check at lookup time, but
+by construction of the key space (the isolation proof in
+tests/test_fleet_autoscale.py shows 0 cross-tenant hits with
+bitwise-identical output either way). The default namespace keeps
+every pre-§25 call site byte-identical.
+
+:class:`PrefixDirectory` is the fleet-level companion: a router-side
+map from ``(tenant, first-block chain keys)`` to the replica indices
+that have served them, so prefix-affinity routing probes only the
+replicas that can possibly hit instead of every replica in the fleet.
+Entries are optimistic (recorded at routing time, before prefill
+registers) — the router re-verifies with the replica's own pure
+``plan`` probe, so a stale or early entry costs one probe, never a
+wrong route.
 """
 
 from __future__ import annotations
 
 import dataclasses
 from collections import OrderedDict
+
+DEFAULT_NS = "default"
 
 
 @dataclasses.dataclass
@@ -89,22 +109,24 @@ class PrefixIndex:
 
     # ---- lookup --------------------------------------------------------
 
-    def _chain(self, prompt):
-        """Yield ``(key, block_tokens)`` for each FULL block of the
-        prompt, chaining keys exactly."""
+    def _chain(self, prompt, ns: str = DEFAULT_NS):
+        """Yield the chain key for each FULL block of the prompt.
+        Chains are rooted at the tenant namespace, so cross-tenant
+        prompts can never share an entry no matter their tokens."""
         bs = self.pool.block_size
-        key = None
+        key = ("ns", str(ns))
         for i in range(len(prompt) // bs):
             tok = tuple(int(t) for t in prompt[i * bs:(i + 1) * bs])
             key = (key, tok)
             yield key
 
-    def plan(self, prompt) -> PrefixHit:
-        """Longest indexed chain for ``prompt``. Pure — no refcounts,
-        no stats, no LRU touch — so the router can probe it per
-        candidate replica without distorting anything."""
+    def plan(self, prompt, ns: str = DEFAULT_NS) -> PrefixHit:
+        """Longest indexed chain for ``prompt`` within tenant
+        namespace ``ns``. Pure — no refcounts, no stats, no LRU touch
+        — so the router can probe it per candidate replica without
+        distorting anything."""
         blocks, keys = [], []
-        for key in self._chain(prompt):
+        for key in self._chain(prompt, ns):
             e = self._entries.get(key)
             if e is None:
                 break
@@ -120,9 +142,9 @@ class PrefixIndex:
         cow = len(blocks) * bs > cached_len
         return PrefixHit(list(blocks), keys, cached_len, cow)
 
-    def cached_len(self, prompt) -> int:
+    def cached_len(self, prompt, ns: str = DEFAULT_NS) -> int:
         """Convenience for prefix-affinity routing."""
-        return self.plan(prompt).cached_len
+        return self.plan(prompt, ns).cached_len
 
     # ---- admission-side effects ---------------------------------------
 
@@ -140,13 +162,14 @@ class PrefixIndex:
         for key in hit.keys:
             self._entries.move_to_end(key)
 
-    def register(self, prompt, blocks) -> None:
-        """Index a finished prefill's FULL prompt blocks. Blocks whose
-        chain key is already present are skipped (the existing entry's
-        block holds identical content by construction); new entries
-        take an index refcount so they outlive the request."""
+    def register(self, prompt, blocks, ns: str = DEFAULT_NS) -> None:
+        """Index a finished prefill's FULL prompt blocks under tenant
+        namespace ``ns``. Blocks whose chain key is already present
+        are skipped (the existing entry's block holds identical
+        content by construction); new entries take an index refcount
+        so they outlive the request."""
         key = None
-        for i, k in enumerate(self._chain(prompt)):
+        for i, k in enumerate(self._chain(prompt, ns)):
             e = self._entries.get(k)
             if e is None:
                 self.pool.incref([blocks[i]])
@@ -214,3 +237,74 @@ class PrefixIndex:
             "inserted": self.inserted,
             "evicted": self.evicted,
         }
+
+
+class PrefixDirectory:
+    """Cross-replica prefix directory for affinity routing (§25).
+
+    The router records ``(tenant, first full block of the prompt) ->
+    replica index`` whenever it routes a request, and consults the
+    directory BEFORE probing replicas: only the replicas recorded for
+    that key can possibly have the prefix cached, so the per-request
+    probe cost stays O(recorded replicas) instead of O(fleet). Entries
+    are advisory — the router still verifies each candidate with the
+    replica's pure ``plan`` probe, and a request whose key has no
+    entries simply falls back to least-loaded routing (nothing could
+    have hit anyway, since the directory has seen every routed
+    submit). Replica removal (scale-down / breaker retirement) calls
+    ``forget`` + ``reindex`` so stale indices never reach ``pick``."""
+
+    def __init__(self, block_size: int):
+        if block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {block_size}")
+        self.block_size = block_size
+        # (tenant, first-block token tuple) -> set of replica indices
+        self._where: dict = {}
+        self.records = 0
+        self.narrowed = 0   # picks the directory narrowed
+        self.misses = 0     # picks with no recorded candidate
+
+    def _key(self, tenant: str, prompt):
+        if len(prompt) < self.block_size:
+            return None  # no full block -> nothing cacheable to find
+        return (str(tenant),
+                tuple(int(t) for t in prompt[:self.block_size]))
+
+    def record(self, tenant: str, prompt, replica: int) -> None:
+        key = self._key(tenant, prompt)
+        if key is None:
+            return
+        self._where.setdefault(key, set()).add(replica)
+        self.records += 1
+
+    def candidates(self, tenant: str, prompt) -> list[int]:
+        """Replica indices that may hold this prompt's prefix (sorted
+        for determinism). Empty = provably cold everywhere."""
+        key = self._key(tenant, prompt)
+        hits = self._where.get(key) if key is not None else None
+        if hits:
+            self.narrowed += 1
+            return sorted(hits)
+        self.misses += 1
+        return []
+
+    def forget(self, replica: int) -> None:
+        """Drop every record pointing at ``replica`` (its pool — and
+        therefore its cache — is gone)."""
+        for key in list(self._where):
+            s = self._where[key]
+            s.discard(replica)
+            if not s:
+                del self._where[key]
+
+    def reindex(self, removed: int) -> None:
+        """Shift indices above a removed replica down by one, matching
+        the router's compaction of its replica list."""
+        self._where = {
+            key: {i - 1 if i > removed else i for i in s}
+            for key, s in self._where.items()}
+
+    def stats(self) -> dict:
+        return {"keys": len(self._where), "records": self.records,
+                "narrowed": self.narrowed, "misses": self.misses}

@@ -211,6 +211,31 @@ class ServeFaultInjector(FaultInjector):
                 return True
         return False
 
+    # ---- load-surge kinds (consumed by the DRIVE loop, not a replica;
+    # chaos decides WHEN the surge lands, the drill decides what burst
+    # to submit — see scripts/serve_chaos_sweep.py) -----------------------
+
+    def flash_crowd_fires(self, step: int) -> bool:
+        """True when a fleet-wide load surge must land at this drive
+        step (the autoscaler's hysteresis/cooldown drill)."""
+        for spec in self.specs:
+            if spec.kind == "flash-crowd" and self._fires(spec, step):
+                self._announce(spec, step)
+                self._mark_sentinel(spec, step)
+                return True
+        return False
+
+    def tenant_storm_fires(self, step: int) -> str | None:
+        """The storming tenant's name when a single-tenant flood must
+        land at this drive step (the WFQ-isolation drill), else
+        None."""
+        for spec in self.specs:
+            if spec.kind == "tenant-storm" and self._fires(spec, step):
+                self._announce(spec, step)
+                self._mark_sentinel(spec, step)
+                return spec.tenant
+        return None
+
 
 def serve_chaos_active() -> bool:
     """True when the chaos env is set at all — engines then construct

@@ -51,8 +51,10 @@ from collections import deque
 
 import numpy as np
 
+from tpu_ddp.fleet.prefix import PrefixDirectory
 from tpu_ddp.fleet.resilience import ReplicaHealth, continuation_of
 from tpu_ddp.serve.engine import Request
+from tpu_ddp.serve.scheduler import tenant_of
 
 POLICIES = ("least-loaded", "prefix-affinity")
 
@@ -90,6 +92,7 @@ class Router:
         backoff_ms = float(
             probe_backoff_ms if probe_backoff_ms is not None
             else config.fleet_probe_backoff_ms)
+        self._backoff_s = backoff_ms / 1e3  # add_replica needs it too
         self.step_deadline_ms = float(
             step_deadline_ms if step_deadline_ms is not None
             else config.fleet_step_deadline_ms)
@@ -112,6 +115,16 @@ class Router:
         self.migrated = 0   # replays that carried tokens already
         self.retried = 0    # replays that had not produced a token
         self.shed = 0       # retry budget exhausted
+        # Cross-replica prefix directory (§25): under prefix-affinity
+        # the router records which replica served each (tenant,
+        # first-block) key, so ``pick`` probes only the replicas that
+        # can possibly hit instead of the whole fleet. Advisory —
+        # every hint is re-verified with the replica's pure probe.
+        self.prefix_dir = None
+        if self.policy == "prefix-affinity":
+            bs = getattr(self.replicas[0], "block_size", None)
+            if bs:
+                self.prefix_dir = PrefixDirectory(int(bs))
         # Stamp each replica's chaos injector with its index so
         # ``:rank=R`` fault specs target one replica of the fleet.
         for i, r in enumerate(self.replicas):
@@ -126,25 +139,43 @@ class Router:
                 if self.health[i].healthy]
         return idxs or list(range(len(self.replicas)))
 
-    def pick(self, prompt) -> int:
+    def pick(self, prompt, tenant: str = "default") -> int:
         """The replica index ``submit`` would use for ``prompt`` —
         split out so tests can interrogate placement decisions.
         Unhealthy replicas are never picked while a healthy one
-        exists."""
+        exists. Affinity probes are tenant-namespaced and, when the
+        prefix directory has hints for this (tenant, prompt) key,
+        narrowed to the hinted replicas — with a full-fleet probe
+        fallback whenever the hints all miss, so narrowing can only
+        save probes, never change the decision."""
         cand = self._candidates()
         loads = {i: self.replicas[i].outstanding() for i in cand}
         least = min(cand, key=lambda i: (loads[i], i))
         if self.policy == "least-loaded":
             return least
-        cached = {i: self.replicas[i].prefix_cached_len(prompt)
-                  for i in cand}
-        best = max(cand, key=lambda i: (cached[i], -loads[i], -i))
+        probe = cand
+        if self.prefix_dir is not None:
+            in_cand = set(cand)
+            hinted = [i for i in self.prefix_dir.candidates(tenant,
+                                                            prompt)
+                      if i in in_cand]
+            if hinted:
+                probe = hinted
+        cached = {i: self.replicas[i].prefix_cached_len(prompt, tenant)
+                  for i in probe}
+        if probe is not cand and max(cached.values()) == 0:
+            for i in cand:  # stale hints: fall back to the full probe
+                if i not in cached:
+                    cached[i] = self.replicas[i].prefix_cached_len(
+                        prompt, tenant)
+        best = max(cached, key=lambda i: (cached[i], -loads[i], -i))
         if cached[best] > 0 and \
                 loads[best] - loads[least] <= self.affinity_slack:
             return best
         return least
 
     def submit(self, prompt, max_new_tokens: int, **kw):
+        tenant = str(kw.get("tenant", "default"))
         if self.health_enabled and \
                 not any(h.healthy for h in self.health):
             # Whole fleet dark: hold the request at the router and
@@ -157,17 +188,20 @@ class Router:
                           seed=int(kw.get("seed", 0)),
                           eos_id=kw.get("eos_id"),
                           on_token=kw.get("on_token"),
+                          tenant=tenant,
                           submitted_at=time.perf_counter())
             self._rid -= 1
             self._pending.append(req)
             return req
-        i = self.pick(prompt)
+        i = self.pick(prompt, tenant)
         if self.policy == "prefix-affinity" and \
-                self.replicas[i].prefix_cached_len(prompt) > 0:
+                self.replicas[i].prefix_cached_len(prompt, tenant) > 0:
             self.affinity_hits += 1
         req = self.replicas[i].submit(prompt, max_new_tokens, **kw)
         self.routed[i] += 1
         self._owner[id(req)] = i
+        if self.prefix_dir is not None:
+            self.prefix_dir.record(tenant, req.prompt, i)
         return req
 
     def cancel(self, req) -> bool:
@@ -200,6 +234,97 @@ class Router:
         if i is None:
             return False
         return self.replicas[i].cancel(req)
+
+    # ---- replica lifecycle (the §25 autoscaler's surface) --------------
+
+    def add_replica(self, replica) -> int:
+        """Join a freshly booted replica to the fleet. It starts
+        healthy with zero load, so the very next ``pick`` can route to
+        it. Returns its index."""
+        i = len(self.replicas)
+        self.replicas.append(replica)
+        self.routed.append(0)
+        self.health.append(ReplicaHealth(backoff_s=self._backoff_s,
+                                         clock=self._clock))
+        ch = getattr(replica, "chaos", None)
+        if ch is not None and hasattr(ch, "set_rank"):
+            ch.set_rank(i)
+        return i
+
+    def drain_replica(self, i: int) -> int:
+        """GRACEFUL drain for scale-down: harvest replica ``i``'s
+        unfinished work and queue every request for replay elsewhere
+        as a bitwise continuation. Unlike ``_fail_replica`` this is a
+        planned retirement — no failure mark, no failover count, and
+        NO retry-budget shed (zero dropped streams is the §25
+        invariant; the budget guards crash loops, not lifecycle).
+        Returns how many streams were queued for migration."""
+        harvested = self.replicas[i].drain() \
+            if hasattr(self.replicas[i], "drain") else []
+        n = 0
+        for req in harvested:
+            orig = self._cont_to_orig.pop(id(req), None)
+            if orig is not None:
+                ent = self._migrating.pop(id(orig), None)
+                if ent is not None:
+                    self._sync_entry(ent)
+                req = orig
+            if req.done or req.cancelled:
+                continue
+            self._pending.append(req)
+            n += 1
+        if self.prefix_dir is not None:
+            self.prefix_dir.forget(i)
+        return n
+
+    def remove_replica(self, i: int):
+        """Retire replica ``i`` from the fleet (drain first — any
+        residual work is harvested here the same graceful way) and
+        compact every index-keyed structure. Returns the removed
+        engine so the caller can detach its subscriber."""
+        if len(self.replicas) <= 1:
+            raise ValueError("cannot remove the last replica")
+        self.drain_replica(i)  # idempotent: empty after a prior drain
+        eng = self.replicas.pop(i)
+        self.routed.pop(i)
+        self.health.pop(i)
+        # _owner entries for i point at requests that finished there
+        # (unfinished ones were just harvested): drop them; shift the
+        # rest. _migrating holds no continuation on i post-drain.
+        self._owner = {k: (v - 1 if v > i else v)
+                       for k, v in self._owner.items() if v != i}
+        for ent in self._migrating.values():
+            if ent[2] > i:
+                ent[2] -= 1
+        if self.prefix_dir is not None:
+            self.prefix_dir.reindex(i)
+        for j, r in enumerate(self.replicas):
+            ch = getattr(r, "chaos", None)
+            if ch is not None and hasattr(ch, "set_rank"):
+                ch.set_rank(j)
+        return eng
+
+    def outstanding_by_tenant(self) -> dict[str, int]:
+        """Fleet-wide backlog per tenant — the autoscaler's
+        tenant-scoped load signal. Computed LIVE from replica queues/
+        slots plus router-held pending work (never a cached counter),
+        so ``cancel`` and shed-retire paths cannot leave a cancelled
+        tenant's ghost load behind to trigger a spurious scale-up."""
+        out: dict[str, int] = {}
+        for r in self.replicas:
+            by = getattr(r, "outstanding_by_tenant", None)
+            if by is not None:
+                for t, w in by().items():
+                    out[t] = out.get(t, 0) + w
+            else:
+                w = r.outstanding()
+                if w:
+                    out["default"] = out.get("default", 0) + w
+        for req in self._pending:
+            t = tenant_of(req)
+            out[t] = out.get(t, 0) \
+                + len(req.prompt) + req.max_new_tokens - len(req.tokens)
+        return out
 
     # ---- failure handling ----------------------------------------------
 
@@ -254,7 +379,8 @@ class Router:
             try:
                 cont = self.replicas[i].submit(
                     prompt, budget, temperature=orig.temperature,
-                    seed=orig.seed, eos_id=orig.eos_id)
+                    seed=orig.seed, eos_id=orig.eos_id,
+                    tenant=tenant_of(orig))
             except ValueError as e:
                 # An invalid held request (fleet was dark at submit,
                 # so validation never ran) surfaces here: shed it
@@ -275,6 +401,8 @@ class Router:
             self._owner[id(orig)] = i
             self._migrating[id(orig)] = [orig, cont, i, 0]
             self._cont_to_orig[id(cont)] = orig
+            if self.prefix_dir is not None:
+                self.prefix_dir.record(tenant_of(orig), cont.prompt, i)
             did = True
         return did
 
@@ -390,6 +518,11 @@ class Router:
     def accounting_ok(self) -> bool:
         return all(r.accounting_ok() for r in self.replicas)
 
+    def tenant_accounting_ok(self) -> bool:
+        """Every replica's per-tenant ledger identity (§25) holds."""
+        return all(r.tenant_accounting_ok() for r in self.replicas
+                   if hasattr(r, "tenant_accounting_ok"))
+
     def stats(self) -> dict:
         per = []
         for i, r in enumerate(self.replicas):
@@ -408,6 +541,9 @@ class Router:
                 "n_replicas": len(self.replicas),
                 "routed": list(self.routed),
                 "affinity_hits": self.affinity_hits,
+                "tenant_backlog": self.outstanding_by_tenant(),
+                "prefix_dir": (self.prefix_dir.stats()
+                               if self.prefix_dir is not None else None),
                 "health_enabled": self.health_enabled,
                 "failovers": self.failovers,
                 "readmitted": self.readmitted,

@@ -707,6 +707,24 @@ def main(argv=None) -> int:
                    help="shed a queued request that has not started "
                         "prefill after this many ms (0 disables). Sets "
                         "TPU_DDP_SERVE_SHED_MS for every rank")
+    p.add_argument("--fleet-autoscale", default=None, choices=("0", "1"),
+                   help="autoscaling replica lifecycle control plane "
+                        "(tpu_ddp/fleet/autoscale.py): scale-up boots "
+                        "replicas from the publisher's full-push path, "
+                        "scale-down drains via bitwise continuation "
+                        "migration. Sets TPU_DDP_FLEET_AUTOSCALE for "
+                        "every rank")
+    p.add_argument("--scale-cooldown-ms", type=float, default=None,
+                   help="minimum ms between autoscaler actions "
+                        "(default 1000); with hysteresis, what keeps a "
+                        "flash crowd from thrashing the fleet. Sets "
+                        "TPU_DDP_SCALE_COOLDOWN_MS for every rank")
+    p.add_argument("--tenant-classes", default=None,
+                   help="SLO classes for multi-tenant serving: comma-"
+                        "separated name=weight[:deadline_ms[:token_"
+                        "budget]] (e.g. 'gold=3,bronze=1'); empty = "
+                        "single-tenant FIFO. Sets "
+                        "TPU_DDP_TENANT_CLASSES for every rank")
     p.add_argument("--publish-every", type=int, default=None,
                    help="publish a versioned weight update to "
                         "subscribed serving engines every this many "
@@ -793,6 +811,20 @@ def main(argv=None) -> int:
             p.error(f"--serve-shed-ms must be >= 0, "
                     f"got {args.serve_shed_ms}")
         env["TPU_DDP_SERVE_SHED_MS"] = str(args.serve_shed_ms)
+    if args.fleet_autoscale is not None:
+        env["TPU_DDP_FLEET_AUTOSCALE"] = args.fleet_autoscale
+    if args.scale_cooldown_ms is not None:
+        if args.scale_cooldown_ms <= 0:
+            p.error(f"--scale-cooldown-ms must be > 0, "
+                    f"got {args.scale_cooldown_ms}")
+        env["TPU_DDP_SCALE_COOLDOWN_MS"] = str(args.scale_cooldown_ms)
+    if args.tenant_classes is not None:
+        for ent in args.tenant_classes.split(","):
+            if ent.strip() and "=" not in ent:
+                p.error(f"--tenant-classes entry {ent.strip()!r}: "
+                        "expected name=weight[:deadline_ms[:token_"
+                        "budget]]")
+        env["TPU_DDP_TENANT_CLASSES"] = args.tenant_classes
     if args.publish_every is not None:
         if args.publish_every < 0:
             p.error(f"--publish-every must be >= 0, "

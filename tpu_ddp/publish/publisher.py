@@ -142,6 +142,7 @@ class Publisher:
         self._stalled: list = []
         self.full_pushes = 0
         self.delta_pushes = 0
+        self.bootstraps = 0   # §25 scale-up boots served from _last
         self.stalls = 0
         self.deaths = 0
         self.gate_blocks = 0
@@ -320,6 +321,55 @@ class Publisher:
             wires=tuple(wires), nbytes=int(nbytes),
             digests=tree_digests(tree), layout=plan.fingerprint(),
             bucket_mb=self.bucket_mb, strategy=strategy)
+
+    def bootstrap(self, subscriber, params=None):
+        """Seed ONE late-joining subscriber — the §25 autoscaler's
+        scale-up boot path — with the publisher's CURRENT
+        reconstruction as a full update at the CURRENT version: no
+        version bump, no trainer involvement, nothing delivered to the
+        fleet. Ships ``_last`` (bitwise what every other subscriber
+        serves) over the exact ``none`` wire regardless of the
+        publish wire: a boot is one full-size transfer, and bitwise
+        fleet parity matters more than its bytes. Full updates pass
+        the subscriber's ordering check by design, so the booted
+        replica lands at ``applied_version == version`` and every
+        later delta extends it normally. Before any publish has
+        happened, ``params`` seeds the whole edge via a regular full
+        push (every connected subscriber needs version 1 anyway).
+        Returns the :class:`WeightUpdate` (None if the publisher is
+        dead)."""
+        if self.dead:
+            return None
+        if self._last is None:
+            if params is None:
+                raise ValueError(
+                    "bootstrap before the first publish needs params")
+            return self.publish(params=params, step=0)
+        plan = self._plan
+        wires, nbytes = [], 0
+        for idxs in plan.buckets:
+            payload = np.concatenate(
+                [np.asarray(self._last[i], np.float32).ravel()
+                 for i in idxs])
+            # One-shot exact codec per bucket: the publisher's own
+            # codecs carry delta residuals a boot must not disturb.
+            wire, n = EdgeCodec("none").encode(payload)
+            wires.append(wire)
+            nbytes += n
+        tree = jax.tree.unflatten(self._treedef, self._last)
+        strategy = "none"
+        if self.trainer is not None \
+                and hasattr(self.trainer, "sharding_plan"):
+            strategy = self.trainer.sharding_plan().strategy
+        update = WeightUpdate(
+            version=self.version,
+            step=self._version_steps.get(self.version, 0),
+            kind="full", wires=tuple(wires), nbytes=int(nbytes),
+            digests=tree_digests(tree), layout=plan.fingerprint(),
+            bucket_mb=self.bucket_mb, strategy=strategy)
+        self.bootstraps += 1
+        subscriber.deliver(update)
+        return update
 
     def _deliver(self, update) -> None:
         for s in self.subscribers:

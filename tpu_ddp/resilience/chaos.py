@@ -84,13 +84,25 @@ FAULT_KINDS = ("hard-exit", "nan-grad", "stalled-step", "corrupt-ckpt",
 # ``push-stall``            a weight push is delayed in flight: the
 #                           trainer's max_staleness_steps gate blocks
 #                           until the stalled update is delivered
+# ``flash-crowd``           a fleet-wide load surge lands in one step:
+#                           autoscaler hysteresis + cooldown absorb it
+#                           (scale up under sustained pressure, never
+#                           thrash on the spike edge)
+# ``tenant-storm``          ONE tenant floods the fleet (requires
+#                           ``:tenant=NAME``): weighted fair queueing +
+#                           lowest-class-first shedding keep the other
+#                           tenants' SLOs intact
 # ========================  =============================================
 #
 # The publish kinds count PUSHES, not engine steps: ``step`` in the
 # spec is the 1-based push ordinal (``publisher-death@2`` kills the
-# publisher on its second publish).
+# publisher on its second publish). The load kinds (flash-crowd,
+# tenant-storm) are consumed by the DRIVE loop, not the replica: the
+# injector reports that the surge fires at this step and the driver
+# submits the burst — chaos decides WHEN, the drill decides WHAT.
 SERVE_FAULT_KINDS = ("replica-crash", "slow-replica", "edge-drop",
-                     "nonfinite-logits", "publisher-death", "push-stall")
+                     "nonfinite-logits", "publisher-death", "push-stall",
+                     "flash-crowd", "tenant-storm")
 
 CHAOS_ENV = "TPU_DDP_CHAOS_FAULTS"
 
@@ -105,6 +117,7 @@ class FaultSpec:
     step: int | None = None
     prob: float | None = None
     rank: int = 0
+    tenant: str | None = None
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS + SERVE_FAULT_KINDS:
@@ -117,12 +130,22 @@ class FaultSpec:
         if self.prob is not None and not 0.0 < self.prob <= 1.0:
             raise ValueError(f"fault probability must be in (0, 1], "
                              f"got {self.prob}")
+        if self.kind == "tenant-storm":
+            if not self.tenant:
+                raise ValueError(
+                    "tenant-storm needs :tenant=NAME (a storm without "
+                    "a storming tenant drills nothing)")
+        elif self.tenant is not None:
+            raise ValueError(
+                f"fault {self.kind!r} does not take tenant= "
+                "(only tenant-storm)")
 
     @property
     def key(self) -> str:
         """Stable sentinel-file name for this spec."""
         trig = f"p{self.prob}" if self.step is None else str(self.step)
-        return f"{self.kind}@{trig}.rank{self.rank}"
+        suffix = f".tenant{self.tenant}" if self.tenant else ""
+        return f"{self.kind}@{trig}.rank{self.rank}{suffix}"
 
 
 def parse_faults(spec: str) -> list[FaultSpec]:
@@ -140,17 +163,23 @@ def parse_faults(spec: str) -> list[FaultSpec]:
             raise ValueError(f"bad fault spec {entry!r}: expected "
                              f"kind@step or kind@p<prob>")
         rank = 0
+        tenant = None
         if tail:
-            if not tail.startswith("rank="):
+            if tail.startswith("rank="):
+                rank = int(tail[len("rank="):])
+            elif tail.startswith("tenant="):
+                tenant = tail[len("tenant="):]
+            else:
                 raise ValueError(f"bad fault spec {entry!r}: unknown "
-                                 f"option {tail!r} (only rank=R)")
-            rank = int(tail[len("rank="):])
+                                 f"option {tail!r} (rank=R or "
+                                 f"tenant=NAME)")
         try:
             if trigger.startswith("p"):
                 out.append(FaultSpec(kind, prob=float(trigger[1:]),
-                                     rank=rank))
+                                     rank=rank, tenant=tenant))
             else:
-                out.append(FaultSpec(kind, step=int(trigger), rank=rank))
+                out.append(FaultSpec(kind, step=int(trigger), rank=rank,
+                                     tenant=tenant))
         except ValueError as e:
             raise ValueError(f"bad fault spec {entry!r}: {e}") from None
     return out

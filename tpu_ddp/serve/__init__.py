@@ -17,15 +17,19 @@ from tpu_ddp.serve.engine import Request, ServeEngine
 from tpu_ddp.serve.kv_pool import PagedKVPool
 from tpu_ddp.serve.loadgen import (
     RequestSpec,
+    TraceEvent,
     calibrate_rate,
     make_shared_prefix_workload,
+    make_trace,
     make_workload,
     run_load,
+    run_trace,
 )
-from tpu_ddp.serve.scheduler import Scheduler
+from tpu_ddp.serve.scheduler import Scheduler, TenantClass, parse_tenant_classes
 
 __all__ = [
     "PagedKVPool", "Request", "RequestSpec", "Scheduler", "ServeEngine",
-    "calibrate_rate", "make_shared_prefix_workload", "make_workload",
-    "run_load",
+    "TenantClass", "TraceEvent", "calibrate_rate",
+    "make_shared_prefix_workload", "make_trace", "make_workload",
+    "parse_tenant_classes", "run_load", "run_trace",
 ]

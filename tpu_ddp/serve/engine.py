@@ -57,7 +57,11 @@ from tpu_ddp.models.decode import (
     sample_token,
 )
 from tpu_ddp.serve.kv_pool import PagedKVPool, pin_committed
-from tpu_ddp.serve.scheduler import Scheduler
+from tpu_ddp.serve.scheduler import (
+    Scheduler,
+    parse_tenant_classes,
+    tenant_of,
+)
 from tpu_ddp.utils.metrics import MetricsLogger
 
 
@@ -73,6 +77,10 @@ class Request:
     seed: int = 0
     eos_id: int | None = None
     on_token: Callable[[int], None] | None = None
+    # Multi-tenancy (§25): the tenant namespace this request bills to —
+    # WFQ class, prefix-cache namespace, and per-tenant accounting all
+    # key on it. "default" keeps single-tenant call sites unchanged.
+    tenant: str = "default"
     tokens: list = dataclasses.field(default_factory=list)
     logprobs: list = dataclasses.field(default_factory=list)
     # Param version each token was sampled under (tpu_ddp/publish/):
@@ -219,6 +227,7 @@ class ServeEngine:
                  prefix_cache: bool | None = None,
                  queue_limit: int | None = None,
                  shed_ms: float | None = None,
+                 tenant_classes: str | None = None,
                  mesh=None,
                  metrics: MetricsLogger | None = None,
                  config=None):
@@ -261,8 +270,23 @@ class ServeEngine:
         if prefix_cache:
             from tpu_ddp.fleet.prefix import PrefixIndex
             self.prefix = PrefixIndex(self.pool)
+        # Tenant SLO classes (§25, TPU_DDP_TENANT_CLASSES): parsed
+        # here, enforced by the scheduler's weighted-fair-queueing
+        # admission and this engine's class-aware shedding. Empty =
+        # single anonymous tenant, FIFO admission unchanged.
+        tc = (tenant_classes if tenant_classes is not None
+              else config.tenant_classes)
+        self.tenants = parse_tenant_classes(tc) or None
         self.sched = Scheduler(self.pool, self.num_slots, mode,
-                               prefix=self.prefix)
+                               prefix=self.prefix,
+                               tenants=self.tenants)
+        # Per-tenant ledger for the §25 accounting identity:
+        # completed + cancelled + shed + in-flight == submitted, PER
+        # tenant, at every step (completed includes quarantined —
+        # the request terminated on this engine). drain() moves a
+        # handle to another replica, so it debits ``submitted`` here;
+        # the handle-level identity lives in loadgen/run_trace.
+        self.tenant_counts: dict[str, dict[str, int]] = {}
         self.metrics = metrics if metrics is not None \
             else MetricsLogger(None)
         self._decode = _build_decode_step(model, self.block_size,
@@ -361,7 +385,8 @@ class ServeEngine:
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
                eos_id: int | None = None,
-               on_token: Callable[[int], None] | None = None) -> Request:
+               on_token: Callable[[int], None] | None = None,
+               tenant: str = "default") -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold >= 1 token")
@@ -373,37 +398,77 @@ class ServeEngine:
                              f"max_seq_len={self.model.max_seq_len}")
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
+        if not tenant:
+            raise ValueError("tenant must be a non-empty string")
         req = Request(rid=next(self._rid), prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), seed=int(seed),
                       eos_id=eos_id, on_token=on_token,
+                      tenant=str(tenant),
                       submitted_at=time.perf_counter())
         self.metrics.inc("serve_submitted")
+        self._tc(req.tenant)["submitted"] += 1
         if self.queue_limit and len(self.sched.queue) >= self.queue_limit:
             # Bounded admission queue: shed at the door rather than
             # queueing work that can only finish past its deadline.
-            self._shed(req)
-            return req
+            # With tenant classes, shed LOWEST CLASS FIRST: a queue
+            # full of bronze must not bounce an arriving gold — evict
+            # the lowest-weight queued request (newest among ties)
+            # instead, when the newcomer strictly outranks it.
+            victim = req
+            if self.tenants:
+                lowest = min(
+                    self.sched.queue,
+                    key=lambda r: (self._weight(tenant_of(r)), -r.rid),
+                    default=None)
+                if lowest is not None \
+                        and self._weight(tenant_of(lowest)) \
+                        < self._weight(req.tenant):
+                    self.sched._remove_queued(lowest)
+                    self._shed(lowest)
+                    victim = None
+            if victim is not None:
+                self._shed(victim)
+                return req
         self.sched.enqueue(req)
         return req
+
+    def _tc(self, tenant: str) -> dict[str, int]:
+        return self.tenant_counts.setdefault(
+            tenant, {"submitted": 0, "completed": 0, "cancelled": 0,
+                     "shed": 0, "quarantined": 0})
+
+    def _weight(self, tenant: str) -> int:
+        cls = self.tenants.get(tenant) if self.tenants else None
+        return cls.weight if cls is not None else 1
 
     def _shed(self, req: Request) -> None:
         req.shed = True
         req.done = True
         req.finished_at = time.perf_counter()
         self.metrics.inc("serve_shed")
+        self._tc(tenant_of(req))["shed"] += 1
 
     def _shed_expired(self) -> None:
         """Deadline-based shedding: a request still queued (no block
-        held, no token emitted) past ``shed_ms`` is dropped — serving
-        it would only burn capacity on an already-missed SLO."""
-        if not self.shed_ms:
+        held, no token emitted) past its deadline is dropped — serving
+        it would only burn capacity on an already-missed SLO. The
+        deadline is the tighter of the global ``shed_ms`` and the
+        request's tenant-class ``deadline_ms`` (either 0 = off)."""
+        if not self.shed_ms and not self.tenants:
             return
         now = time.perf_counter()
-        expired = [r for r in self.sched.queue
-                   if (now - r.submitted_at) * 1e3 > self.shed_ms]
+        expired = []
+        for r in self.sched.queue:
+            limits = [self.shed_ms]
+            if self.tenants:
+                cls = self.tenants.get(tenant_of(r))
+                limits.append(cls.deadline_ms if cls is not None else 0.0)
+            limits = [m for m in limits if m > 0]
+            if limits and (now - r.submitted_at) * 1e3 > min(limits):
+                expired.append(r)
         for r in expired:
-            self.sched.queue.remove(r)
+            self.sched._remove_queued(r)
             self._shed(r)
 
     def cancel(self, req: Request) -> bool:
@@ -424,6 +489,7 @@ class ServeEngine:
         req.done = True
         req.finished_at = time.perf_counter()
         self.metrics.inc("serve_cancelled")
+        self._tc(tenant_of(req))["cancelled"] += 1
         return True
 
     # ---- the iteration -------------------------------------------------
@@ -498,16 +564,56 @@ class ServeEngine:
                     + (s.request.max_new_tokens - s.generated)
         return w
 
-    def prefix_cached_len(self, prompt) -> int:
-        """Prompt tokens this engine's prefix cache already holds —
-        the router's prefix-affinity signal (0 without a cache)."""
+    def prefix_cached_len(self, prompt, tenant: str = "default") -> int:
+        """Prompt tokens this engine's prefix cache already holds
+        WITHIN the tenant's namespace — the router's prefix-affinity
+        signal (0 without a cache)."""
         if self.prefix is None:
             return 0
         return self.prefix.cached_len(
-            np.asarray(prompt, np.int32).reshape(-1))
+            np.asarray(prompt, np.int32).reshape(-1), ns=tenant)
 
     def accounting_ok(self) -> bool:
         return self.sched.accounting_ok()
+
+    def outstanding_by_tenant(self) -> dict[str, int]:
+        """``outstanding()`` partitioned by tenant — the autoscaler's
+        tenant-scoped backlog signal. Computed live from the queue and
+        slots (never a cached counter), so cancel/shed/drain can't
+        leave ghost load behind."""
+        out: dict[str, int] = {}
+        for r in self.sched.queue:
+            t = tenant_of(r)
+            out[t] = out.get(t, 0) + len(r.prompt) + r.max_new_tokens
+        for s in self.sched.slots:
+            if s is not None:
+                t = tenant_of(s.request)
+                out[t] = out.get(t, 0) \
+                    + (len(s.request.prompt) - s.prefill_done) \
+                    + (s.request.max_new_tokens - s.generated)
+        return out
+
+    def _tenant_in_flight(self, tenant: str) -> int:
+        n = sum(tenant_of(r) == tenant for r in self.sched.queue)
+        n += sum(s is not None and tenant_of(s.request) == tenant
+                 for s in self.sched.slots)
+        return n
+
+    def tenant_accounting_ok(self) -> bool:
+        """The §25 identity, per tenant: completed + cancelled + shed
+        + in-flight == submitted on THIS engine, for every tenant ever
+        seen."""
+        for t, c in self.tenant_counts.items():
+            if c["completed"] + c["cancelled"] + c["shed"] \
+                    + self._tenant_in_flight(t) != c["submitted"]:
+                return False
+        return True
+
+    def tenant_stats(self) -> dict[str, dict]:
+        """Per-tenant ledger + live load, for stats()/debugging."""
+        live = self.outstanding_by_tenant()
+        return {t: dict(c, outstanding=live.get(t, 0))
+                for t, c in sorted(self.tenant_counts.items())}
 
     # ---- internals -----------------------------------------------------
 
@@ -536,7 +642,8 @@ class ServeEngine:
             # (max_new_tokens == 1), and the index must take its
             # holder refs while the blocks are still live.
             if self.prefix is not None:
-                self.prefix.register(req.prompt, s.blocks)
+                self.prefix.register(req.prompt, s.blocks,
+                                     ns=tenant_of(req))
             s.phase = "decode"
             self._emit(pi, int(tok), float(lp))  # the first token
 
@@ -600,6 +707,8 @@ class ServeEngine:
         req.done = True
         req.finished_at = time.perf_counter()
         self.metrics.inc("serve_quarantined")
+        self._tc(tenant_of(req))["quarantined"] += 1
+        self._tc(tenant_of(req))["completed"] += 1
         warnings.warn(
             f"request {req.rid}: non-finite logits at engine step "
             f"{self._step_n}; request quarantined, pages scrubbed",
@@ -618,8 +727,14 @@ class ServeEngine:
                 self.sched.retire(i)
         reqs.extend(self.sched.queue)
         self.sched.queue.clear()
-        return sorted((r for r in reqs if not r.done),
-                      key=lambda r: r.rid)
+        harvested = sorted((r for r in reqs if not r.done),
+                           key=lambda r: r.rid)
+        for r in harvested:
+            # The handle migrates to another replica: debit this
+            # engine's per-tenant ledger so its local identity holds
+            # (the router-level identity follows the handle).
+            self._tc(tenant_of(r))["submitted"] -= 1
+        return harvested
 
     def _emit(self, idx: int, tok: int, logprob: float) -> None:
         """Record one sampled token for slot ``idx``'s request: stream
@@ -644,6 +759,7 @@ class ServeEngine:
             req.finished_at = now
             self.sched.retire(idx)
             self.metrics.inc("serve_retired")
+            self._tc(tenant_of(req))["completed"] += 1
 
 
 __all__ = ["Request", "ServeEngine"]

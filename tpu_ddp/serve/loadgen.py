@@ -29,6 +29,17 @@ Arrival times and workloads are fully seeded (numpy Generator), so a
 sweep cell is reproducible; wall-clock measurements of course are not,
 which is why experiments/serve_sweep.json records host provenance the
 same way every other sweep artifact does.
+
+On top of the constant-rate open system, :func:`make_trace` builds a
+DAY-IN-THE-LIFE arrival trace — a seeded non-homogeneous Poisson
+process (sinusoidal diurnal swing between trough and peak rate, via
+thinning) with optional flash-crowd windows and a multi-tenant mix —
+and :func:`run_trace` replays it against anything with the engine
+drive surface (one engine, a Router, or an Autoscaler), reporting
+per-tenant latency/goodput breakdowns, the per-tenant accounting
+identity, cross-tenant SLO inversions, and goodput per
+replica-second (docs/DESIGN.md §25). The autoscaling sweep
+(scripts/fleet_autoscale_sweep.py) is built on exactly this pair.
 """
 
 from __future__ import annotations
@@ -48,6 +59,7 @@ class RequestSpec:
     max_new_tokens: int
     temperature: float = 0.0
     seed: int = 0
+    tenant: str = "default"
 
 
 def make_workload(n: int, vocab_size: int, seed: int = 0,
@@ -142,7 +154,8 @@ def run_load(engine, specs: list[RequestSpec], rate: float,
             sp = specs[nxt]
             handles[nxt] = engine.submit(
                 sp.prompt, sp.max_new_tokens,
-                temperature=sp.temperature, seed=sp.seed)
+                temperature=sp.temperature, seed=sp.seed,
+                tenant=sp.tenant)
             nxt += 1
         worked = engine.step()
         if not worked:
@@ -244,7 +257,326 @@ def calibrate_rate(engine_factory, specs: list[RequestSpec]) -> float:
     t0 = time.perf_counter()
     for sp in specs:
         engine.submit(sp.prompt, sp.max_new_tokens,
-                      temperature=sp.temperature, seed=sp.seed)
+                      temperature=sp.temperature, seed=sp.seed,
+                      tenant=sp.tenant)
     engine.run()
     elapsed = time.perf_counter() - t0
     return len(specs) / elapsed
+
+
+# ---------------------------------------------------------------------------
+# Day-in-the-life traces (docs/DESIGN.md §25)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TraceEvent:
+    """One scheduled arrival: WHEN (seconds from trace start, scaled
+    by ``run_trace(time_scale=...)`` at replay) and WHAT."""
+
+    at_s: float
+    spec: RequestSpec
+
+
+def diurnal_rate(t: float, duration_s: float, base_rate: float,
+                 peak_rate: float,
+                 flash_crowds: tuple = ()) -> float:
+    """Instantaneous arrival rate at time ``t``: one sinusoidal
+    diurnal cycle (trough at the endpoints, peak mid-trace) times any
+    flash-crowd window multiplier covering ``t``. Exposed so tests can
+    pin the thinning envelope."""
+    frac = 0.5 * (1.0 - np.cos(2.0 * np.pi * t / duration_s))
+    rate = base_rate + (peak_rate - base_rate) * frac
+    for start, end, mult in flash_crowds:
+        if start <= t < end:
+            rate *= mult
+    return float(rate)
+
+
+def make_trace(duration_s: float, base_rate: float, peak_rate: float,
+               vocab_size: int, seed: int = 0,
+               tenant_mix: dict[str, float] | None = None,
+               flash_crowds: tuple = (),
+               shared_prefix_len: int = 0,
+               prompt_len: tuple[int, int] = (4, 17),
+               max_new: tuple[int, int] = (4, 17),
+               temperature: float = 0.0) -> list[TraceEvent]:
+    """A seeded day-in-the-life arrival trace.
+
+    Arrivals follow a non-homogeneous Poisson process — candidate
+    points at the envelope rate, thinned by accept-probability
+    ``rate(t) / rate_max`` (the standard Lewis–Shedler construction,
+    exact and fully seeded). The rate curve is :func:`diurnal_rate`:
+    a ``base_rate``→``peak_rate`` sinusoid over ``duration_s``, with
+    ``flash_crowds`` = ``((start_s, end_s, multiplier), ...)`` windows
+    stacked on top — the burst shape autoscaling hysteresis exists to
+    absorb without thrash.
+
+    ``tenant_mix`` maps tenant name → relative traffic share (need not
+    sum to 1). With ``shared_prefix_len > 0`` each tenant gets its OWN
+    seeded system prompt of that length: identical structure across
+    tenants but disjoint token streams, so prefix-namespace isolation
+    is exercised by construction (a cross-tenant hit would be visible
+    as a hit on a prefix that tenant never submitted).
+    """
+    if duration_s <= 0:
+        raise ValueError(f"duration_s must be > 0, got {duration_s}")
+    if not 0 < base_rate <= peak_rate:
+        raise ValueError(
+            f"need 0 < base_rate <= peak_rate, got "
+            f"{base_rate}/{peak_rate}")
+    for fc in flash_crowds:
+        if len(fc) != 3 or not (0 <= fc[0] < fc[1]) or fc[2] <= 0:
+            raise ValueError(f"flash crowd {fc!r}: expected "
+                             "(start_s, end_s, multiplier > 0)")
+    mix = dict(tenant_mix) if tenant_mix else {"default": 1.0}
+    total = sum(mix.values())
+    if total <= 0 or any(w < 0 for w in mix.values()):
+        raise ValueError(f"tenant_mix weights must be >= 0 with a "
+                         f"positive sum, got {mix}")
+    names = sorted(mix)
+    probs = np.array([mix[n] / total for n in names])
+    rng = np.random.default_rng(seed)
+    # Per-tenant system prompts: seeded off the same generator, drawn
+    # in sorted-name order so the trace is a pure function of its
+    # arguments.
+    prefixes = {n: tuple(int(t) for t in
+                         rng.integers(0, vocab_size,
+                                      size=shared_prefix_len))
+                for n in names} if shared_prefix_len else {}
+    rate_max = max(diurnal_rate(t, duration_s, base_rate, peak_rate,
+                                flash_crowds)
+                   for t in np.linspace(0.0, duration_s, 512))
+    events: list[TraceEvent] = []
+    t = 0.0
+    i = 0
+    while True:
+        t += float(rng.exponential(1.0 / rate_max))
+        if t >= duration_s:
+            break
+        if rng.random() * rate_max > diurnal_rate(
+                t, duration_s, base_rate, peak_rate, flash_crowds):
+            continue   # thinned: candidate above the true rate curve
+        tenant = names[int(rng.choice(len(names), p=probs))]
+        p_len = int(rng.integers(*prompt_len))
+        tail = tuple(int(tok) for tok in
+                     rng.integers(0, vocab_size, size=p_len))
+        events.append(TraceEvent(at_s=round(t, 6), spec=RequestSpec(
+            prompt=prefixes.get(tenant, ()) + tail,
+            max_new_tokens=int(rng.integers(*max_new)),
+            temperature=temperature, seed=i, tenant=tenant)))
+        i += 1
+    return events
+
+
+class _VirtualClock:
+    """The fleet-parallel trace clock :func:`run_trace` advances —
+    callable (seconds) so it can stand in for ``time.monotonic`` as an
+    Autoscaler's control-plane clock."""
+
+    __slots__ = ("t",)
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _slo_inversions(records: list[dict], weights: dict[str, int],
+                    slo_ttft_ms: float) -> int:
+    """Cross-tenant SLO inversions: a shed request whose class
+    OUTWEIGHS that of some strictly-lower-class request that arrived
+    no earlier and still completed within the TTFT SLO. Weighted fair
+    queueing plus lowest-class-first shedding makes this structurally
+    zero; the count is the acceptance check that says so."""
+    shed = [r for r in records if r["shed"]]
+    ok = [r for r in records
+          if not r["cancelled"] and not r["shed"]
+          and r["ttft_ms"] is not None and r["ttft_ms"] <= slo_ttft_ms]
+    n = 0
+    for s in shed:
+        ws = weights.get(s["tenant"], 1)
+        n += sum(1 for r in ok
+                 if weights.get(r["tenant"], 1) < ws
+                 and r["at_s"] >= s["at_s"])
+    return n
+
+
+def run_trace(engine, trace: list[TraceEvent], seed: int = 0,
+              slo_ttft_ms: float | None = None,
+              time_scale: float = 1.0,
+              class_weights: dict[str, int] | None = None) -> dict:
+    """Replay a :func:`make_trace` trace against ``engine`` (one
+    engine, a Router, or an Autoscaler — anything with the drive
+    surface) and report fleet-wide AND per-tenant metrics.
+
+    **Time is virtual and fleet-parallel.** The test host steps a
+    fleet's replicas sequentially in one process, so wall-clock
+    throughput cannot scale with replica count — a 3-replica fleet
+    measured on wall time looks exactly as fast as 1. A real fleet
+    runs one replica per host, so the harness charges each drive
+    round ``wall_cost / (time_scale * live_capacity)`` of trace time:
+    the time the round would take on parallel hardware. Arrivals,
+    TTFT, SLO attainment, makespan and replica-seconds are all read
+    off this virtual clock (idle lulls between arrivals fast-forward
+    instead of sleeping), which is what makes goodput-per-replica-
+    second comparable across fleet sizes on one machine — the same
+    move as the fleet sweep's equal-simulated-hardware cells. An
+    Autoscaler's ``set_clock`` is hooked up automatically so its
+    cooldown windows and replica-second integral tick in trace time.
+
+    ``time_scale`` sets how expensive one replica-second of compute
+    is in trace seconds: at ``time_scale=1.0`` (the calibrated-sweep
+    setting) one wall second of single-replica stepping is one trace
+    second, so :func:`calibrate_rate`'s requests/sec plugs straight
+    into ``make_trace`` rates. TTFT for a request is measured from
+    its TRACE arrival time — backlog a slow fleet accrues shows up as
+    queueing delay, exactly as a frontend's arrival queue would.
+    ``seed`` is accepted for signature parity with :func:`run_load`
+    (the trace itself already carries all randomness).
+    """
+    del seed
+    if time_scale <= 0:
+        raise ValueError(f"time_scale must be > 0, got {time_scale}")
+    cap_fn = getattr(engine, "capacity", None)
+    if cap_fn is None:
+        n_static = len(getattr(engine, "replicas", ())) or 1
+        cap_fn = lambda: n_static   # noqa: E731 — static fleet
+    vclock = _VirtualClock()
+    set_clock = getattr(engine, "set_clock", None)
+    if set_clock is not None:
+        set_clock(vclock)
+    handles: list = [None] * len(trace)
+    at_s: list = [0.0] * len(trace)      # trace arrival time
+    first_s: list = [None] * len(trace)  # virtual first-token time
+    waiting: list[int] = []
+    nxt = 0
+    while True:
+        while nxt < len(trace) and trace[nxt].at_s <= vclock.t:
+            sp = trace[nxt].spec
+            handles[nxt] = engine.submit(
+                sp.prompt, sp.max_new_tokens,
+                temperature=sp.temperature, seed=sp.seed,
+                tenant=sp.tenant)
+            at_s[nxt] = trace[nxt].at_s
+            waiting.append(nxt)
+            nxt += 1
+        t_round = time.perf_counter()
+        worked = engine.step()
+        idle = not worked and engine.outstanding() == 0
+        if not worked and not idle:
+            # Idle step with work still outstanding (a router's retry
+            # backoff lull runs on WALL timers): yield, and charge the
+            # wait into trace time like any other round.
+            time.sleep(0.001)
+        dt = time.perf_counter() - t_round
+        vclock.t += dt / (time_scale * max(1, cap_fn()))
+        for i in list(waiting):
+            h = handles[i]
+            if h.cancelled or getattr(h, "shed", False):
+                waiting.remove(i)
+            elif len(h.tokens):
+                first_s[i] = vclock.t
+                waiting.remove(i)
+        if idle:
+            if nxt >= len(trace):
+                break
+            # Nothing in flight and the next arrival is in the future:
+            # fast-forward the lull instead of sleeping through it.
+            vclock.t = max(vclock.t, trace[nxt].at_s)
+    makespan = vclock.t
+
+    assert_atomic_cutover(
+        [h for h in handles if not h.cancelled
+         and not getattr(h, "shed", False)])
+    weights = dict(class_weights or {})
+    records = []
+    for i, h in enumerate(handles):
+        shed = bool(getattr(h, "shed", False))
+        records.append({
+            "tenant": h.tenant, "shed": shed,
+            "cancelled": h.cancelled and not shed,
+            "at_s": at_s[i], "tokens": len(h.tokens),
+            "ttft_ms": ((first_s[i] - at_s[i]) * 1e3
+                        if first_s[i] is not None else None)})
+    by_tenant: dict[str, list[dict]] = {}
+    for r in records:
+        by_tenant.setdefault(r["tenant"], []).append(r)
+    tenants = {}
+    for name in sorted(by_tenant):
+        hs = by_tenant[name]
+        comp = [r for r in hs if not r["cancelled"] and not r["shed"]]
+        n_shed = sum(r["shed"] for r in hs)
+        n_canc = sum(r["cancelled"] for r in hs)
+        # inf for a (theoretical) completed request with no observed
+        # first token — keeps ttfts aligned with toks for the goodput
+        # mask; percentiles only ever see finite values in practice.
+        ttfts = np.array([r["ttft_ms"] if r["ttft_ms"] is not None
+                          else np.inf for r in comp])
+        toks = np.array([r["tokens"] for r in comp], dtype=int)
+        if slo_ttft_ms is None:
+            good = int(toks.sum()) if toks.size else 0
+        else:
+            good = int(toks[ttfts <= slo_ttft_ms].sum()) \
+                if toks.size else 0
+        tenants[name] = {
+            "submitted": len(hs),
+            "completed": len(comp),
+            "shed": int(n_shed),
+            "cancelled": int(n_canc),
+            # The per-tenant identity, at HANDLE level — the engines'
+            # internal ledgers assert the same thing engine-side.
+            "accounting_ok": len(comp) + n_canc + n_shed == len(hs),
+            "total_tokens": int(toks.sum()) if toks.size else 0,
+            "good_tokens": good,
+            "ttft_p50_ms": (round(float(np.percentile(ttfts, 50)), 3)
+                            if ttfts.size else None),
+            "ttft_p99_ms": (round(float(np.percentile(ttfts, 99)), 3)
+                            if ttfts.size else None),
+            "slo_attained": (round(float(
+                (ttfts <= slo_ttft_ms).sum()) / len(hs), 4)
+                if slo_ttft_ms is not None and len(hs) else None),
+        }
+    total_good = sum(t["good_tokens"] for t in tenants.values())
+    # Replica-seconds: an Autoscaler integrates ∫ capacity dt; a
+    # static engine/router is a constant fleet for the whole run.
+    rs_fn = getattr(engine, "replica_seconds", None)
+    if rs_fn is not None:
+        replica_s = float(rs_fn())
+    else:
+        n_rep = len(getattr(engine, "replicas", ())) or 1
+        replica_s = makespan * n_rep
+    ta_fn = getattr(engine, "tenant_accounting_ok", None)
+    out = {
+        "n_requests": len(trace),
+        "makespan_s": round(makespan, 4),
+        "trace_span_s": (round(trace[-1].at_s, 3) if trace else 0.0),
+        "time_scale": time_scale,
+        "slo_ttft_ms": slo_ttft_ms,
+        "n_completed": sum(t["completed"] for t in tenants.values()),
+        "n_shed": sum(t["shed"] for t in tenants.values()),
+        "n_cancelled": sum(t["cancelled"] for t in tenants.values()),
+        "accounting_ok": all(t["accounting_ok"]
+                             for t in tenants.values()),
+        "tenant_accounting_ok": (bool(ta_fn()) if ta_fn is not None
+                                 else None),
+        "total_tokens": sum(t["total_tokens"]
+                            for t in tenants.values()),
+        "good_tokens": total_good,
+        "goodput_tokens_per_sec": round(total_good / makespan, 3),
+        "replica_seconds": round(replica_s, 4),
+        "goodput_per_replica_sec": round(
+            total_good / replica_s, 3) if replica_s else None,
+        "slo_inversions": (_slo_inversions(records, weights,
+                                           slo_ttft_ms)
+                           if slo_ttft_ms is not None else None),
+        "tenants": tenants,
+    }
+    stats_fn = getattr(engine, "stats", None)
+    if stats_fn is not None and hasattr(engine, "scale_ups"):
+        st = stats_fn()
+        out["autoscale"] = {k: st[k] for k in
+                            ("n_replicas", "capacity", "scale_ups",
+                             "scale_downs", "migrated_on_drain",
+                             "boot_s")}
+    return out

@@ -40,6 +40,22 @@ queue, then admits nothing until the whole batch drains — classic
 static batching, with all other machinery identical, so the serve
 sweep's continuous-vs-static comparison isolates exactly the
 scheduling policy.
+
+Multi-tenancy (docs/DESIGN.md §25, ``TPU_DDP_TENANT_CLASSES``): with
+tenant classes configured, admission switches from global FIFO to
+WEIGHTED FAIR QUEUEING over per-tenant FIFO heads — stride scheduling:
+each tenant carries a virtual ``pass`` that advances by
+``work / weight`` per admission, and the tenant with the smallest pass
+admits next. A weight-3 tenant therefore gets 3x the admission
+bandwidth of a weight-1 tenant under contention, while FIFO order holds
+WITHIN each tenant and an idle tenant re-joins at the current virtual
+time (it cannot hoard credit and then starve everyone). Classes also
+carry an optional per-request TTFT deadline (queued past it = shed, the
+engine enforces it) and an optional outstanding-token budget (a tenant
+at its budget is passed over for admission until its own work retires —
+one tenant cannot monopolize the slot bank no matter its arrival rate).
+The accounting identity ``completed + cancelled + shed == submitted``
+is tracked and enforced PER TENANT by the engine.
 """
 
 from __future__ import annotations
@@ -47,6 +63,80 @@ from __future__ import annotations
 import dataclasses
 from collections import deque
 from typing import Any
+
+DEFAULT_TENANT = "default"
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantClass:
+    """One tenant's SLO class: WFQ weight (higher = more admission
+    bandwidth, and sheds LAST under pressure), optional queued-TTFT
+    deadline, optional outstanding-token budget."""
+
+    name: str
+    weight: int = 1
+    deadline_ms: float = 0.0   # 0 = no per-class queue deadline
+    token_budget: int = 0      # 0 = unbounded outstanding tokens
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("tenant class needs a non-empty name")
+        if self.weight < 1:
+            raise ValueError(
+                f"tenant {self.name!r}: weight must be >= 1, "
+                f"got {self.weight}")
+        if self.deadline_ms < 0:
+            raise ValueError(
+                f"tenant {self.name!r}: deadline_ms must be >= 0")
+        if self.token_budget < 0:
+            raise ValueError(
+                f"tenant {self.name!r}: token_budget must be >= 0")
+
+
+def parse_tenant_classes(spec: str | None) -> dict[str, TenantClass]:
+    """Parse a ``TPU_DDP_TENANT_CLASSES`` value: comma-separated
+    ``name=weight[:deadline_ms[:token_budget]]`` entries. Empty/None
+    means no classes (single anonymous tenant, plain FIFO). Raises
+    ValueError with the offending entry on malformed input — a typo'd
+    class silently running as weight-1 would fake SLO coverage."""
+    out: dict[str, TenantClass] = {}
+    if not spec:
+        return out
+    for entry in str(spec).split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, eq, rest = entry.partition("=")
+        name = name.strip()
+        if not eq or not name:
+            raise ValueError(
+                f"bad tenant class {entry!r}: expected "
+                "name=weight[:deadline_ms[:token_budget]]")
+        if name in out:
+            raise ValueError(f"duplicate tenant class {name!r}")
+        parts = rest.split(":")
+        if len(parts) > 3:
+            raise ValueError(
+                f"bad tenant class {entry!r}: at most "
+                "weight:deadline_ms:token_budget")
+        try:
+            weight = int(parts[0])
+            deadline = float(parts[1]) if len(parts) > 1 and parts[1] \
+                else 0.0
+            budget = int(parts[2]) if len(parts) > 2 and parts[2] else 0
+        except ValueError:
+            raise ValueError(
+                f"bad tenant class {entry!r}: expected "
+                "name=weight[:deadline_ms[:token_budget]] with "
+                "numeric fields") from None
+        out[name] = TenantClass(name, weight, deadline, budget)
+    return out
+
+
+def tenant_of(request) -> str:
+    """The tenant a request belongs to (engine-independent handles
+    from older call sites default to the anonymous tenant)."""
+    return getattr(request, "tenant", DEFAULT_TENANT)
 
 
 @dataclasses.dataclass
@@ -66,7 +156,7 @@ class SlotState:
 
 class Scheduler:
     def __init__(self, pool, num_slots: int, mode: str = "continuous",
-                 prefix=None, role: str = "serve"):
+                 prefix=None, role: str = "serve", tenants=None):
         if mode not in ("continuous", "static"):
             raise ValueError(f"unknown scheduler mode {mode!r}; "
                              "expected 'continuous' or 'static'")
@@ -78,6 +168,15 @@ class Scheduler:
         self.pool = pool
         self.num_slots = num_slots
         self.mode = mode
+        # name -> TenantClass. None/empty = single anonymous tenant,
+        # admission stays plain FIFO (the pre-§25 behavior, bit for
+        # bit). Tenants arriving WITHOUT a configured class get an
+        # implicit weight-1 class: classes are scheduling policy, not
+        # an ACL.
+        self.tenants: dict[str, TenantClass] | None = \
+            dict(tenants) if tenants else None
+        self._tenant_pass: dict[str, float] = {}  # WFQ virtual passes
+        self._vtime = 0.0  # virtual time = pass of the last admission
         # Optional fleet.prefix.PrefixIndex: admission consults it so
         # shared-prompt requests adopt cached blocks instead of
         # re-prefilling them.
@@ -160,51 +259,131 @@ class Scheduler:
         rule. Returns the newly filled slot indices."""
         if self.mode == "static" and self.live:
             return []  # static batching: drain fully before re-admitting
+        if self.tenants is None:
+            return self._admit_fifo()
+        return self._admit_wfq()
+
+    def _admit_fifo(self) -> list[int]:
         admitted = []
         for i in range(self.num_slots):
             if not self.queue or self.slots[i] is not None:
                 continue
-            req = self.queue[0]
-            need = self.worst_case_blocks(req)
-            hit = (self.prefix.plan(req.prompt)
-                   if self.prefix is not None else None)
-            # Every draw this admission makes on (free + evictable):
-            # fresh blocks (need minus cached hits, +1 for the CoW
-            # copy), plus each hit whose share pins a previously
-            # evictable cache entry.
-            draw = need
-            if hit is not None:
-                draw -= len(hit.blocks)
-                draw += 1 if hit.cow else 0
-                draw += sum(self.pool.refcount(b) == 1
-                            for b in hit.blocks)
-            if draw > self.pool_budget:
+            if not self._fill_slot(i, self.queue[0]):
                 break  # FIFO: never skip the head
             self.queue.popleft()
-            slot = SlotState(request=req, admit_seq=self._admit_seq,
-                             phase="prefill", reserved=need)
-            self._admit_seq += 1
-            if hit is not None:
-                self.prefix.share(hit)  # no-op stats on a miss
-            if hit:
-                slot.blocks = list(hit.blocks)
-                if hit.cow:
-                    # The last hit block would be written in place by
-                    # the re-run of the final prompt token — swap in a
-                    # private copy and drop our share of the original.
-                    private = self.pool.cow(slot.blocks[-1])
-                    self.pool.free([slot.blocks[-1]])
-                    slot.blocks[-1] = private
-                slot.prefill_done = hit.cached_len
-                slot.length = hit.cached_len
-            # Remaining prompt blocks up front (prefill scatters into
-            # them this or next step); generation blocks arrive lazily.
-            for _ in range(self.pool.blocks_for(len(req.prompt))
-                           - len(slot.blocks)):
-                slot.blocks.append(self.pool.alloc())
-            self.slots[i] = slot
             admitted.append(i)
         return admitted
+
+    def _admit_wfq(self) -> list[int]:
+        """Weighted fair queueing over per-tenant FIFO heads (stride
+        scheduling). The WFQ-selected head inherits the FIFO
+        no-starvation rule: if it does not fit the pool budget,
+        NOTHING else is admitted this round — retirement frees blocks
+        monotonically and the tenant keeps the minimum pass until
+        served, so it eventually admits. Budget-capped tenants are
+        different: skipping them is the point (their own retirements
+        un-cap them)."""
+        admitted = []
+        free = [i for i in range(self.num_slots) if self.slots[i] is None]
+        capped: set[str] = set()
+        while free and self.queue:
+            heads: dict[str, Any] = {}
+            for r in self.queue:
+                heads.setdefault(tenant_of(r), r)
+            cand = [t for t in heads if t not in capped]
+            if not cand:
+                break
+            t = min(cand, key=lambda t: (
+                self._tenant_pass.get(t, self._vtime),
+                -self._class(t).weight, heads[t].rid))
+            req = heads[t]
+            cls = self._class(t)
+            work = len(req.prompt) + req.max_new_tokens
+            # Token budget: pass the tenant over while ITS live work
+            # exceeds the cap — but never wedge a request bigger than
+            # the whole budget (it admits when the tenant is idle).
+            live = self.tenant_live_tokens(t)
+            if cls.token_budget and live and live + work > cls.token_budget:
+                capped.add(t)
+                continue
+            if not self._fill_slot(free[0], req):
+                break  # reservation rule: stop, don't reorder past it
+            self._remove_queued(req)
+            admitted.append(free.pop(0))
+            # Stride advance: virtual time is the served tenant's pass
+            # BEFORE the increment; an idle tenant re-joining starts at
+            # the current virtual time (no hoarded credit).
+            t_pass = max(self._tenant_pass.get(t, 0.0), self._vtime)
+            self._vtime = t_pass
+            self._tenant_pass[t] = t_pass + work / cls.weight
+        return admitted
+
+    def _fill_slot(self, i: int, req) -> bool:
+        """Reservation-rule check + slot fill for one request. False
+        when the pool budget cannot cover the draw (the caller stops
+        admitting; the request stays queued)."""
+        need = self.worst_case_blocks(req)
+        hit = (self.prefix.plan(req.prompt, ns=tenant_of(req))
+               if self.prefix is not None else None)
+        # Every draw this admission makes on (free + evictable):
+        # fresh blocks (need minus cached hits, +1 for the CoW
+        # copy), plus each hit whose share pins a previously
+        # evictable cache entry.
+        draw = need
+        if hit is not None:
+            draw -= len(hit.blocks)
+            draw += 1 if hit.cow else 0
+            draw += sum(self.pool.refcount(b) == 1
+                        for b in hit.blocks)
+        if draw > self.pool_budget:
+            return False
+        slot = SlotState(request=req, admit_seq=self._admit_seq,
+                         phase="prefill", reserved=need)
+        self._admit_seq += 1
+        if hit is not None:
+            self.prefix.share(hit)  # no-op stats on a miss
+        if hit:
+            slot.blocks = list(hit.blocks)
+            if hit.cow:
+                # The last hit block would be written in place by
+                # the re-run of the final prompt token — swap in a
+                # private copy and drop our share of the original.
+                private = self.pool.cow(slot.blocks[-1])
+                self.pool.free([slot.blocks[-1]])
+                slot.blocks[-1] = private
+            slot.prefill_done = hit.cached_len
+            slot.length = hit.cached_len
+        # Remaining prompt blocks up front (prefill scatters into
+        # them this or next step); generation blocks arrive lazily.
+        for _ in range(self.pool.blocks_for(len(req.prompt))
+                       - len(slot.blocks)):
+            slot.blocks.append(self.pool.alloc())
+        self.slots[i] = slot
+        return True
+
+    def _class(self, tenant: str) -> TenantClass:
+        cls = self.tenants.get(tenant) if self.tenants else None
+        return cls if cls is not None else TenantClass(tenant)
+
+    def _remove_queued(self, req) -> None:
+        """Drop ``req`` from the queue by IDENTITY — dataclass
+        equality would compare prompt arrays elementwise."""
+        for j, r in enumerate(self.queue):
+            if r is req:
+                del self.queue[j]
+                return
+        raise RuntimeError("request vanished from the queue mid-admit")
+
+    def tenant_live_tokens(self, tenant: str) -> int:
+        """Outstanding (unretired) token work tenant ``tenant`` holds
+        in live slots — the quantity its token budget caps."""
+        total = 0
+        for s in self.slots:
+            if s is None or tenant_of(s.request) != tenant:
+                continue
+            total += (len(s.request.prompt) - s.prefill_done) \
+                + (s.request.max_new_tokens - s.generated)
+        return total
 
     def place(self, request, blocks, length: int,
               pending_token: int) -> int:

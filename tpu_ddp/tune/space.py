@@ -292,6 +292,31 @@ KNOBS: tuple[Knob, ...] = (
          doc="steps the trainer may run ahead of the slowest "
              "subscriber before its publish gate blocks; 0 = "
              "unbounded (fully async)"),
+    # Autoscaling + multi-tenancy knobs (fleet/autoscale.py,
+    # serve/scheduler.py WFQ — DESIGN.md §25): same goodput objective,
+    # measured by the day-in-the-life trace harness (loadgen.run_trace).
+    Knob("fleet_autoscale", "fleet_autoscale", "TPU_DDP_FLEET_AUTOSCALE",
+         values=(False, True), flag="--fleet-autoscale",
+         objective="goodput",
+         doc="autoscaling replica lifecycle control plane "
+             "(fleet/autoscale.py): scale-up boots replicas from the "
+             "publisher's full-push path, scale-down drains via "
+             "bitwise continuation migration; off = static fleet"),
+    Knob("scale_cooldown_ms", "scale_cooldown_ms",
+         "TPU_DDP_SCALE_COOLDOWN_MS",
+         values=(250.0, 1000.0, 5000.0), flag="--scale-cooldown-ms",
+         objective="goodput",
+         doc="minimum ms between autoscaler actions: short cooldowns "
+             "react faster to a flash crowd but risk boot/drain "
+             "thrash at the hysteresis band edge; must be > 0"),
+    Knob("tenant_classes", "tenant_classes", "TPU_DDP_TENANT_CLASSES",
+         values=("", "gold=3,silver=2,bronze=1"),
+         flag="--tenant-classes", objective="goodput",
+         doc="SLO classes for multi-tenant serving "
+             "(serve/scheduler.py): comma-separated name=weight"
+             "[:deadline_ms[:token_budget]]; non-empty switches "
+             "admission from FIFO to weighted fair queueing with "
+             "lowest-class-first shedding; empty = single-tenant"),
 )
 
 # Model-level knobs are baked into get_model() before the Trainer ever
@@ -456,6 +481,12 @@ def violations(assignment: Mapping, ctx: Workload) -> list[str]:
                 f"max_staleness_steps={get('max_staleness_steps')} "
                 "with publish_every=0 — the gate only arms on "
                 "publish, so the cell duplicates the default")
+    if get("scale_cooldown_ms", 1000.0) != 1000.0 \
+            and not get("fleet_autoscale", False):
+        bad.append(
+            f"scale_cooldown_ms={get('scale_cooldown_ms')} without "
+            "fleet_autoscale — the cooldown only gates autoscaler "
+            "actions, so the cell duplicates the default")
     # Pipeline knobs (round 10) — mirror PipelineLMTrainer's guards.
     sched = get("pp_schedule", "gpipe")
     virt = get("pp_virtual", 1)

@@ -239,6 +239,26 @@ class TrainConfig:
     # capacity on an already-missed SLO (0 = off). Env:
     # TPU_DDP_SERVE_SHED_MS.
     serve_shed_ms: float = 0.0
+    # Autoscaling fleet control plane (tpu_ddp/fleet/autoscale.py,
+    # docs/DESIGN.md §25): an Autoscaler over the Router boots
+    # replicas from the weight-publisher's full-push path under load
+    # and drains them via deterministic migration when idle. Env:
+    # TPU_DDP_FLEET_AUTOSCALE.
+    fleet_autoscale: bool = False
+    # Minimum ms between autoscale actions — the cooldown half of the
+    # thrash guard (hysteresis streaks are Autoscaler constructor
+    # args). Must be > 0: a zero cooldown lets one flash crowd churn
+    # boot/drain cycles that burn the capacity scaling should add.
+    # Env: TPU_DDP_SCALE_COOLDOWN_MS.
+    scale_cooldown_ms: float = 1000.0
+    # Tenant SLO classes for weighted fair queueing
+    # (tpu_ddp/serve/scheduler.py): comma-separated
+    # "name=weight[:deadline_ms[:token_budget]]" entries; empty = one
+    # anonymous class, plain FIFO admission. Mirrors
+    # scheduler.parse_tenant_classes (the source of truth, which
+    # re-validates at engine construction). Env:
+    # TPU_DDP_TENANT_CLASSES.
+    tenant_classes: str = ""
 
     # Live train->serve weight streaming (tpu_ddp/publish/,
     # docs/DESIGN.md §24). Publish a versioned weight update to
@@ -515,6 +535,39 @@ class TrainConfig:
             raise ValueError(
                 f"publish_every must be >= 0, got "
                 f"{self.publish_every} (TPU_DDP_PUBLISH_EVERY)")
+        self.fleet_autoscale = _env_bool("TPU_DDP_FLEET_AUTOSCALE",
+                                         self.fleet_autoscale)
+        self.scale_cooldown_ms = _env_num(
+            "TPU_DDP_SCALE_COOLDOWN_MS", float, self.scale_cooldown_ms)
+        if self.scale_cooldown_ms <= 0:
+            raise ValueError(
+                f"scale_cooldown_ms must be > 0, got "
+                f"{self.scale_cooldown_ms} (TPU_DDP_SCALE_COOLDOWN_MS)")
+        env_tc = os.environ.get("TPU_DDP_TENANT_CLASSES")
+        if env_tc is not None:
+            self.tenant_classes = env_tc
+        # Mirrors serve/scheduler.py parse_tenant_classes (the source
+        # of truth, which re-validates at engine construction): comma-
+        # separated name=weight[:deadline_ms[:token_budget]] entries.
+        for entry in str(self.tenant_classes).split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            name, _, rest = entry.partition("=")
+            parts = rest.split(":")
+            ok = bool(name.strip()) and "=" in entry and \
+                1 <= len(parts) <= 3
+            if ok:
+                try:
+                    ok = float(parts[0]) >= 1 and all(
+                        float(p) >= 0 for p in parts[1:])
+                except ValueError:
+                    ok = False
+            if not ok:
+                raise ValueError(
+                    f"tenant_classes entry {entry!r}: expected "
+                    "name=weight[:deadline_ms[:token_budget]] "
+                    "(TPU_DDP_TENANT_CLASSES)")
         env_pw = os.environ.get("TPU_DDP_PUBLISH_WIRE")
         if env_pw:
             self.publish_wire = env_pw
