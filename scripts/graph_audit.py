@@ -188,9 +188,23 @@ def audit_serve_cells():
     model = _tiny_lm()
     params = model.init(jax.random.key(0))
     engine = ServeEngine(model, params, **GEOM)
+    # Speculative + int8 surfaces (DESIGN.md §26). The "chain" family
+    # adds NO program (it re-dispatches serve/decode — that absence IS
+    # its bitwise-parity argument); the fused families and the int8
+    # tree each compile distinct programs, audited here. A quantized
+    # params tree has a different treedef, so the int8 decode/prefill
+    # cells are separate jit cache entries, not retraces.
+    spec = ServeEngine(model, params, spec_k=4, spec_draft="self-1",
+                       **GEOM)
+    specq = ServeEngine(model, params, spec_k=4, spec_draft="quant",
+                        decode_quant="int8", **GEOM)
     return [
         _program_audit("serve/decode", engine.lower_decode_step),
         _program_audit("serve/prefill", engine.lower_prefill_step),
+        _program_audit("serve/spec-step", spec.lower_spec_step),
+        _program_audit("serve/spec-step+quant", specq.lower_spec_step),
+        _program_audit("serve/decode+int8", specq.lower_decode_step),
+        _program_audit("serve/prefill+int8", specq.lower_prefill_step),
     ]
 
 

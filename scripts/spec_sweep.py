@@ -1,0 +1,260 @@
+"""Speculative decoding + int8 decode sweep -> experiments/spec_sweep.json.
+
+Two regimes, because the chain family's win is fixed-overhead
+amortization and that mechanism is regime-dependent:
+
+* ``latency`` — a dispatch-overhead-dominated micro model (1 layer,
+  d_model 64) at low batch: the canonical speculative-decoding setting,
+  where a single-token decode step is almost pure per-step cost (on a
+  real accelerator a one-token step can't fill the chip; on the CPU CI
+  host the analogue is the host/dispatch loop). Chain speculation
+  multiplies raw tokens/sec here — the >= 2x claim is enforced on this
+  regime's best chain cell.
+* ``throughput`` — the serve_sweep TransformerLM-tiny geometry at
+  num_slots=8, where per-token model compute is a much larger share.
+  Chain still wins (reported, not held to 2x) and the int8 + fused
+  draft cells run here.
+
+Enforced claims (exit 1 on violation):
+
+1. best latency-regime chain speedup >= 2.0x over the k=0 baseline;
+2. BITWISE accept-path parity on every chain cell: token AND logprob
+   streams equal the matching k=0 engine's, request by request
+   (fp32 chain vs fp32 baseline, int8 chain vs int8 baseline);
+3. acceptance ledger identity on every speculative cell:
+   proposed == accepted + rejected, per request and in aggregate, and
+   pool accounting (free + allocated == total) after drain;
+4. int8 weight-only decode quality: relative mean-NLL drift vs fp32
+   <= 0.25% (the compress-sweep convergence-drift convention);
+5. fused quant-draft acceptance >= 0.5 (the draft must actually
+   predict the target, not coast on the always-accepted first column).
+
+Fused cells (self-<j> / quant drafts) report acceptance and speedup;
+their accepted tokens are target-program samples inside one fused
+program, but cross-program CPU XLA drift (gemm tiling differs by
+batch extent) makes bitwise parity vs the k=0 program unattainable —
+DESIGN.md §26 — so they carry no bitwise claim here.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+TEMPERATURE = 0.8
+REPS = 3          # per-cell repeats; best wall-clock wins (noise floor)
+
+
+def make_engine(regime: str, **knobs):
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_ddp.models.transformer import make_transformer
+    from tpu_ddp.serve import ServeEngine
+
+    if regime == "latency":
+        model = make_transformer(
+            "TransformerLM-tiny", num_layers=1, num_heads=2, d_model=64,
+            d_ff=256, max_seq_len=256, compute_dtype=jnp.float32)
+        geom = dict(num_slots=2, block_size=16, prefill_chunk=32)
+    else:
+        model = make_transformer("TransformerLM-tiny", max_seq_len=64,
+                                 compute_dtype=jnp.float32)
+        geom = dict(num_slots=8, block_size=16, prefill_chunk=32)
+    params = model.init(jax.random.key(0))
+    return ServeEngine(model, params, mode="continuous", **geom, **knobs)
+
+
+def make_requests(n: int, max_new: int, seed: int) -> list:
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(1, 1024,
+                          size=int(rng.integers(4, 9))).astype(np.int32),
+             max_new, int(rng.integers(0, 2**31 - 1)))
+            for _ in range(n)]
+
+
+def run_cell(regime: str, reqs: list, **knobs) -> dict:
+    """Run one engine over the workload REPS times; keep the fastest
+    wall-clock (streams are deterministic across reps — verified)."""
+    best = None
+    for _ in range(REPS):
+        eng = make_engine(regime, **knobs)
+        handles = [eng.submit(p, mn, temperature=TEMPERATURE, seed=s)
+                   for p, mn, s in reqs]
+        t0 = time.perf_counter()
+        eng.run()
+        dt = time.perf_counter() - t0
+        tokens = sum(len(h.tokens) for h in handles)
+        cell = {
+            "tokens_per_sec": round(tokens / dt, 1),
+            "total_tokens": tokens,
+            "wall_s": round(dt, 4),
+            "streams": [(tuple(h.tokens), tuple(h.logprobs))
+                        for h in handles],
+            "ledger_ok": all(
+                h.spec_proposed == h.spec_accepted + h.spec_rejected
+                for h in handles),
+            "pool_ok": eng.accounting_ok(),
+        }
+        if getattr(eng, "spec_k", 0) > 0:
+            st = eng.spec_stats()
+            cell["speculative"] = st
+            cell["ledger_ok"] = (cell["ledger_ok"] and
+                                 st["proposed"]
+                                 == st["accepted"] + st["rejected"])
+        if best is not None and best["streams"] != cell["streams"]:
+            print("[spec-sweep] REGRESSION: nondeterministic streams "
+                  "across repeats", flush=True)
+            raise SystemExit(1)
+        if best is None or cell["wall_s"] < best["wall_s"]:
+            streams = cell["streams"] if best is None else best["streams"]
+            cell["streams"] = streams
+            best = cell
+    return best
+
+
+def main() -> int:
+    import jax
+
+    dev = jax.devices()[0]
+    fails: list[str] = []
+    out_cells: dict = {}
+
+    def publish(name: str, cell: dict) -> dict:
+        """Strip the stream payload before committing the cell."""
+        pub = {k: v for k, v in cell.items() if k != "streams"}
+        out_cells[name] = pub
+        return pub
+
+    def check(ok: bool, msg: str) -> None:
+        tag = "ok" if ok else "REGRESSION"
+        print(f"[spec-sweep] {tag}: {msg}", flush=True)
+        if not ok:
+            fails.append(msg)
+
+    # ---- latency regime: the >= 2x chain claim ------------------------
+    lat_reqs = make_requests(8, max_new=208, seed=11)
+    lat0 = run_cell("latency", lat_reqs)
+    publish("latency/k0", lat0)
+    best_speedup = 0.0
+    for k in (12, 25):
+        cell = run_cell("latency", lat_reqs, spec_k=k)
+        speedup = cell["tokens_per_sec"] / lat0["tokens_per_sec"]
+        cell["speedup_vs_k0"] = round(speedup, 3)
+        best_speedup = max(best_speedup, speedup)
+        check(cell["streams"] == lat0["streams"],
+              f"latency/chain_k{k}: bitwise token+logprob parity vs k=0")
+        check(cell["ledger_ok"] and cell["pool_ok"],
+              f"latency/chain_k{k}: ledger identity + pool accounting")
+        publish(f"latency/chain_k{k}", cell)
+    check(best_speedup >= 2.0,
+          f"latency regime best chain speedup {best_speedup:.2f}x >= 2.0x")
+
+    # ---- throughput regime: tiny model, batch 8 -----------------------
+    tp_reqs = make_requests(32, max_new=52, seed=11)
+    tp0 = run_cell("throughput", tp_reqs)
+    publish("throughput/k0", tp0)
+    chain = run_cell("throughput", tp_reqs, spec_k=12)
+    chain["speedup_vs_k0"] = round(
+        chain["tokens_per_sec"] / tp0["tokens_per_sec"], 3)
+    check(chain["streams"] == tp0["streams"],
+          "throughput/chain_k12: bitwise token+logprob parity vs k=0")
+    check(chain["ledger_ok"] and chain["pool_ok"],
+          "throughput/chain_k12: ledger identity + pool accounting")
+    check(chain["tokens_per_sec"] > tp0["tokens_per_sec"],
+          "throughput/chain_k12 beats k=0 baseline")
+    publish("throughput/chain_k12", chain)
+
+    # int8 weight-only decode: chain parity must hold WITHIN the
+    # quantized stream family (int8 k>0 vs int8 k=0).
+    tp0q = run_cell("throughput", tp_reqs, decode_quant="int8")
+    tp0q["speedup_vs_fp32_k0"] = round(
+        tp0q["tokens_per_sec"] / tp0["tokens_per_sec"], 3)
+    publish("throughput/k0+int8", tp0q)
+    chainq = run_cell("throughput", tp_reqs, spec_k=12,
+                      decode_quant="int8")
+    chainq["speedup_vs_k0_int8"] = round(
+        chainq["tokens_per_sec"] / tp0q["tokens_per_sec"], 3)
+    check(chainq["streams"] == tp0q["streams"],
+          "throughput/chain_k12+int8: bitwise parity vs int8 k=0")
+    check(chainq["ledger_ok"] and chainq["pool_ok"],
+          "throughput/chain_k12+int8: ledger identity + pool accounting")
+    publish("throughput/chain_k12+int8", chainq)
+
+    # fused draft families: acceptance mechanics, no bitwise claim.
+    for name, knobs in (("self1_k4", dict(spec_k=4, spec_draft="self-1")),
+                        ("quant_k4", dict(spec_k=4, spec_draft="quant",
+                                          decode_quant="int8"))):
+        cell = run_cell("throughput", tp_reqs, **knobs)
+        cell["speedup_vs_k0"] = round(
+            cell["tokens_per_sec"] / tp0["tokens_per_sec"], 3)
+        check(cell["ledger_ok"] and cell["pool_ok"],
+              f"throughput/{name}: ledger identity + pool accounting")
+        publish(f"throughput/{name}", cell)
+    qacc = out_cells["throughput/quant_k4"]["speculative"]["acceptance"]
+    check(qacc >= 0.5,
+          f"fused quant-draft acceptance {qacc:.3f} >= 0.5")
+
+    # ---- int8 quality bar --------------------------------------------
+    import jax.numpy as jnp
+
+    from tpu_ddp.models.transformer import make_transformer
+    from tpu_ddp.ops.quant import nll_drift, quantize_params
+
+    model = make_transformer("TransformerLM-tiny", max_seq_len=64,
+                             compute_dtype=jnp.float32)
+    params = model.init(jax.random.key(0))
+    qparams = quantize_params(model, params)
+    rng = np.random.default_rng(3)
+    eval_tokens = jnp.asarray(
+        rng.integers(1, 1024, size=(8, 48)).astype(np.int32))
+    drift = nll_drift(model, params, qparams, eval_tokens)
+    drift = {k: (round(v, 6) if isinstance(v, float) else v)
+             for k, v in drift.items()}
+    check(drift["rel_drift"] <= 0.0025,
+          f"int8 decode NLL drift {drift['rel_drift']:.5f} <= 0.25%")
+
+    out = {
+        "sweep": "speculative decoding + weight-only int8 decode",
+        "note": ("chain = spec_k+1 chained dispatches of the SAME "
+                 "compiled decode program (bitwise-exact accept path, "
+                 "acceptance 1 by construction); fused self-<j>/quant "
+                 "= one draft+verify program (acceptance < 1, no "
+                 "bitwise claim on CPU XLA — DESIGN.md §26). The "
+                 ">= 2x tokens/sec claim is enforced on the latency "
+                 "regime, ledger identity and the 0.25% int8 NLL bar "
+                 "on every cell (exit 1 on violation)."),
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "temperature": TEMPERATURE,
+        "regimes": {
+            "latency": {"model": "1L/64d micro", "num_slots": 2,
+                        "n_requests": 8, "max_new": 208},
+            "throughput": {"model": "TransformerLM-tiny", "num_slots": 8,
+                           "n_requests": 32, "max_new": 52},
+        },
+        "best_latency_chain_speedup": round(best_speedup, 3),
+        "int8_quality": drift,
+        "cells": out_cells,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    (REPO / "experiments" / "spec_sweep.json").write_text(
+        json.dumps(out, indent=1))
+    print(f"[spec-sweep] wrote experiments/spec_sweep.json "
+          f"({len(out_cells)} cells)", flush=True)
+    if fails:
+        print(f"[spec-sweep] {len(fails)} claim(s) FAILED", flush=True)
+        return 1
+    print("[spec-sweep] all claims hold", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

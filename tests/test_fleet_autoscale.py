@@ -340,13 +340,14 @@ def test_scale_up_boots_current_version_no_new_compiles(model, params):
 
 
 def test_graph_audit_n_programs_pinned():
-    """Autoscaling added ZERO new jit surfaces: the committed audit
-    artifact still fingerprints exactly 19 programs."""
+    """Speculative decoding + int8 decode added exactly FOUR jit
+    surfaces (spec-step, spec-step+quant, decode+int8, prefill+int8;
+    the chain family deliberately adds none): 19 -> 23 programs."""
     art = pathlib.Path(__file__).resolve().parents[1] / \
         "experiments" / "graph_audit.json"
     audit = json.loads(art.read_text())
-    assert audit["n_programs"] == 19
-    assert len(audit["cells"]) == 19
+    assert audit["n_programs"] == 23
+    assert len(audit["cells"]) == 23
 
 
 # ---------------------------------------------------------------------------

@@ -108,7 +108,11 @@ def test_serve_knobs_registered_under_goodput_objective():
               "publish_every", "publish_wire", "max_staleness_steps",
               # Autoscaling knobs (DESIGN.md §25): replica lifecycle in
               # the Autoscaler, SLO classes in the scheduler's WFQ.
-              "fleet_autoscale", "scale_cooldown_ms", "tenant_classes"}
+              "fleet_autoscale", "scale_cooldown_ms", "tenant_classes",
+              # Speculative decoding + quantized decode (DESIGN.md
+              # §26): window width and draft family in the engine,
+              # int8 weights at engine construction.
+              "spec_k", "spec_draft", "decode_quant"}
     for f in fields:
         k = knob_by_field(f)
         assert k is not None and k.objective == "goodput", f
@@ -138,6 +142,14 @@ def test_serve_knobs_registered_under_goodput_objective():
     # whole control plane is pure scheduling.
     for f in ("fleet_autoscale", "scale_cooldown_ms", "tenant_classes"):
         assert not knob_by_field(f).semantic, f
+    # int8 decode rounds the served logits -> semantic like
+    # publish_wire; speculation never changes the emitted stream (the
+    # chain family is bitwise, the fused families emit only target
+    # samples), so spec_k/spec_draft are pure scheduling.
+    assert knob_by_field("decode_quant").semantic
+    assert not knob_by_field("spec_k").semantic
+    assert not knob_by_field("spec_draft").semantic
+    assert knob_by_field("spec_k").env == "TPU_DDP_SPEC_K"
     cfg, ctx = TrainConfig(), Workload(platform="cpu")
     good = {k.field for k, _ in
             searchable_knobs(cfg, ctx, objective="goodput",
@@ -145,21 +157,30 @@ def test_serve_knobs_registered_under_goodput_objective():
     # At the default config the coupled fleet knobs collapse to single
     # candidates (kv_wire needs a disagg edge, prefix-affinity needs a
     # cache, the publish wire and gate need a publish cadence, the
-    # scale cooldown needs a live autoscaler — tune/space.py
-    # violations) and drop out of the space.
+    # scale cooldown needs a live autoscaler, a non-chain draft needs
+    # spec_k > 0 — tune/space.py violations) and drop out of the
+    # space; spec_k and decode_quant are live on a single engine.
     assert good == fields - {"router_policy", "kv_wire",
                              "publish_wire", "max_staleness_steps",
-                             "scale_cooldown_ms"}
+                             "scale_cooldown_ms", "spec_draft"}
     step = {k.field for k, _ in searchable_knobs(cfg, ctx)}
     assert not (step & fields)
     # With the edge, the cache, a publish cadence, and the autoscaler
-    # on, the whole fleet space opens up.
+    # on, the whole fleet space opens up — EXCEPT speculation, which
+    # the disagg decode tier's fused adopt+decode program excludes
+    # (spec_k collapses to {0}, which in turn keeps spec_draft inert).
     fleet_cfg = TrainConfig(fleet_roles="disagg", prefix_cache=True,
                             publish_every=1, fleet_autoscale=True)
     good = {k.field for k, _ in
             searchable_knobs(fleet_cfg, ctx, objective="goodput",
                              include_semantic=True)}
-    assert good == fields
+    assert good == fields - {"spec_k", "spec_draft"}
+    # On a single engine with speculation on, the draft family opens.
+    spec_cfg = TrainConfig(spec_k=4)
+    good = {k.field for k, _ in
+            searchable_knobs(spec_cfg, ctx, objective="goodput",
+                             include_semantic=True)}
+    assert "spec_draft" in good and "decode_quant" in good
 
 
 def test_reverse_check_catches_unregistered_remat_env():

@@ -98,9 +98,24 @@ def donation_report(lowered, compiled=None, min_bytes: int = 0) -> dict:
     aliased = parse_input_output_alias(text)
     info = _flat_args_info(lowered)
     donated = [i for i, (d, _) in enumerate(info) if d]
+    # The alias header numbers parameters AFTER jit's dead-argument
+    # elimination, while args_info numbers the caller's flat arguments
+    # — a program with unused leaves (e.g. a partial-depth draft tree)
+    # shifts every later parameter. Map through the executable's kept
+    # indices; a donated argument that was dropped entirely transfers
+    # no buffer, so it cannot be a copy and is skipped.
+    kept = getattr(getattr(compiled, "_executable", None),
+                   "_kept_var_idx", None)
+    hlo_pos = ({flat: p for p, flat in enumerate(sorted(kept))}
+               if kept is not None else None)
     findings = []
     for i in donated:
-        if i in aliased:
+        if hlo_pos is None:
+            if i in aliased:
+                continue
+        elif i not in hlo_pos:
+            continue            # dead argument: never materialized
+        elif hlo_pos[i] in aliased:
             continue
         aval = info[i][1]
         nbytes = _aval_bytes(aval)

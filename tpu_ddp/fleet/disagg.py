@@ -190,6 +190,7 @@ class DisaggEngine:
                  queue_limit: int | None = None,
                  shed_ms: float | None = None,
                  tenant_classes: str | None = None,
+                 decode_quant: str | None = None,
                  metrics: MetricsLogger | None = None,
                  config=None):
         check_decodable(model)
@@ -255,6 +256,21 @@ class DisaggEngine:
         self.prefill_degraded = False
         self.edge = KVEdge(kv_wire if kv_wire is not None
                            else config.kv_wire)
+        # Weight-only int8 decode compute (§26, TPU_DDP_DECODE_QUANT)
+        # for BOTH roles: one quantized tree feeds prefill, degraded
+        # prefill, decode and adopt+decode, so the shipped KV and the
+        # decode queries come from the same arithmetic. (Speculation
+        # is NOT supported here — the decode tier runs the fused
+        # adopt+decode program only; tune/space.py marks spec_k>0
+        # with fleet_roles='disagg' infeasible.)
+        self.decode_quant = str(
+            decode_quant if decode_quant is not None
+            else getattr(config, "decode_quant", "none"))
+        if self.decode_quant not in ("none", "int8"):
+            raise ValueError(
+                f"decode_quant={self.decode_quant!r}: expected 'none'"
+                " or 'int8' (TPU_DDP_DECODE_QUANT)")
+        self._refresh_quant()
         self.metrics = metrics if metrics is not None \
             else MetricsLogger(None)
         self._prefill = _build_prefill_step(model, self.block_size,
@@ -445,6 +461,32 @@ class DisaggEngine:
         program on the same version from the next step on."""
         self.params = params
         self.param_version = int(version)
+        self._refresh_quant()
+
+    def _refresh_quant(self) -> None:
+        """(Re)derive the serving parameter tree from the fp master
+        ``self.params`` — at construction and after every
+        :meth:`swap_params` flip (the subscriber re-quantizes on
+        hot-swap without knowing the knob exists; see
+        ServeEngine._refresh_quant)."""
+        if self.decode_quant == "int8":
+            from tpu_ddp.ops.quant import quantize_params
+            self._decode_params = pin_committed(
+                quantize_params(self.model, self.params))
+        else:
+            self._decode_params = self.params
+
+    def stats(self) -> dict:
+        """Pipeline introspection for dashboards and the sweep:
+        the edge ledger, the quantization knob, and the degraded
+        flag. ``speculative`` is always None — the decode tier runs
+        the fused adopt+decode program only (speculation is a
+        single-engine/router feature; tune/space.py marks the combo
+        infeasible)."""
+        return {"edge": self.edge.stats(),
+                "decode_quant": self.decode_quant,
+                "prefill_degraded": self.prefill_degraded,
+                "speculative": None}
 
     # ---- router hooks --------------------------------------------------
 
@@ -507,7 +549,8 @@ class DisaggEngine:
         piece = req.prompt[start:start + C]
         chunk[0, :piece.size] = piece
         k, v, tok, lp = self._prefill(
-            self.params, self.prefill_pool.k, self.prefill_pool.v,
+            self._decode_params, self.prefill_pool.k,
+            self.prefill_pool.v,
             jnp.asarray(self._table_for(s)), jnp.asarray(chunk),
             jnp.int32(start), jnp.int32(req.prompt.size),
             jnp.float32(req.temperature), jnp.int32(req.seed))
@@ -555,6 +598,7 @@ class DisaggEngine:
         req.logprobs.append(lp)
         req.token_versions.append(self.param_version)
         now = time.perf_counter()
+        req.token_times.append(now)
         req.first_token_at = now
         self.metrics.observe("serve_ttft_ms",
                              (now - req.submitted_at) * 1e3)
@@ -613,7 +657,7 @@ class DisaggEngine:
         piece = req.prompt[start:start + C]
         chunk[0, :piece.size] = piece
         k, v, tok, lp = self._prefill(
-            self.params, self.pool.k, self.pool.v,
+            self._decode_params, self.pool.k, self.pool.v,
             jnp.asarray(self._table_for(s)), jnp.asarray(chunk),
             jnp.int32(start), jnp.int32(req.prompt.size),
             jnp.float32(req.temperature), jnp.int32(req.seed))
@@ -690,7 +734,8 @@ class DisaggEngine:
                 self._bank_inputs(dslots)
             self._maybe_poison(dslots)
             k, v, toks, lps, bad = self._adopt_decode(
-                self.params, self.pool.k, self.pool.v, adopt_ids,
+                self._decode_params, self.pool.k, self.pool.v,
+                adopt_ids,
                 ak, av, tables, lengths, last, temps, seeds)
             self.pool.commit(k, v)
             self._emit_bank(dslots, toks, lps, bad)
@@ -740,7 +785,7 @@ class DisaggEngine:
         step = _build_decode_step(self.model, self.block_size,
                                   self.blocks_per_seq)
         k, v, toks, lps, bad = step(
-            self.params, self.pool.k, self.pool.v,
+            self._decode_params, self.pool.k, self.pool.v,
             tables, lengths, last, temps, seeds)
         self.pool.commit(k, v)
         self._emit_bank(dslots, toks, lps, bad)
@@ -775,6 +820,7 @@ class DisaggEngine:
             req.tokens.append(tok)
             req.logprobs.append(float(lps[i]))
             req.token_versions.append(self.param_version)
+            req.token_times.append(time.perf_counter())
             if req.on_token is not None:
                 req.on_token(tok)
             if s.generated >= req.max_new_tokens \
@@ -826,7 +872,7 @@ class DisaggEngine:
         ``tpu_ddp/analysis`` fingerprints and donation-checks."""
         sds = lambda x: jax.ShapeDtypeStruct(  # noqa: E731
             jnp.shape(x), jnp.result_type(x))
-        params = jax.tree.map(sds, self.params)
+        params = jax.tree.map(sds, self._decode_params)
         S, BPS = self.num_slots, self.blocks_per_seq
         pk = sds(self.pool.k)
         payload = jax.ShapeDtypeStruct(
@@ -851,7 +897,7 @@ class DisaggEngine:
         program — the graph-audit cell for the fallback path."""
         sds = jax.ShapeDtypeStruct
         return self._prefill.lower(
-            self.params, self.pool.k, self.pool.v,
+            self._decode_params, self.pool.k, self.pool.v,
             sds((self.blocks_per_seq,), jnp.int32),
             sds((1, self.prefill_chunk), jnp.int32),
             sds((), jnp.int32), sds((), jnp.int32),

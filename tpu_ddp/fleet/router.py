@@ -536,8 +536,22 @@ class Router:
             if getattr(r, "subscriber", None) is not None:
                 s["param_version"] = r.param_version
                 s["publish_lag"] = r.subscriber.lag
+            # Speculation ledger (§26): surfaced when the replica
+            # speculates, so fleet dashboards can see proposal waste
+            # (proposed - accepted) per replica.
+            if getattr(r, "spec_k", 0) > 0 \
+                    and hasattr(r, "spec_stats"):
+                s["speculative"] = r.spec_stats()
             per.append(s)
+        spec = [p["speculative"] for p in per if "speculative" in p]
+        agg = None
+        if spec:
+            agg = {k: sum(s[k] for s in spec)
+                   for k in ("proposed", "accepted", "rejected")}
+            agg["acceptance"] = (agg["accepted"] / agg["proposed"]
+                                 if agg["proposed"] else None)
         return {"policy": self.policy,
+                "speculative": agg,
                 "n_replicas": len(self.replicas),
                 "routed": list(self.routed),
                 "affinity_hits": self.affinity_hits,

@@ -741,6 +741,24 @@ def main(argv=None) -> int:
                         "slowest subscriber before publishing blocks "
                         "(0 = unbounded). Sets "
                         "TPU_DDP_PUBLISH_MAX_STALENESS for every rank")
+    p.add_argument("--spec-k", type=int, default=None,
+                   help="speculative decoding: proposals verified per "
+                        "serving engine step (0 = off, the one-token "
+                        "baseline; tpu_ddp/serve/speculative.py). Sets "
+                        "TPU_DDP_SPEC_K for every rank")
+    p.add_argument("--spec-draft", default=None,
+                   help="draft family for speculation: 'chain' "
+                        "(bitwise-exact same-program schedule), "
+                        "'self-<j>' (early exit over the target's "
+                        "first j blocks) or 'quant' (full-depth int8 "
+                        "twin). Sets TPU_DDP_SPEC_DRAFT for every rank")
+    p.add_argument("--decode-quant", default=None,
+                   choices=("none", "int8"),
+                   help="weight-only int8 decode compute "
+                        "(tpu_ddp/ops/quant.py): per-channel "
+                        "quantization of every decode-path projection "
+                        "at engine construction. Sets "
+                        "TPU_DDP_DECODE_QUANT for every rank")
     p.add_argument("--autotune", default=None,
                    choices=("off", "cached", "search"),
                    help="perf-knob autotuning (tpu_ddp/tune/): 'cached' "
@@ -838,6 +856,21 @@ def main(argv=None) -> int:
                     f"got {args.publish_max_staleness}")
         env["TPU_DDP_PUBLISH_MAX_STALENESS"] = \
             str(args.publish_max_staleness)
+    if args.spec_k is not None:
+        if args.spec_k < 0:
+            p.error(f"--spec-k must be >= 0, got {args.spec_k}")
+        env["TPU_DDP_SPEC_K"] = str(args.spec_k)
+    if args.spec_draft is not None:
+        sd = args.spec_draft.strip()
+        if sd not in ("chain", "quant") and not (
+                sd.startswith("self-")
+                and sd[len("self-"):].isdigit()
+                and int(sd[len("self-"):]) >= 1):
+            p.error(f"--spec-draft {args.spec_draft!r}: expected "
+                    "chain, self-<j> (j >= 1) or quant")
+        env["TPU_DDP_SPEC_DRAFT"] = args.spec_draft
+    if args.decode_quant is not None:
+        env["TPU_DDP_DECODE_QUANT"] = args.decode_quant
     if args.autotune is not None:
         env["TPU_DDP_AUTOTUNE"] = args.autotune
     if args.audit is not None:

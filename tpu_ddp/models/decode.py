@@ -52,12 +52,11 @@ def check_decodable(model) -> None:
 
 
 def mlp(model, blk, y):
+    from tpu_ddp.ops.quant import qdot
     cd = model.compute_dtype
-    y = jnp.dot(y, blk["w1"].astype(cd),
-                preferred_element_type=jnp.float32)
+    y = qdot(y, blk["w1"], cd)
     y = jax.nn.gelu(y.astype(jnp.float32)).astype(cd)
-    return jnp.dot(y, blk["w2"].astype(cd),
-                   preferred_element_type=jnp.float32).astype(cd)
+    return qdot(y, blk["w2"], cd).astype(cd)
 
 
 def attend_cached(model, q, ck, cv, q_pos):
@@ -101,11 +100,11 @@ def project_qkv(model, blk, x, pos):
 def block_finish(model, blk, x, o):
     """Post-attention half of a block: output projection + residual,
     LN2 + MLP + residual. (B, L, dm) -> (B, L, dm)."""
+    from tpu_ddp.ops.quant import qdot
     cd = model.compute_dtype
     b, L = x.shape[0], x.shape[1]
-    wo = blk["wo"].astype(cd).reshape(-1, model.d_model)
-    o = jnp.dot(o.reshape(b, L, -1), wo,
-                preferred_element_type=jnp.float32).astype(cd)
+    o = qdot(o.reshape(b, L, -1), blk["wo"], cd,
+             reshape=(-1, model.d_model)).astype(cd)
     x = x + o
     y = layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
     return x + mlp(model, blk, y)
@@ -160,6 +159,21 @@ def sample_token(model, logits, temperature, seed, position):
     tok = jnp.where(temperature > 0, sampled, greedy)
     logprob = jax.nn.log_softmax(logits.astype(jnp.float32))[tok]
     return tok, logprob
+
+
+def verify_sample(model, logits, temperature, seed, positions):
+    """Batched multi-position sampling for speculative verification:
+    ``logits`` (W, V) at ``positions`` (W,) under ONE request's
+    (temperature, seed) -> (tokens (W,), logprobs (W,)). Each column
+    is exactly :func:`sample_token` with the same stateless
+    ``fold_in(seed, position)`` key the one-token decode step would
+    use at that position — the property that makes the speculative
+    accept path bitwise identical to the non-speculative stream
+    (tpu_ddp/serve/speculative.py, DESIGN.md §26). vmap over the live
+    batch for the verify program."""
+    return jax.vmap(
+        lambda lg, p: sample_token(model, lg, temperature, seed, p)
+    )(logits, positions)
 
 
 def dense_params_from_checkpoint(model, directory: str,

@@ -337,9 +337,12 @@ class TransformerLM:
 
     def project(self, params, x):
         """Vocabulary projection of post-LN activations — the ONE place
-        the head matmul's precision is decided."""
-        logits = jnp.dot(x, params["head"].astype(self.compute_dtype),
-                         preferred_element_type=jnp.float32)
+        the head matmul's precision is decided. Routed through
+        :func:`tpu_ddp.ops.quant.qdot` so an int8-quantized serving
+        tree (decode_quant, ops/quant.py) runs the fused weight-only
+        matmul; a plain fp tree traces the identical dot."""
+        from tpu_ddp.ops.quant import qdot
+        logits = qdot(x, params["head"], self.compute_dtype)
         return logits.astype(jnp.float32)
 
     def trunk_with_aux(self, params, tokens, rng=None):
@@ -395,7 +398,10 @@ class TransformerLM:
         heads only, zero communication. One fused "wqkv" matmul for MHA;
         separate "wq"/"wkv" for GQA (KV/tp heads, the smaller
         projection). Shared by training (block_apply_aux) and KV-cache
-        decode (models/generate.py)."""
+        decode (models/generate.py). The projections route through
+        :func:`tpu_ddp.ops.quant.qdot` (identical trace for fp trees;
+        fused int8 matmul for a quantized serving tree)."""
+        from tpu_ddp.ops.quant import qdot
         cd = self.compute_dtype
         b, lc, hd = y.shape[0], y.shape[1], self.head_dim
         h_loc = self.num_heads // self._tp
@@ -403,17 +409,14 @@ class TransformerLM:
         # checkpoint layout mismatch then fails immediately with a
         # KeyError instead of silently training the other scheme.
         if not self.is_gqa:
-            wqkv = blk["wqkv"].astype(cd).reshape(self.d_model, -1)
-            qkv = jnp.dot(y, wqkv, preferred_element_type=jnp.float32)
+            qkv = qdot(y, blk["wqkv"], cd, reshape=(self.d_model, -1))
             qkv = qkv.astype(cd).reshape(b, lc, 3, h_loc, hd)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         else:
             kv_loc = self.kv_heads // self._tp
-            wq = blk["wq"].astype(cd).reshape(self.d_model, -1)
-            q = jnp.dot(y, wq, preferred_element_type=jnp.float32)
+            q = qdot(y, blk["wq"], cd, reshape=(self.d_model, -1))
             q = q.astype(cd).reshape(b, lc, h_loc, hd)
-            wkv = blk["wkv"].astype(cd).reshape(self.d_model, -1)
-            kvp = jnp.dot(y, wkv, preferred_element_type=jnp.float32)
+            kvp = qdot(y, blk["wkv"], cd, reshape=(self.d_model, -1))
             kvp = kvp.astype(cd).reshape(b, lc, 2, kv_loc, hd)
             k, v = kvp[:, :, 0], kvp[:, :, 1]
         return rope(q, pos), rope(k, pos), v

@@ -13,6 +13,9 @@ Pallas:
                     with a custom VJP.
 - :mod:`flash_attention` — flash attention forward/backward: O(L·D) HBM
                     traffic instead of the O(L²) score matrix.
+- :mod:`quant_matmul` — weight-only int8 matmul with the dequant scale
+                    fused into the epilogue (quantized decode compute,
+                    ops/quant.py).
 
 Every kernel runs compiled on TPU and falls back to interpreter mode on
 CPU (tests force the host platform, conftest.py), selected automatically.
@@ -31,6 +34,7 @@ def interpret_mode() -> bool:
 from tpu_ddp.ops.pallas.sgd import fused_sgd_step  # noqa: E402
 from tpu_ddp.ops.pallas.bn_relu import batch_norm_relu  # noqa: E402
 from tpu_ddp.ops.pallas.flash_attention import flash_attention  # noqa: E402
+from tpu_ddp.ops.pallas.quant_matmul import int8_matmul  # noqa: E402
 
 __all__ = ["interpret_mode", "fused_sgd_step", "batch_norm_relu",
-           "flash_attention"]
+           "flash_attention", "int8_matmul"]

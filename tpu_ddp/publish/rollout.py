@@ -21,6 +21,17 @@ over identical greedy rollouts selects nothing).
 (tests/test_publish.py) pins that the engine provably serves
 trainer-updated weights — digests equal on both ends, versions
 advanced, generations changed.
+
+Speculation composes (DESIGN.md §26): a speculative engine
+(``spec_k > 0``) multiplies rollout generation throughput, and with
+an int8 draft or target the engine's ``swap_params`` re-derives the
+quantized tree on every publisher flip — the draft-distill-and-push
+loop: each round's draft is re-quantized FROM the weights that round
+trained, so the draft never serves a stale version (the per-round
+report pins ``speculative.draft_version == engine_version``). With
+the default "chain" family the rollout streams stay bitwise what the
+non-speculative engine would have sampled, so speculation changes
+the loop's wall-clock, never its trajectory.
 """
 
 from __future__ import annotations
@@ -132,16 +143,27 @@ def run_online_loop(trainer, engine, publisher, state, *, rounds: int,
         x, y = trainer.put_batch(inputs, targets)
         state, loss = trainer.train_step(state, x, y)
         publisher.after_step(state, int(state.step))
-        report["rounds"].append({
+        rep = {
             "round": r, "loss": float(np.mean(np.asarray(loss))),
             "reward_mean": float(np.mean([ro.reward for ro in batch])),
             "published_version": publisher.version,
             "engine_version": getattr(engine, "param_version", 0),
-        })
+        }
+        if getattr(engine, "spec_k", 0) > 0 \
+                and hasattr(engine, "spec_stats"):
+            # Draft provenance: swap_params re-derived the draft from
+            # the engine's current weights, so the draft's version IS
+            # the engine's — pinned per round by the scenario test.
+            rep["speculative"] = dict(
+                engine.spec_stats(),
+                draft_version=getattr(engine, "param_version", 0))
+        report["rounds"].append(rep)
     for _ in range(settle_steps):
         engine.step()
     report["publisher"] = publisher.stats()
     report["subscribers"] = [s.stats() for s in publisher.subscribers]
+    if getattr(engine, "spec_k", 0) > 0 and hasattr(engine, "spec_stats"):
+        report["speculative"] = engine.spec_stats()
     return state, report
 
 

@@ -26,10 +26,17 @@ from tpu_ddp.serve.loadgen import (
     run_trace,
 )
 from tpu_ddp.serve.scheduler import Scheduler, TenantClass, parse_tenant_classes
+from tpu_ddp.serve.speculative import (
+    SPEC_DRAFTS,
+    accept_length,
+    build_spec_step,
+    parse_spec_draft,
+)
 
 __all__ = [
-    "PagedKVPool", "Request", "RequestSpec", "Scheduler", "ServeEngine",
-    "TenantClass", "TraceEvent", "calibrate_rate",
-    "make_shared_prefix_workload", "make_trace", "make_workload",
+    "PagedKVPool", "Request", "RequestSpec", "SPEC_DRAFTS", "Scheduler",
+    "ServeEngine", "TenantClass", "TraceEvent", "accept_length",
+    "build_spec_step", "calibrate_rate", "make_shared_prefix_workload",
+    "make_trace", "make_workload", "parse_spec_draft",
     "parse_tenant_classes", "run_load", "run_trace",
 ]

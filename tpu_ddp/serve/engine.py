@@ -2,7 +2,9 @@
 continuous-batching scheduler.
 
 One ``ServeEngine`` owns a dense model + params, a ``PagedKVPool``, a
-``Scheduler`` and exactly TWO jitted programs, compiled once each:
+``Scheduler`` and TWO jitted programs, compiled once each (a third —
+the fused speculative step — joins only under ``spec_k > 0`` with a
+fused draft family; tpu_ddp/serve/speculative.py):
 
 - ``decode step`` — one token for the ENTIRE slot bank per call.
   Static (num_slots, blocks_per_seq) shapes; idle slots ride along with
@@ -57,6 +59,11 @@ from tpu_ddp.models.decode import (
     sample_token,
 )
 from tpu_ddp.serve.kv_pool import PagedKVPool, pin_committed
+from tpu_ddp.serve.speculative import (
+    accept_length,
+    build_spec_step,
+    parse_spec_draft,
+)
 from tpu_ddp.serve.scheduler import (
     Scheduler,
     parse_tenant_classes,
@@ -88,6 +95,16 @@ class Request:
     # steps, so no token ever mixes versions, and the stream's stamps
     # are non-decreasing (loadgen.assert_atomic_cutover pins both).
     token_versions: list = dataclasses.field(default_factory=list)
+    # Wall-clock stamp per emitted token (perf_counter) — the honest
+    # TPOT basis under speculation, where one engine step can emit a
+    # burst of tokens (loadgen computes inter-token percentiles from
+    # these stamps, never from a tokens-per-step assumption).
+    token_times: list = dataclasses.field(default_factory=list)
+    # Speculation ledger (§26): per-request proposal accounting with
+    # the identity proposed == accepted + rejected at every step.
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    spec_rejected: int = 0
     done: bool = False
     cancelled: bool = False
     shed: bool = False          # dropped by admission control (SLO)
@@ -228,6 +245,9 @@ class ServeEngine:
                  queue_limit: int | None = None,
                  shed_ms: float | None = None,
                  tenant_classes: str | None = None,
+                 spec_k: int | None = None,
+                 spec_draft: str | None = None,
+                 decode_quant: str | None = None,
                  mesh=None,
                  metrics: MetricsLogger | None = None,
                  config=None):
@@ -293,6 +313,43 @@ class ServeEngine:
                                           self.blocks_per_seq)
         self._prefill = _build_prefill_step(model, self.block_size,
                                             self.blocks_per_seq)
+        # Speculative decoding + quantized decode compute (§26,
+        # TPU_DDP_SPEC_K / TPU_DDP_SPEC_DRAFT / TPU_DDP_DECODE_QUANT):
+        # same knob convention as above — explicit arguments win over
+        # config, which already folded in the env surface.
+        self.spec_k = int(spec_k if spec_k is not None
+                          else getattr(config, "spec_k", 0))
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        self.spec_draft = str(
+            spec_draft if spec_draft is not None
+            else getattr(config, "spec_draft", "chain"))
+        kind, j = parse_spec_draft(self.spec_draft)
+        if kind == "self" and j > model.num_layers:
+            raise ValueError(
+                f"spec_draft={self.spec_draft!r}: draft depth {j} "
+                f"exceeds the model's {model.num_layers} blocks")
+        self._spec_kind, self._spec_j = kind, j
+        self.decode_quant = str(
+            decode_quant if decode_quant is not None
+            else getattr(config, "decode_quant", "none"))
+        if self.decode_quant not in ("none", "int8"):
+            raise ValueError(
+                f"decode_quant={self.decode_quant!r}: expected 'none'"
+                " or 'int8' (TPU_DDP_DECODE_QUANT)")
+        self._refresh_quant()
+        self._spec = None
+        if self.spec_k > 0 and kind != "chain":
+            # The fused draft+verify program. "chain" adds NO program:
+            # its schedule is k+1 calls of self._decode.
+            self._spec = build_spec_step(
+                model, self.block_size, self.blocks_per_seq,
+                self.spec_k, j if kind == "self" else model.num_layers)
+        # Engine-level speculation ledger (spec_stats(); per-request
+        # counts live on the Request handle).
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_rejected = 0
         self._rid = itertools.count()
         self.config = config
         # SLO-aware load shedding (docs/DESIGN.md §23): queue_limit
@@ -329,6 +386,32 @@ class ServeEngine:
             from tpu_ddp.analysis.gate import maybe_audit_serve_engine
             maybe_audit_serve_engine(self)
 
+    def _refresh_quant(self) -> None:
+        """(Re)derive the decode-path parameter tree from the fp
+        master ``self.params`` — at construction and after every
+        :meth:`swap_params` flip, which is how the publish Subscriber
+        re-quantizes on hot-swap without knowing quantization exists.
+
+        ``self._decode_params`` feeds EVERY compiled step program
+        (decode, prefill, fused speculative verify): the fp tree under
+        ``decode_quant == "none"``, the per-channel int8 tree
+        (ops/quant.py quantize_params) under ``"int8"``. The two trees
+        have different treedefs (QuantizedWeight leaves), so jit keys
+        them to distinct compiled programs automatically — no engine
+        dispatch logic. ``self.params`` stays the fp master.
+        ``self._draft_params`` is the fused draft's tree: the decode
+        tree for a "self-<j>" early exit (the draft IS the target's
+        first j blocks), the int8 tree for a "quant" draft (shared
+        with ``_decode_params`` when the target is itself int8)."""
+        qp = None
+        if self.decode_quant == "int8" or self._spec_kind == "quant":
+            from tpu_ddp.ops.quant import quantize_params
+            qp = pin_committed(quantize_params(self.model, self.params))
+        self._decode_params = (qp if self.decode_quant == "int8"
+                               else self.params)
+        self._draft_params = (qp if self._spec_kind == "quant"
+                              else self._decode_params)
+
     def lower_decode_step(self):
         """``jit.lower`` the whole-bank decode step at the engine's
         static shapes — the HLO-inspection surface the graph audit
@@ -336,7 +419,7 @@ class ServeEngine:
         S, BPS = self.num_slots, self.blocks_per_seq
         sds = jax.ShapeDtypeStruct
         return self._decode.lower(
-            self.params, self.pool.k, self.pool.v,
+            self._decode_params, self.pool.k, self.pool.v,
             sds((S, BPS), jnp.int32), sds((S,), jnp.int32),
             sds((S,), jnp.int32), sds((S,), jnp.float32),
             sds((S,), jnp.int32))
@@ -346,11 +429,29 @@ class ServeEngine:
         surface as :meth:`lower_decode_step`)."""
         sds = jax.ShapeDtypeStruct
         return self._prefill.lower(
-            self.params, self.pool.k, self.pool.v,
+            self._decode_params, self.pool.k, self.pool.v,
             sds((self.blocks_per_seq,), jnp.int32),
             sds((1, self.prefill_chunk), jnp.int32),
             sds((), jnp.int32), sds((), jnp.int32),
             sds((), jnp.float32), sds((), jnp.int32))
+
+    def lower_spec_step(self):
+        """``jit.lower`` the fused speculative step (same audit
+        surface). Raises unless a fused draft family is configured —
+        the "chain" schedule adds NO program (it reuses the compiled
+        decode step; that is its exactness argument)."""
+        if self._spec is None:
+            raise ValueError(
+                "no fused speculative program: spec_k == 0 or "
+                "spec_draft == 'chain'")
+        S, BPS = self.num_slots, self.blocks_per_seq
+        sds = jax.ShapeDtypeStruct
+        return self._spec.lower(
+            self._decode_params, self._draft_params,
+            self.pool.k, self.pool.v,
+            sds((S, BPS), jnp.int32), sds((S,), jnp.int32),
+            sds((S,), jnp.int32), sds((S,), jnp.float32),
+            sds((S,), jnp.int32), sds((S,), jnp.int32))
 
     @classmethod
     def from_checkpoint(cls, model, directory: str,
@@ -495,8 +596,16 @@ class ServeEngine:
     # ---- the iteration -------------------------------------------------
 
     def step(self) -> bool:
-        """One engine iteration: admit, at most one prefill chunk, one
-        whole-batch decode step. Returns whether any work ran."""
+        """One engine iteration: admit, prefill, one whole-batch
+        decode step. Returns whether any work ran.
+
+        Prefill budget: at most one chunk per step at ``spec_k == 0``
+        (the latency-smoothing default), ``spec_k + 1`` chunks when
+        speculating — a speculative step retires up to ``spec_k + 1``
+        tokens per slot, so single-chunk refill would starve the bank
+        (slots empty faster than they refill) and the window would run
+        at a fraction of its width. Matching the budgets keeps bank
+        occupancy at its k=0 level."""
         self._step_n += 1
         if self.chaos is not None:
             # May raise ReplicaCrashError — BEFORE any state mutation,
@@ -514,15 +623,23 @@ class ServeEngine:
             self.metrics.inc("serve_admitted")
         did = False
 
-        pi = self.sched.prefill_slot()
-        if pi is not None:
+        budget = self.spec_k + 1 if self.spec_k > 0 else 1
+        for _ in range(budget):
+            pi = self.sched.prefill_slot()
+            if pi is None:
+                break
             did = True
             self._run_prefill_chunk(pi)
 
         dslots = self.sched.decode_slots()
         if dslots:
             did = True
-            self._run_decode_step(dslots)
+            if self.spec_k > 0 and self._spec_kind == "chain":
+                self._run_chain_step(dslots)
+            elif self.spec_k > 0:
+                self._run_spec_step(dslots)
+            else:
+                self._run_decode_step(dslots)
 
         self.metrics.observe("serve_queue_depth",
                              len(self.sched.queue))
@@ -549,6 +666,10 @@ class ServeEngine:
         test), and the very next decode step samples on ``version``."""
         self.params = params
         self.param_version = int(version)
+        # Quantized serving re-derives the int8 decode tree from the
+        # new fp master — the subscriber's hot-swap re-quantizes by
+        # construction, with no publish-side knowledge of the knob.
+        self._refresh_quant()
 
     # ---- router hooks --------------------------------------------------
 
@@ -630,7 +751,7 @@ class ServeEngine:
         piece = req.prompt[start:start + C]
         chunk[0, :piece.size] = piece
         k, v, tok, lp = self._prefill(
-            self.params, self.pool.k, self.pool.v,
+            self._decode_params, self.pool.k, self.pool.v,
             jnp.asarray(self._table_for(s)), jnp.asarray(chunk),
             jnp.int32(start), jnp.int32(req.prompt.size),
             jnp.float32(req.temperature), jnp.int32(req.seed))
@@ -680,7 +801,7 @@ class ServeEngine:
             seeds[i] = s.request.seed
         self._maybe_poison(dslots)
         k, v, toks, lps, bad = self._decode(
-            self.params, self.pool.k, self.pool.v,
+            self._decode_params, self.pool.k, self.pool.v,
             jnp.asarray(tables), jnp.asarray(lengths),
             jnp.asarray(last), jnp.asarray(temps), jnp.asarray(seeds))
         self.pool.commit(k, v)
@@ -691,6 +812,206 @@ class ServeEngine:
                 continue
             self.sched.slots[i].length += 1
             self._emit(i, int(toks[i]), float(lps[i]))
+
+    def _run_chain_step(self, dslots: list[int]) -> None:
+        """The "chain" speculative schedule (spec_draft="chain"): one
+        engine step runs ``spec_k + 1`` sequential dispatches of the
+        SAME compiled decode program the k=0 engine runs, each column
+        feeding the token the previous column sampled — on device,
+        with NO host sync inside the window. Every emitted sample
+        comes from that one program with bit-identical inputs, so the
+        (token, logprob) stream is bitwise identical to the
+        non-speculative stream by construction — the exactness family
+        (speculative.py). The win: batch assembly, the per-step
+        host/dispatch round trip and the output sync are paid once
+        per window instead of once per token.
+
+        Freezing is two-phase. Budget exhaustion (``max_new_tokens``)
+        is HOST-PREDICTABLE, so the per-column ``act`` mask is
+        precomputed: a slot past its budget is frozen on device to
+        the idle pattern (zeroed table row, length/last 0 — writes
+        land in the null block, outputs discarded at harvest), which
+        also caps every write at position ``< prompt + max_new``,
+        inside the blocks ``ensure_blocks`` pre-allocated. EOS and
+        non-finite truncation are NOT predictable; their tail columns
+        compute discarded garbage into the slot's OWN pre-allocated
+        blocks at in-budget positions (beyond the final length —
+        causally masked, freed at harvest-time retire, scrubbed on
+        quarantine), never into anyone else's — the harvest loop
+        stops at the EOS/bad column exactly like the synced
+        column-at-a-time schedule would. Frozen rows cannot perturb
+        live rows: every bank op is row-independent at fixed shapes
+        (the property the migration/rebatching parity tests pin).
+
+        Acceptance is 1 by construction (no rollback); the ledger
+        counts each emitted non-first column as an accepted proposal
+        (rejected on the quarantine column), so
+        ``proposed == accepted + rejected`` stays exact."""
+        S, BPS = self.num_slots, self.blocks_per_seq
+        W = self.spec_k + 1
+        tables = np.zeros((S, BPS), np.int32)
+        lengths = np.zeros(S, np.int32)
+        last = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        seeds = np.zeros(S, np.int32)
+        remaining = np.zeros(S, np.int32)
+        for i in dslots:
+            self.sched.ensure_blocks(i, W)
+            s = self.sched.slots[i]
+            tables[i] = self._table_for(s)
+            lengths[i] = s.length
+            last[i] = s.pending_token
+            temps[i] = s.request.temperature
+            seeds[i] = s.request.seed
+            remaining[i] = s.request.max_new_tokens - s.generated
+        self._maybe_poison(dslots)
+        active = np.arange(W)[:, None] < remaining[None, :]  # (W, S)
+        # Fast-path test per column: every LIVE slot still in budget
+        # (idle rows are never active — judging them would force the
+        # masked path on any partially-full bank; on the fast path
+        # they just advance harmlessly into the null block).
+        full = active[:, dslots].all(axis=1)                 # (W,)
+        d_tables = jnp.asarray(tables)
+        d_lengths = jnp.asarray(lengths)
+        d_last = jnp.asarray(last)
+        d_temps = jnp.asarray(temps)
+        d_seeds = jnp.asarray(seeds)
+        cols = []
+        pk, pv = self.pool.k, self.pool.v
+        ncols = int(active.any(axis=1).sum())  # drop all-frozen tail
+        for c in range(ncols):
+            if c:
+                # Column-to-column advance, on device. Fast path (no
+                # slot freezes this column — the steady state): reuse
+                # the previous column's sample array as-is and bump
+                # lengths with one eager add; the per-step device_put
+                # storm the profiler blames on the k=0 path (five
+                # host->device transfers per dispatch) happens once
+                # per WINDOW here, not once per column.
+                if full[c]:
+                    d_lengths = d_lengths + 1
+                    d_last = cols[-1][0]
+                else:
+                    # Wind-down: some slot exhausted its budget —
+                    # mask it to the idle pattern (null-block table
+                    # row, length/last 0).
+                    act = jnp.asarray(active[c])
+                    d_tables = jnp.where(act[:, None], d_tables, 0)
+                    d_lengths = jnp.where(act, d_lengths + 1, 0)
+                    d_last = jnp.where(act, cols[-1][0], 0)
+            # Thread the pool buffers column to column locally — each
+            # dispatch consumes (donates) the previous column's output
+            # buffers directly; one commit per window, not per column.
+            pk, pv, toks, lps, bad = self._decode(
+                self._decode_params, pk, pv,
+                d_tables, d_lengths, d_last, d_temps, d_seeds)
+            cols.append((toks, lps, bad))
+        self.pool.commit(pk, pv)
+        toks = np.stack([np.asarray(t) for t, _, _ in cols])  # (W', S)
+        lps = np.stack([np.asarray(l) for _, l, _ in cols])
+        bad = np.stack([np.asarray(b) for _, _, b in cols])
+        live = set(dslots)
+        for c in range(ncols):
+            for i in sorted(live):
+                if not active[c, i]:
+                    continue
+                s = self.sched.slots[i]
+                req = s.request
+                if c > 0:
+                    req.spec_proposed += 1
+                    self.spec_proposed += 1
+                if bad[c, i]:
+                    if c > 0:
+                        req.spec_rejected += 1
+                        self.spec_rejected += 1
+                    self._quarantine(i)
+                    live.discard(i)
+                    continue
+                if c > 0:
+                    req.spec_accepted += 1
+                    self.spec_accepted += 1
+                s.length += 1
+                self._emit(i, int(toks[c, i]), float(lps[c, i]))
+                if req.done:
+                    live.discard(i)
+
+    def _run_spec_step(self, dslots: list[int]) -> None:
+        """The fused draft+verify speculative step (spec_draft
+        "self-<j>" / "quant"): ONE dispatch drafts k proposals per
+        slot and verifies all k+1 columns with the target
+        (speculative.build_spec_step), then the host emits the longest
+        prefix of TARGET samples whose inputs the draft guessed right
+        (accept_length — never a draft token). Rejected tail blocks go
+        back to the pool via the scheduler's ``trim_blocks`` rollback,
+        so ``accounting_ok()`` holds between steps."""
+        S, BPS = self.num_slots, self.blocks_per_seq
+        tables = np.zeros((S, BPS), np.int32)
+        lengths = np.zeros(S, np.int32)
+        last = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        seeds = np.zeros(S, np.int32)
+        limits = np.zeros(S, np.int32)
+        for i in dslots:
+            self.sched.ensure_blocks(i, self.spec_k + 1)
+            s = self.sched.slots[i]
+            tables[i] = self._table_for(s)
+            lengths[i] = s.length
+            last[i] = s.pending_token
+            temps[i] = s.request.temperature
+            seeds[i] = s.request.seed
+            limits[i] = len(s.request.prompt) + s.request.max_new_tokens
+        self._maybe_poison(dslots)
+        k, v, drafted, toks, lps, bad = self._spec(
+            self._decode_params, self._draft_params,
+            self.pool.k, self.pool.v,
+            jnp.asarray(tables), jnp.asarray(lengths),
+            jnp.asarray(last), jnp.asarray(temps),
+            jnp.asarray(seeds), jnp.asarray(limits))
+        self.pool.commit(k, v)
+        drafted, toks = np.asarray(drafted), np.asarray(toks)
+        lps, bad = np.asarray(lps), np.asarray(bad)
+        for i in dslots:
+            s = self.sched.slots[i]
+            req = s.request
+            g = accept_length(drafted[i], toks[i], self.spec_k)
+            req.spec_proposed += self.spec_k
+            self.spec_proposed += self.spec_k
+            emitted = 0
+            quarantined = False
+            for c in range(g + 1):
+                if bad[i, c]:
+                    quarantined = True
+                    break
+                s.length += 1
+                self._emit(i, int(toks[i, c]), float(lps[i, c]))
+                emitted += 1
+                if req.done:
+                    break
+            acc = max(emitted - 1, 0)
+            req.spec_accepted += acc
+            self.spec_accepted += acc
+            req.spec_rejected += self.spec_k - acc
+            self.spec_rejected += self.spec_k - acc
+            if quarantined:
+                self._quarantine(i)
+            elif not req.done:
+                # KV rollback: free the tail blocks the rejected
+                # columns over-allocated; garbage beyond ``length``
+                # inside kept blocks is causally masked and the next
+                # step's write at ``length`` overwrites the frontier.
+                self.sched.trim_blocks(i)
+
+    def spec_stats(self) -> dict:
+        """The engine's speculation ledger (router stats roll this
+        up per replica): knob settings, proposal totals with the
+        ``proposed == accepted + rejected`` identity, and the
+        acceptance rate (None before any proposal)."""
+        p = self.spec_proposed
+        return {"spec_k": self.spec_k, "spec_draft": self.spec_draft,
+                "decode_quant": self.decode_quant,
+                "proposed": p, "accepted": self.spec_accepted,
+                "rejected": self.spec_rejected,
+                "acceptance": (self.spec_accepted / p) if p else None}
 
     def _quarantine(self, idx: int) -> None:
         """Non-finite logits on slot ``idx``: isolate the request, not
@@ -747,6 +1068,7 @@ class ServeEngine:
         req.logprobs.append(logprob)
         req.token_versions.append(self.param_version)
         now = time.perf_counter()
+        req.token_times.append(now)
         if req.first_token_at is None:
             req.first_token_at = now
             self.metrics.observe("serve_ttft_ms",

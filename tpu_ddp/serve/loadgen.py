@@ -12,11 +12,14 @@ continuously in between. Per run we report:
   chunking actually do to a user.
 - ``e2e_p50_ms`` / ``e2e_p99_ms`` — arrival to final token: the whole
   wait, which TTFT alone understates for long generations.
-- ``tpot_p50_ms`` / ``tpot_p99_ms`` / ``tpot_mean_ms`` — time per
-  output token AFTER the first, per request. The streaming-smoothness
-  metric: disaggregation's claim is precisely that prefill bursts stop
-  showing up here. Single-token requests have no inter-token gaps and
-  are excluded.
+- ``tpot_p50_ms`` / ``tpot_p99_ms`` / ``tpot_mean_ms`` — inter-token
+  emission gaps AFTER each request's first token, pooled across
+  requests from the engine's per-token stamps
+  (``Request.token_times``). The streaming-smoothness metric:
+  disaggregation's claim is precisely that prefill bursts stop
+  showing up here, and under speculative decoding the percentiles
+  expose the burst/gap cadence a per-request average would hide.
+  Single-token requests have no inter-token gaps and are excluded.
 - ``tokens_per_sec`` — completed generated tokens / makespan, the
   throughput axis of the latency/throughput frontier.
 - ``goodput_tokens_per_sec`` — tokens from requests whose TTFT met
@@ -187,11 +190,26 @@ def run_load(engine, specs: list[RequestSpec], rate: float,
     n_tokens = np.array([len(h.tokens) for h in completed], dtype=int)
     e2es = np.array([h.finished_at - h.submitted_at
                      for h in completed]) * 1e3            # ms
-    # Per-request mean time per output token after the first;
-    # single-token requests have no inter-token gap to measure.
-    tpots = np.array([(h.finished_at - h.first_token_at)
-                      / (len(h.tokens) - 1)
-                      for h in completed if len(h.tokens) > 1]) * 1e3
+    # Inter-token gaps pooled across completed requests, from the
+    # per-token emission stamps the engine records — NOT the old
+    # (finished - first) / (n - 1) per-request average, which
+    # silently assumed one token per engine step: under speculative
+    # decoding (serve/speculative.py) a step emits a BURST of tokens,
+    # and the uniform estimate averaged the bursts away while the
+    # p99 story lives in the inter-burst gaps the stamps expose.
+    # Requests without stamps (a handle built outside the engine)
+    # fall back to uniform synthetic gaps so they still weigh in.
+    def _req_gaps(h):
+        stamps = getattr(h, "token_times", None)
+        if stamps and len(stamps) == len(h.tokens):
+            return np.diff(stamps)
+        n = len(h.tokens) - 1
+        return np.full(n, (h.finished_at - h.first_token_at) / n)
+
+    tpots = (np.concatenate(
+        [_req_gaps(h) for h in completed if len(h.tokens) > 1])
+        if any(len(h.tokens) > 1 for h in completed)
+        else np.array([])) * 1e3
     makespan = t_end - t0
     # Weight-streaming provenance (tpu_ddp/publish/): each completed
     # request reports the param version(s) its tokens sampled under,

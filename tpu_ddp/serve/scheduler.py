@@ -410,9 +410,35 @@ class Scheduler:
         """Grow slot ``idx``'s table to cover writing position
         ``length`` (called before each decode step). Covered by the
         reservation, so ``alloc`` cannot fail."""
+        self.ensure_blocks(idx, 1)
+
+    def ensure_blocks(self, idx: int, width: int) -> None:
+        """Grow slot ``idx``'s table to cover writing positions
+        ``length .. length + width - 1`` — the speculative step's
+        k+1-wide generalization of :meth:`ensure_block`. Capped at the
+        request's ``prompt + max_new_tokens`` budget (positions beyond
+        it scatter to the null block in-graph), so the growth never
+        exceeds the admission-time worst-case ``reserved`` count and
+        ``alloc`` cannot fail."""
         s = self.slots[idx]
-        while s.length // self.pool.block_size >= len(s.blocks):
+        limit = len(s.request.prompt) + s.request.max_new_tokens
+        last = min(s.length + width - 1, limit - 1)
+        while last // self.pool.block_size >= len(s.blocks):
             s.blocks.append(self.pool.alloc())
+
+    def trim_blocks(self, idx: int) -> None:
+        """Free slot ``idx``'s tail blocks beyond what ``length``
+        needs — the speculative KV rollback (DESIGN.md §26). A
+        rejected proposal leaves over-allocated (and garbage-filled)
+        tail blocks; freeing whole blocks restores
+        ``free + Σ allocated == total`` with no new pool invariant.
+        Keeps the block holding position ``length`` (the next write
+        target), so a kept block's garbage tail is causally masked."""
+        s = self.slots[idx]
+        keep = s.length // self.pool.block_size + 1
+        if len(s.blocks) > keep:
+            self.pool.free(s.blocks[keep:])
+            del s.blocks[keep:]
 
     def retire(self, idx: int) -> None:
         """Free slot ``idx``'s blocks and reservation."""

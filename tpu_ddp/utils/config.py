@@ -259,6 +259,22 @@ class TrainConfig:
     # re-validates at engine construction). Env:
     # TPU_DDP_TENANT_CLASSES.
     tenant_classes: str = ""
+    # Speculative decoding (tpu_ddp/serve/speculative.py,
+    # docs/DESIGN.md §26): proposals verified per engine step
+    # (0 = off, the one-token baseline). Env: TPU_DDP_SPEC_K.
+    spec_k: int = 0
+    # Draft family for speculation: "chain" (same-program schedule,
+    # bitwise-exact stream), "self-<j>" (early exit over the target's
+    # first j blocks) or "quant" (full-depth int8 twin). Mirrors
+    # serve/speculative.py parse_spec_draft (the source of truth,
+    # which re-validates at engine construction). Env:
+    # TPU_DDP_SPEC_DRAFT.
+    spec_draft: str = "chain"
+    # Weight-only int8 decode compute (tpu_ddp/ops/quant.py): "none"
+    # serves fp, "int8" quantizes every decode-path projection
+    # per-output-channel at engine construction (re-derived on each
+    # weight hot-swap). Env: TPU_DDP_DECODE_QUANT.
+    decode_quant: str = "none"
 
     # Live train->serve weight streaming (tpu_ddp/publish/,
     # docs/DESIGN.md §24). Publish a versioned weight update to
@@ -585,6 +601,32 @@ class TrainConfig:
                 f"max_staleness_steps must be >= 0, got "
                 f"{self.max_staleness_steps} "
                 "(TPU_DDP_PUBLISH_MAX_STALENESS)")
+        self.spec_k = _env_num("TPU_DDP_SPEC_K", int, self.spec_k)
+        if self.spec_k < 0:
+            raise ValueError(
+                f"spec_k must be >= 0, got {self.spec_k} "
+                "(TPU_DDP_SPEC_K)")
+        env_sd = os.environ.get("TPU_DDP_SPEC_DRAFT")
+        if env_sd:
+            self.spec_draft = env_sd
+        # Mirrors serve/speculative.py parse_spec_draft (the source of
+        # truth, which re-validates at engine construction): "chain",
+        # "self-<j>" (j >= 1) or "quant".
+        sd = str(self.spec_draft).strip()
+        ok = sd in ("chain", "quant")
+        if not ok and sd.startswith("self-"):
+            ok = sd[len("self-"):].isdigit() and int(sd[5:]) >= 1
+        if not ok:
+            raise ValueError(
+                f"spec_draft={self.spec_draft!r}: expected "
+                "chain|self-<j>|quant (TPU_DDP_SPEC_DRAFT)")
+        env_dq = os.environ.get("TPU_DDP_DECODE_QUANT")
+        if env_dq:
+            self.decode_quant = env_dq
+        if self.decode_quant not in ("none", "int8"):
+            raise ValueError(
+                f"decode_quant={self.decode_quant!r}: expected "
+                "none|int8 (TPU_DDP_DECODE_QUANT)")
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.
