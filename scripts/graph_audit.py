@@ -208,6 +208,51 @@ def audit_serve_cells():
     ]
 
 
+def audit_long_context_cells():
+    """The §27 long-context surfaces: the tiered decode/prefill step
+    twins (mixed hot/cold reads through two slot tables), the two
+    batched page-movement programs (demote quantizes into donated cold
+    buffers; promote dequantizes into donated hot buffers — unaliased
+    donation here would copy a whole tier per movement), and the
+    context-parallel prefill-chunk program on an sp=2 mesh (its ring
+    collectives are the cell's fingerprint)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_ddp.parallel.mesh import make_mesh, replicated_sharding
+    from tpu_ddp.serve.engine import ServeEngine
+    from tpu_ddp.serve.kv_pool import _demote_prog, _promote_prog
+
+    model = _tiny_lm()
+    params = model.init(jax.random.key(0))
+    tiered = ServeEngine(model, params, **GEOM, kv_tiers=3,
+                         hbm_blocks=6, cold_blocks=9)
+    pool = tiered.pool
+    sds = jax.ShapeDtypeStruct
+    slots = sds((2,), jnp.int32)
+    cells = [
+        _program_audit("serve/tiered-decode",
+                       tiered.lower_tiered_decode_step),
+        _program_audit("serve/tiered-prefill",
+                       tiered.lower_tiered_prefill_step),
+        _program_audit("kv/demote", lambda: _demote_prog.lower(
+            pool.k, pool.v, pool.cold_k, pool.cold_v,
+            pool.cold_sk, pool.cold_sv, slots, slots)),
+        _program_audit("kv/promote", lambda: _promote_prog.lower(
+            pool.k, pool.v, pool.cold_k, pool.cold_v,
+            pool.cold_sk, pool.cold_sv, slots, slots)),
+    ]
+    sp = min(2, len(jax.devices()))
+    if sp == 2:
+        mesh = make_mesh(jax.devices()[:sp], dp=1, sp=sp)
+        rp = jax.device_put(params, replicated_sharding(mesh))
+        cp = ServeEngine(model, rp, **GEOM, cp_prefill="ring",
+                         mesh=mesh)
+        cells.append(_program_audit("serve/cp-prefill-ring",
+                                    cp.lower_prefill_step))
+    return cells
+
+
 def audit_fleet_cell():
     import jax
 
@@ -294,6 +339,7 @@ def build_cells(only=None):
                   lambda: [audit_train_cell("fused", overlap=True)]))
     specs.append(("mpmd", audit_mpmd_cells))
     specs.append(("serve", audit_serve_cells))
+    specs.append(("long-context", audit_long_context_cells))
     specs.append(("fleet", audit_fleet_cell))
     specs.append(("publish", audit_publish_cells))
     specs.append(("redistribute", audit_redistribute_cell))

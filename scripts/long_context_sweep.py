@@ -1,0 +1,277 @@
+"""Long-context serving sweep -> experiments/long_context_sweep.json.
+
+The §27 claim, measured: a prompt whose KV footprint is 8x the hot
+(HBM, exact-dtype) tier still prefills at near-resident TTFT, because
+the tiered pool streams pages through the int8 cold tier and the host
+spill tier instead of refusing admission — HBM bounds the HOT context
+per step, not the TOTAL context. Prompt length is the sweep's axis:
+the same engine geometry serves 1x..8x the hot capacity and reports
+TTFT per prompt token for each cell.
+
+Enforced claims (exit 1 on violation):
+
+1. capacity: the headline cell's prompt occupies >= 8x the hot tier's
+   usable pages (oversubscription is real, not nominal), and the
+   fully-resident oracle holds the whole prompt hot (the comparison is
+   tiered-vs-resident, not tiered-vs-thrashing);
+2. TTFT-per-token of the 8x-oversubscribed tiered cell <= 1.2x the
+   fully-resident tiers=1 cell on the SAME prompt (the demand
+   demote/promote traffic costs < 20% of prefill);
+3. BITWISE decode parity on mid-size prompts: the tiers=3 engine under
+   residency pressure (bf16 hot + bf16 cold — the lossless codec)
+   emits token streams EQUAL to the tiers=1 single-pool oracle,
+   request by request, plus pool accounting after drain;
+4. context-parallel prefill exactness: ring and ulysses cp cells emit
+   the EXACT greedy stream of the single-rank engine (first token and
+   the full continuation); their TTFT ratio is reported, not enforced
+   (on the forced-host CPU platform the sp=4 collectives cost more
+   than they save — the cell exists to pin exactness and give real
+   accelerators a measured baseline).
+
+The int8 cold codec is semantic (rounded re-reads), so the 8x headline
+cell carries liveness + accounting claims; the bitwise bar lives on
+the bf16 tier where demote/promote is a pure byte move.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+# The cp cells need an sp=4 mesh: force the 8-device host platform
+# (same header as scripts/graph_audit.py) before jax initializes.
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+REPS = 3          # per-cell repeats; best wall-clock wins (noise floor)
+MAX_NEW = 8
+
+
+def long_model():
+    import jax.numpy as jnp
+
+    from tpu_ddp.models.transformer import make_transformer
+
+    # The long-context geometry: a 1024-token window with enough
+    # per-chunk compute (4 layers, d_model 256) that the measurement
+    # reflects the paper's regime — prefill math dominating, residency
+    # bookkeeping amortized over real work. On the 2-layer d128 micro
+    # model the per-chunk demote dispatch is a third of the chunk's
+    # wall clock and the ratio measures host overhead, not the tier.
+    return make_transformer("TransformerLM-tiny", max_seq_len=1024,
+                            num_layers=4, d_model=256, d_ff=1024,
+                            compute_dtype=jnp.float32)
+
+
+def mid_model():
+    import jax.numpy as jnp
+
+    from tpu_ddp.models.transformer import make_transformer
+
+    return make_transformer("TransformerLM-tiny", max_seq_len=64,
+                            compute_dtype=jnp.float32)
+
+
+def run_long_cell(model, params, prompt, **knobs) -> dict:
+    """One prompt through one engine config, REPS times; TTFT is the
+    submit->first-token wall clock of the fastest rep (rep 1 pays any
+    jit compile; best-of absorbs it)."""
+    from tpu_ddp.serve import ServeEngine
+
+    best = None
+    for _ in range(REPS):
+        eng = ServeEngine(model, params, num_slots=1, block_size=32,
+                          prefill_chunk=64, **knobs)
+        stamp: list[float] = []
+        h = eng.submit(prompt, MAX_NEW,
+                       on_token=lambda t: stamp.append(
+                           time.perf_counter()) if not stamp else None)
+        t0 = time.perf_counter()
+        eng.run()
+        dt = time.perf_counter() - t0
+        cell = {
+            "prompt_tokens": int(prompt.size),
+            "hot_capacity_tokens": eng.pool.hot_usable * eng.pool.block_size,
+            "ttft_s": round(stamp[0] - t0, 4),
+            "ttft_per_token_us": round(
+                (stamp[0] - t0) / prompt.size * 1e6, 2),
+            "wall_s": round(dt, 4),
+            "tier_counts_at_drain": eng.pool.tier_counts(),
+            "pool_ok": (eng.pool.free_count == eng.pool.total_usable
+                        and eng.pool.refcount_ok([])),
+            "stream": [int(t) for t in h.tokens],
+        }
+        if best is not None and best["stream"] != cell["stream"]:
+            print("[long-context] REGRESSION: nondeterministic stream "
+                  "across repeats", flush=True)
+            raise SystemExit(1)
+        if best is None or cell["ttft_s"] < best["ttft_s"]:
+            best = cell
+    return best
+
+
+def run_mid_cell(model, params, specs, **knobs) -> dict:
+    """The mid-size parity workload: a mixed continuous batch through
+    the shared fast-tier geometry."""
+    from tpu_ddp.serve import ServeEngine
+
+    eng = ServeEngine(model, params, num_slots=4, block_size=8,
+                      prefill_chunk=8, cache_dtype="bf16", **knobs)
+    hs = [eng.submit(sp.prompt, sp.max_new_tokens) for sp in specs]
+    t0 = time.perf_counter()
+    eng.run()
+    return {
+        "wall_s": round(time.perf_counter() - t0, 4),
+        "streams": [[int(t) for t in h.tokens] for h in hs],
+        "pool_ok": (eng.pool.free_count == eng.pool.total_usable
+                    and eng.pool.refcount_ok([])),
+    }
+
+
+def run_cp_cell(model, params, prompt, mode, mesh=None) -> dict:
+    from tpu_ddp.serve import ServeEngine
+
+    best = None
+    for _ in range(REPS):
+        eng = ServeEngine(model, params, num_slots=1, block_size=8,
+                          prefill_chunk=8, cp_prefill=mode, mesh=mesh)
+        stamp: list[float] = []
+        h = eng.submit(prompt, MAX_NEW,
+                       on_token=lambda t: stamp.append(
+                           time.perf_counter()) if not stamp else None)
+        t0 = time.perf_counter()
+        eng.run()
+        cell = {"ttft_s": round(stamp[0] - t0, 4),
+                "stream": [int(t) for t in h.tokens]}
+        if best is None or cell["ttft_s"] < best["ttft_s"]:
+            best = cell
+    return best
+
+
+def main() -> int:
+    import jax
+
+    from tpu_ddp.parallel.mesh import make_mesh, replicated_sharding
+    from tpu_ddp.serve import make_long_prompt_workload
+
+    fails: list[str] = []
+    out_cells: dict = {}
+
+    def publish(name: str, cell: dict) -> dict:
+        pub = {k: v for k, v in cell.items()
+               if k not in ("stream", "streams")}
+        out_cells[name] = pub
+        return pub
+
+    def check(ok: bool, msg: str) -> None:
+        tag = "ok" if ok else "REGRESSION"
+        print(f"[long-context] {tag}: {msg}", flush=True)
+        if not ok:
+            fails.append(msg)
+
+    # ---- prompt-length axis: 1x..8x the hot tier ----------------------
+    # Tiered geometry: hbm_blocks=3 -> hot usable = 2 pages = 64 tokens.
+    # The 512-token prompt needs 16 pages: 8x oversubscribed. The
+    # oracle is tiers=1 with the whole 33-block pool resident.
+    model = long_model()
+    params = model.init(jax.random.key(0))
+    tiers = dict(kv_tiers=3, kv_cold_dtype="int8", hbm_blocks=3,
+                 cold_blocks=33)
+    resident = None
+    for plen in (64, 128, 256, 512):
+        spec = make_long_prompt_workload(
+            1, model.vocab_size, seed=5, prompt_len=plen,
+            max_new=(MAX_NEW, MAX_NEW + 1))[0]
+        prompt = np.asarray(spec.prompt, np.int32)
+        res = run_long_cell(model, params, prompt)
+        trd = run_long_cell(model, params, prompt, **tiers)
+        ratio = trd["ttft_per_token_us"] / res["ttft_per_token_us"]
+        trd["ttft_per_token_vs_resident"] = round(ratio, 3)
+        over = plen // 32 / (tiers["hbm_blocks"] - 1)
+        check(trd["pool_ok"] and res["pool_ok"],
+              f"prompt{plen}: pool accounting clean after drain")
+        publish(f"resident/prompt{plen}", res)
+        publish(f"tiered/prompt{plen}", trd)
+        if plen == 512:
+            resident, headline, over8 = res, trd, over
+    check(over8 >= 8.0,
+          f"headline prompt occupies {over8:.0f}x the hot tier (>= 8x)")
+    check(resident["hot_capacity_tokens"] >= 512 + MAX_NEW,
+          "oracle holds the whole prompt resident")
+    ratio = headline["ttft_per_token_vs_resident"]
+    check(ratio <= 1.2,
+          f"8x-oversubscribed TTFT/token {ratio:.3f}x resident <= 1.2x")
+
+    # ---- mid-size bitwise parity: tiered vs single-pool oracle --------
+    mmodel = mid_model()
+    mparams = mmodel.init(jax.random.key(1))
+    specs = make_long_prompt_workload(6, mmodel.vocab_size, seed=9,
+                                      prompt_len=20, max_new=(6, 12))
+    oracle = run_mid_cell(mmodel, mparams, specs)
+    tiered = run_mid_cell(mmodel, mparams, specs, kv_tiers=3,
+                          kv_cold_dtype="bf16", hbm_blocks=6,
+                          cold_blocks=33)
+    check(tiered["streams"] == oracle["streams"],
+          "mid-size prompts: BITWISE decode parity, tiered (bf16 cold, "
+          "hot tier 5 of 33 pages) vs the single-pool oracle")
+    check(tiered["pool_ok"] and oracle["pool_ok"],
+          "mid-size prompts: pool accounting clean after drain")
+    publish("midsize/oracle", oracle)
+    publish("midsize/tiered", tiered)
+
+    # ---- context-parallel prefill: exactness + reported TTFT ----------
+    if len(jax.devices()) >= 4:
+        sp = 4
+        mesh = make_mesh(jax.devices()[:sp], dp=1, sp=sp)
+        rp = jax.device_put(mparams, replicated_sharding(mesh))
+        spec = make_long_prompt_workload(1, mmodel.vocab_size, seed=13,
+                                         prompt_len=48,
+                                         max_new=(MAX_NEW, MAX_NEW + 1))[0]
+        cprompt = np.asarray(spec.prompt, np.int32)
+        base = run_cp_cell(mmodel, mparams, cprompt, "off")
+        publish("cp/single-rank", base)
+        for mode in ("ring", "ulysses"):
+            cell = run_cp_cell(mmodel, rp, cprompt, mode, mesh=mesh)
+            cell["ttft_vs_single_rank"] = round(
+                cell["ttft_s"] / base["ttft_s"], 3)
+            check(cell["stream"][0] == base["stream"][0],
+                  f"cp/{mode}: greedy first token equals single-rank")
+            check(cell["stream"] == base["stream"],
+                  f"cp/{mode}: full greedy stream equals single-rank")
+            publish(f"cp/{mode}-sp{sp}", cell)
+
+    out = {
+        "note": ("Long-context serving sweep (DESIGN.md §27, "
+                 "EXPERIMENTS.md §23): TTFT per prompt token with the "
+                 "prompt-length axis sweeping 1x..8x the hot tier's "
+                 "capacity. Absolute seconds are CPU-host-relative; "
+                 "the committed claims are the <= 1.2x "
+                 "tiered-vs-resident TTFT/token bound at 8x "
+                 "oversubscription, bitwise mid-size decode parity "
+                 "through the lossless bf16 cold codec, and "
+                 "context-parallel prefill exactness."),
+        "platform": jax.devices()[0].platform,
+        "reps": REPS,
+        "cells": out_cells,
+        "fails": fails,
+    }
+    (REPO / "experiments" / "long_context_sweep.json").write_text(
+        json.dumps(out, indent=1))
+    if fails:
+        print(f"[long-context] {len(fails)} enforced claim(s) FAILED")
+        return 1
+    print(f"[long-context] all enforced claims hold "
+          f"({len(out_cells)} cells)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -567,6 +567,10 @@ class TestFactoredZeRO1Partitioned:
             losses.append(float(np.mean(np.asarray(loss))))
         return tr, state, losses
 
+    # test_tp_state_layout pins the partitioned-tp layout fast and
+    # TestFactoredZeRO1 pins the zero1 equivalence; this full tp
+    # equivalence composes the two -> slow tier.
+    @pytest.mark.slow
     def test_tp_matches_replicated_opt(self, devices):
         model = make_transformer("TransformerLM-tiny", max_seq_len=32,
                                  compute_dtype=jnp.float32)

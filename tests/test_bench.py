@@ -68,6 +68,9 @@ class TestBenchEntry:
         # CPU platform: no peak table -> mfu is null, never a wrong number.
         assert ex["mfu"] is None and ex["peak_tflops_bf16"] is None
 
+    # test_lm_config runs the same bench entry fast; this repeats it
+    # only to read the peak-flops override out of the report.
+    @pytest.mark.slow
     def test_mfu_env_peak_override(self, monkeypatch):
         monkeypatch.setenv("TPU_DDP_PEAK_TFLOPS", "100")
         out = bench.run_lm_bench(batch_size=2, seq_len=64, timed_iters=1,

@@ -233,6 +233,9 @@ class TestLMCheckpoint:
         rng = np.random.default_rng(seed)
         return rng.integers(0, 1024, size=(b, L))
 
+    # Core roundtrips and the fsdp/zero restore tests keep checkpoint
+    # coverage fast; the tp layout adds only placement on top.
+    @pytest.mark.slow
     def test_lm_trainer_roundtrip_tp(self, tmp_path, devices):
         import jax.numpy as jnp
 

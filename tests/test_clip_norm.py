@@ -161,6 +161,9 @@ class TestClipLM:
                                            rtol=2e-5, atol=1e-6,
                                            err_msg=name)
 
+    # test_layouts_agree pins the cross-layout clip agreement fast;
+    # the tp mesh adds only one more layout to the same check.
+    @pytest.mark.slow
     def test_tp_layouts_agree(self, devices):
         """The tp-sharded leaves' norm contribution is psum'd over mp:
         dense dp x tp == fsdp x tp == zero1 x tp."""

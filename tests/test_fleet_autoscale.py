@@ -340,14 +340,15 @@ def test_scale_up_boots_current_version_no_new_compiles(model, params):
 
 
 def test_graph_audit_n_programs_pinned():
-    """Speculative decoding + int8 decode added exactly FOUR jit
-    surfaces (spec-step, spec-step+quant, decode+int8, prefill+int8;
-    the chain family deliberately adds none): 19 -> 23 programs."""
+    """Long-context serving added exactly FIVE jit surfaces
+    (tiered-decode, tiered-prefill, the demote/promote page-movement
+    pair, cp-prefill-ring; the ulysses mode shares the cp program
+    shape and chain speculation still adds none): 23 -> 28 programs."""
     art = pathlib.Path(__file__).resolve().parents[1] / \
         "experiments" / "graph_audit.json"
     audit = json.loads(art.read_text())
-    assert audit["n_programs"] == 23
-    assert len(audit["cells"]) == 23
+    assert audit["n_programs"] == 28
+    assert len(audit["cells"]) == 28
 
 
 # ---------------------------------------------------------------------------

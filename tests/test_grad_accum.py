@@ -48,7 +48,9 @@ def _step(devices, grad_accum, param_sharding="replicated", dp=2, sp=1):
 
 
 class TestGradAccum:
-    @pytest.mark.parametrize("accum", [2, 4])
+    @pytest.mark.parametrize("accum", [
+        # accum=4 only lengthens the scan accum=2 already pins.
+        2, pytest.param(4, marks=pytest.mark.slow)])
     def test_matches_single_step(self, devices, accum):
         p1, l1 = _step(devices, 1)
         pa, la = _step(devices, accum)

@@ -112,7 +112,11 @@ def test_serve_knobs_registered_under_goodput_objective():
               # Speculative decoding + quantized decode (DESIGN.md
               # §26): window width and draft family in the engine,
               # int8 weights at engine construction.
-              "spec_k", "spec_draft", "decode_quant"}
+              "spec_k", "spec_draft", "decode_quant",
+              # Long-context serving knobs (DESIGN.md §27): tier count
+              # and cold codec on the KV pool, context-parallel prefill
+              # on the engine's prefill path.
+              "kv_tiers", "kv_cold_dtype", "cp_prefill"}
     for f in fields:
         k = knob_by_field(f)
         assert k is not None and k.objective == "goodput", f
@@ -150,6 +154,13 @@ def test_serve_knobs_registered_under_goodput_objective():
     assert not knob_by_field("spec_k").semantic
     assert not knob_by_field("spec_draft").semantic
     assert knob_by_field("spec_k").env == "TPU_DDP_SPEC_K"
+    # The int8 cold codec rounds re-read pages -> semantic like
+    # kv_wire; the tier count and cp prefill only move/split exact
+    # bytes (bitwise parity in tests/test_long_context.py), so both
+    # are pure scheduling.
+    assert knob_by_field("kv_cold_dtype").semantic
+    assert not knob_by_field("kv_tiers").semantic
+    assert not knob_by_field("cp_prefill").semantic
     cfg, ctx = TrainConfig(), Workload(platform="cpu")
     good = {k.field for k, _ in
             searchable_knobs(cfg, ctx, objective="goodput",
@@ -160,9 +171,12 @@ def test_serve_knobs_registered_under_goodput_objective():
     # scale cooldown needs a live autoscaler, a non-chain draft needs
     # spec_k > 0 — tune/space.py violations) and drop out of the
     # space; spec_k and decode_quant are live on a single engine.
+    # (kv_cold_dtype likewise collapses: it is inert until kv_tiers
+    # lifts off 1, while kv_tiers and cp_prefill stay live.)
     assert good == fields - {"router_policy", "kv_wire",
                              "publish_wire", "max_staleness_steps",
-                             "scale_cooldown_ms", "spec_draft"}
+                             "scale_cooldown_ms", "spec_draft",
+                             "kv_cold_dtype"}
     step = {k.field for k, _ in searchable_knobs(cfg, ctx)}
     assert not (step & fields)
     # With the edge, the cache, a publish cadence, and the autoscaler
@@ -174,7 +188,7 @@ def test_serve_knobs_registered_under_goodput_objective():
     good = {k.field for k, _ in
             searchable_knobs(fleet_cfg, ctx, objective="goodput",
                              include_semantic=True)}
-    assert good == fields - {"spec_k", "spec_draft"}
+    assert good == fields - {"spec_k", "spec_draft", "kv_cold_dtype"}
     # On a single engine with speculation on, the draft family opens.
     spec_cfg = TrainConfig(spec_k=4)
     good = {k.field for k, _ in

@@ -237,6 +237,9 @@ class TestRemat:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-6, atol=1e-7)
 
+    # test_values_and_grads_identical pins remat-under-ring fast; the
+    # pipeline composition re-tests two already-pinned pieces.
+    @pytest.mark.slow
     def test_pipeline_with_remat(self, devices):
         """GPipe + per-layer remat trains and matches the dense step."""
         from tpu_ddp.ops.optim import SGD

@@ -563,6 +563,9 @@ class TestTrainServeRoundTrip:
             _ref_logprobs(model, trained, prompt, req.tokens),
             rtol=1e-4, atol=1e-4)
 
+    # The under-budget and cross-strategy cells keep the restore path
+    # fast; this adds only the tp-serving placement on top.
+    @pytest.mark.slow
     def test_checkpoint_over_budget_serves_tensor_parallel(
             self, model, devices, tmp_path):
         """A checkpoint too big for one chip's param budget routes

@@ -133,7 +133,11 @@ class TestAcceptRule:
 
     @pytest.mark.parametrize("knobs", [
         dict(spec_k=3, spec_draft="self-1"),
-        dict(spec_k=3, spec_draft="quant", decode_quant="int8"),
+        # The quant family's ledger runs the same accept path; its
+        # accounting stays in the fast tier via TestKVRollback.
+        pytest.param(dict(spec_k=3, spec_draft="quant",
+                          decode_quant="int8"),
+                     marks=pytest.mark.slow),
     ])
     def test_fused_ledger_identity(self, model, params, knobs):
         """proposed == accepted + rejected, per request and in
@@ -300,7 +304,9 @@ class TestChainParity:
 class TestKVRollback:
     @pytest.mark.parametrize("knobs", [
         dict(spec_k=3, spec_draft="self-1"),
-        dict(spec_k=5, spec_draft="self-2"),
+        # self-2 only widens the early-exit depth self-1 already pins.
+        pytest.param(dict(spec_k=5, spec_draft="self-2"),
+                     marks=pytest.mark.slow),
         dict(spec_k=4, spec_draft="quant", decode_quant="int8"),
     ])
     def test_accounting_holds_after_every_step(self, model, params,

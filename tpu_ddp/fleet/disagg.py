@@ -570,9 +570,13 @@ class DisaggEngine:
         self._emit_first(req, tok, lp)
         if not req.done:
             nb = len(s.blocks)
-            ids = jnp.asarray(np.asarray(s.blocks, np.int32))
-            kb = self.prefill_pool.k[:, ids]   # (L, nb, bs, KV, hd)
-            vb = self.prefill_pool.v[:, ids]
+            # page_arrays is the tier-aware whole-page read: at
+            # tiers == 1 it is the direct gather this always was; a
+            # tiered prefill pool promotes the blocks hot first so the
+            # wire carries exact-dtype bytes, never double-quantized
+            # cold pages.
+            kb, vb = self.prefill_pool.page_arrays(s.blocks)
+            # kb/vb: (L, nb, bs, KV, hd)
             # Zero the garbage tail of the last block: stale positions
             # would pollute the int8 per-block quantization scales.
             valid = (np.arange(nb * self.block_size)

@@ -759,6 +759,24 @@ def main(argv=None) -> int:
                         "quantization of every decode-path projection "
                         "at engine construction. Sets "
                         "TPU_DDP_DECODE_QUANT for every rank")
+    p.add_argument("--kv-tiers", type=int, default=None,
+                   choices=(1, 2, 3),
+                   help="tiered KV pool (tpu_ddp/serve/kv_pool.py): "
+                        "1 = single-tier, 2 adds an in-HBM quantized "
+                        "cold tier, 3 adds host-memory spill behind "
+                        "it. Sets TPU_DDP_KV_TIERS for every rank")
+    p.add_argument("--kv-cold-dtype", default=None,
+                   choices=("int8", "bf16"),
+                   help="cold-page codec for --kv-tiers >= 2: "
+                        "per-token-row int8 or a bf16 downcast "
+                        "(lossless under a bf16 hot cache dtype). "
+                        "Sets TPU_DDP_KV_COLD_DTYPE for every rank")
+    p.add_argument("--cp-prefill", default=None,
+                   choices=("off", "ring", "ulysses"),
+                   help="context-parallel chunked prefill "
+                        "(tpu_ddp/serve/long_context.py): shard each "
+                        "prefill chunk over the serving mesh's sp "
+                        "axis. Sets TPU_DDP_CP_PREFILL for every rank")
     p.add_argument("--autotune", default=None,
                    choices=("off", "cached", "search"),
                    help="perf-knob autotuning (tpu_ddp/tune/): 'cached' "
@@ -871,6 +889,12 @@ def main(argv=None) -> int:
         env["TPU_DDP_SPEC_DRAFT"] = args.spec_draft
     if args.decode_quant is not None:
         env["TPU_DDP_DECODE_QUANT"] = args.decode_quant
+    if args.kv_tiers is not None:
+        env["TPU_DDP_KV_TIERS"] = str(args.kv_tiers)
+    if args.kv_cold_dtype is not None:
+        env["TPU_DDP_KV_COLD_DTYPE"] = args.kv_cold_dtype
+    if args.cp_prefill is not None:
+        env["TPU_DDP_CP_PREFILL"] = args.cp_prefill
     if args.autotune is not None:
         env["TPU_DDP_AUTOTUNE"] = args.autotune
     if args.audit is not None:

@@ -548,6 +548,18 @@ def make_transformer(name: str = "TransformerLM-small",
         "TransformerLM-large": dict(num_layers=12, num_heads=16,
                                     d_model=2048, d_ff=8192,
                                     vocab_size=32000, remat="blocks"),
+        # Long-context zoo entries (DESIGN.md §27): tiny compute dims
+        # so CPU tests and the long-context sweep trace fast, with a
+        # max_seq_len far past what one hot KV tier holds — prompt
+        # length, not model size, is what these exist to stress.
+        "TransformerLM-tiny-8k": dict(num_layers=2, num_heads=4,
+                                      d_model=128, d_ff=512,
+                                      vocab_size=1024,
+                                      max_seq_len=8192),
+        "TransformerLM-small-32k": dict(num_layers=4, num_heads=8,
+                                        d_model=512, d_ff=2048,
+                                        vocab_size=32000,
+                                        max_seq_len=32768),
         "TransformerLM-moe-tiny": dict(num_layers=2, num_heads=4,
                                        d_model=128, d_ff=256,
                                        vocab_size=1024, moe_experts=4),

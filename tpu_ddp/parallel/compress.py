@@ -541,3 +541,42 @@ class EdgeCodec:
             flat = k._dequant(wire["q"], wire["scale"])[:size]
             return flat.reshape(shape)
         raise ValueError(f"unknown edge wire kind {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# Cold-page codec (tpu_ddp/serve/kv_pool.py tiered KV, DESIGN.md §27).
+# The SAME per-block int8 scheme as GradCompressor._quant — scale =
+# max|x|/127 clamped away from zero — but with DETERMINISTIC
+# round-to-nearest instead of stochastic rounding: a KV page demoted
+# and promoted twice must dequantize identically both times (replay /
+# migration parity is position-keyed, never RNG-keyed), and there is
+# no error-feedback loop to absorb rounding bias here. The scale is
+# per (layer, page, token-row) — one row's outlier cannot flatten its
+# neighbours' resolution — matching the disagg KV wire's granularity
+# choice (fleet/disagg.py zero-masks garbage tails for the same
+# reason).
+# ---------------------------------------------------------------------------
+
+
+def page_quantize(x, cold_dtype):
+    """Quantize KV pages ``x`` (..., bs, KV, hd) for cold storage.
+
+    Returns ``(q, scale)`` with scale shaped like ``x`` minus the two
+    trailing (KV, hd) axes. ``cold_dtype`` jnp.int8 -> per-row symmetric
+    int8; jnp.bfloat16 -> a plain downcast with unit scales (lossless
+    when the hot dtype is already bf16 — the parity-testing tier)."""
+    if cold_dtype == jnp.bfloat16:
+        return (x.astype(jnp.bfloat16),
+                jnp.ones(x.shape[:-2], jnp.float32))
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    scale = jnp.maximum(amax / 127.0, jnp.float32(1e-30))
+    q = jnp.clip(jnp.round(xf / scale[..., None, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def page_dequantize(q, scale, out_dtype):
+    """Inverse of :func:`page_quantize`: (..., bs, KV, hd) pages back
+    in ``out_dtype`` (the pool's hot dtype)."""
+    return (q.astype(jnp.float32)
+            * scale[..., None, None]).astype(out_dtype)

@@ -86,12 +86,26 @@ def _block_attn(q, k, v, m_prev, l_prev, acc_prev, q_pos, k_pos, causal,
 
 
 def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
-                   axis_size: int | None = None, causal: bool = False):
+                   axis_size: int | None = None, causal: bool = False,
+                   *, q_offset=0, cache_k=None, cache_v=None,
+                   cache_valid=None):
     """Exact multi-head attention with sequence sharded over ``axis_name``.
 
     Must be called inside a ``shard_map`` over a mesh with that axis.
     ``q``/``k``/``v``: local chunks (B, L/sp, H, D). Returns the local
     output chunk (B, L/sp, H, D) in ``q``'s dtype.
+
+    Cache seeding (context-parallel chunked prefill, DESIGN.md §27):
+    ``cache_k``/``cache_v`` (B, S, KV, D), REPLICATED across the ring,
+    hold already-committed KV for absolute positions ``0 .. S-1`` — a
+    paged-pool view of the chunks prefilled so far. They seed the
+    online-softmax state with one extra ``_block_attn`` before the ring
+    spins, and ``q_offset`` (static or traced scalar) shifts every
+    position so chunk-local indices become absolute: the result is
+    exact attention over ``cache ++ current chunk``, chunk by chunk.
+    ``cache_valid`` (bool (S,)) masks cache tail garbage; cache entries
+    never need the causal mask (every cache position precedes
+    ``q_offset``, hence every query).
     """
     if axis_size is None:
         raise ValueError("axis_size (the sp mesh extent) is required — "
@@ -99,11 +113,16 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     b, lc, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     my = lax.axis_index(axis_name)
-    q_pos = my * lc + jnp.arange(lc)
+    q_pos = q_offset + my * lc + jnp.arange(lc)
 
     m = jnp.full((b, h, lc), _NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, lc), jnp.float32)
     acc = jnp.zeros((b, lc, h, d), jnp.float32)
+    if cache_k is not None:
+        lk = cache_k.shape[1]
+        m, l, acc = _block_attn(q, cache_k, cache_v, m, l, acc,
+                                q_pos, jnp.arange(lk), False, scale,
+                                k_valid=cache_valid)
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     k_cur, v_cur = k, v
@@ -111,7 +130,7 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
         # After `step` forward rotations each device holds the chunk that
         # originated `step` positions behind it on the ring.
         kv_owner = (my - step) % axis_size
-        k_pos = kv_owner * lc + jnp.arange(lc)
+        k_pos = q_offset + kv_owner * lc + jnp.arange(lc)
         m, l, acc = _block_attn(q, k_cur, v_cur, m, l, acc,
                                 q_pos, k_pos, causal, scale)
         if step != axis_size - 1:
@@ -124,23 +143,68 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
 
 
 def blockwise_attention(q, k, v, causal: bool = False,
-                        block_size: int = 512):
+                        block_size: int = 512, *, q_pos=None, k_pos=None,
+                        k_valid=None):
     """Exact attention with K/V streamed in blocks (online softmax).
 
     Same math as :func:`full_attention` but the score buffer is
     (B, H, L, block) instead of (B, H, L, L) — the memory-bounded jnp
     path for long local sequences (the Ulysses local attention uses this
     when the Pallas flash kernel is off, tpu_ddp/parallel/ulysses.py).
+
+    Explicit positions (§27 chunked prefill): ``q_pos`` (Lq,) and
+    ``k_pos`` (Lk,) override the default 0-based index alignment, and
+    ``k_valid`` (bool (Lk,)) masks invalid keys — what lets a caller
+    prepend cache KV (absolute positions 0..S-1) to a chunk whose
+    queries start at an offset. Defaults reproduce the original
+    program exactly — existing callers' compiled steps are unchanged.
     """
     b, L, h, d = q.shape
+    Lk = k.shape[1]
     kvh = k.shape[2]  # may be < h under grouped-query attention
-    bs = min(block_size, L)
-    n = -(-L // bs)
-    pad = n * bs - L
+    explicit = (q_pos is not None or k_pos is not None
+                or k_valid is not None)
+    bs = min(block_size, Lk)
+    n = -(-Lk // bs)
+    pad = n * bs - Lk
+    scale = 1.0 / (d ** 0.5)
+    if explicit:
+        # General path: carry positions/validity through the padding
+        # and the scan explicitly. Pad positions get a huge sentinel
+        # (causally masked for any query) AND k_valid False.
+        if q_pos is None:
+            q_pos = jnp.arange(L)
+        if k_pos is None:
+            k_pos = jnp.arange(Lk)
+        if k_valid is None:
+            k_valid = jnp.ones((Lk,), bool)
+        if pad:
+            k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            k_pos = jnp.pad(k_pos, (0, pad),
+                            constant_values=jnp.iinfo(jnp.int32).max)
+            k_valid = jnp.pad(k_valid, (0, pad), constant_values=False)
+        kb = jnp.moveaxis(k.reshape(b, n, bs, kvh, d), 1, 0)
+        vb = jnp.moveaxis(v.reshape(b, n, bs, kvh, d), 1, 0)
+
+        @jax.checkpoint
+        def xbody(carry, inp):
+            kc, vc, kp, kw = inp
+            state = _block_attn(q, kc, vc, *carry, q_pos, kp, causal,
+                                scale, k_valid=kw)
+            return state, None
+
+        init = (jnp.full((b, h, L), _NEG_INF, jnp.float32),
+                jnp.zeros((b, h, L), jnp.float32),
+                jnp.zeros((b, L, h, d), jnp.float32))
+        (m, l, acc), _ = lax.scan(
+            init=init, xs=(kb, vb, k_pos.reshape(n, bs),
+                           k_valid.reshape(n, bs)), f=xbody)
+        out = acc / l.transpose(0, 2, 1)[..., None]
+        return out.astype(q.dtype)
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-    scale = 1.0 / (d ** 0.5)
     q_pos = jnp.arange(L)
     # (n, B, bs, KV, D) so lax.scan carries the online-softmax state over
     # key blocks; XLA keeps only one block's scores live at a time.

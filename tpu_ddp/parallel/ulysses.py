@@ -67,17 +67,33 @@ def _seq_to_heads(x, axis_name):
 
 def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
                       axis_size: int | None = None, causal: bool = False,
-                      flash: bool = False):
+                      flash: bool = False, *, q_offset=0, cache_k=None,
+                      cache_v=None, cache_valid=None):
     """Exact multi-head attention with sequence sharded over ``axis_name``.
 
     Must be called inside a ``shard_map`` over a mesh with that axis.
     ``q``/``k``/``v``: local chunks (B, L/sp, H, D) with RoPE (or any
     position encoding) already applied at the chunks' GLOBAL positions.
     Returns the local output chunk (B, L/sp, H, D) in ``q``'s dtype.
+
+    Cache prepending (context-parallel chunked prefill, DESIGN.md §27):
+    ``cache_k``/``cache_v`` (B, S, KV, D), replicated across ranks, hold
+    committed KV for absolute positions ``0 .. S-1``; ``q_offset``
+    shifts the gathered chunk's positions to absolute. After the
+    all-to-all each rank holds the full chunk for H/sp heads — it
+    slices ITS head group out of the replicated cache, concatenates
+    cache-then-chunk along keys, and runs the blockwise path with
+    explicit positions (``cache_valid`` masks the cache tail). This
+    path requires the jnp blockwise attention (the flash kernel has no
+    explicit-position interface), so ``flash`` must be off when a
+    cache is given.
     """
     if axis_size is None:
         raise ValueError("axis_size (the sp mesh extent) is required — "
                          "loop bounds must be static under jit")
+    if cache_k is not None and flash:
+        raise ValueError("ulysses_attention: cache prepending requires "
+                         "the blockwise path (flash=False)")
     h, kvh = q.shape[2], k.shape[2]
     if h % axis_size:
         raise ValueError(
@@ -89,6 +105,8 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
         # result unchanged). Head-contiguous groups survive the a2a: q's
         # i-th head block maps exactly onto kv's i-th head block.
         k, v = repeat_kv_heads(k, v, h // kvh)
+        if cache_k is not None:
+            cache_k, cache_v = repeat_kv_heads(cache_k, cache_v, h // kvh)
         kvh = h
     if kvh == h:
         # One collective for all three tensors: same bytes as three
@@ -105,7 +123,31 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     # materializing (L, L) scores would forfeit what sp is for — so it's
     # the Pallas flash kernel or the blockwise jnp path, never
     # full_attention.
-    if flash:
+    if cache_k is not None:
+        # Each rank now owns head group `idx`: slice the SAME group out
+        # of the replicated cache (group-contiguous head order survives
+        # the tiled a2a) and prepend it on the key axis. Explicit
+        # positions make the causal mask exact: the chunk's queries sit
+        # at q_offset.., the cache's keys at 0..S-1 (always visible,
+        # modulo cache_valid).
+        L = q.shape[1]
+        S = cache_k.shape[1]
+        idx = lax.axis_index(axis_name)
+        ckvh = cache_k.shape[2]
+        per = ckvh // axis_size
+        ck = lax.dynamic_slice_in_dim(cache_k, idx * per, per, axis=2)
+        cv = lax.dynamic_slice_in_dim(cache_v, idx * per, per, axis=2)
+        pos = q_offset + jnp.arange(L)
+        out = blockwise_attention(
+            q,
+            jnp.concatenate([ck.astype(k.dtype), k], axis=1),
+            jnp.concatenate([cv.astype(v.dtype), v], axis=1),
+            causal=causal, q_pos=pos,
+            k_pos=jnp.concatenate([jnp.arange(S), pos]),
+            k_valid=jnp.concatenate(
+                [jnp.ones((S,), bool) if cache_valid is None
+                 else cache_valid, jnp.ones((L,), bool)]))
+    elif flash:
         from tpu_ddp.ops.pallas import flash_attention
         # Grouped K/V go straight in: the kernel indexes K/V blocks by
         # q-head group natively, and the a2a's contiguous head blocks

@@ -19,11 +19,17 @@ from tpu_ddp.serve.loadgen import (
     RequestSpec,
     TraceEvent,
     calibrate_rate,
+    make_long_prompt_workload,
     make_shared_prefix_workload,
     make_trace,
     make_workload,
     run_load,
     run_trace,
+)
+from tpu_ddp.serve.long_context import (
+    build_cp_prefill_step,
+    build_tiered_decode_step,
+    build_tiered_prefill_step,
 )
 from tpu_ddp.serve.scheduler import Scheduler, TenantClass, parse_tenant_classes
 from tpu_ddp.serve.speculative import (
@@ -36,7 +42,9 @@ from tpu_ddp.serve.speculative import (
 __all__ = [
     "PagedKVPool", "Request", "RequestSpec", "SPEC_DRAFTS", "Scheduler",
     "ServeEngine", "TenantClass", "TraceEvent", "accept_length",
-    "build_spec_step", "calibrate_rate", "make_shared_prefix_workload",
-    "make_trace", "make_workload", "parse_spec_draft",
-    "parse_tenant_classes", "run_load", "run_trace",
+    "build_cp_prefill_step", "build_spec_step",
+    "build_tiered_decode_step", "build_tiered_prefill_step",
+    "calibrate_rate", "make_long_prompt_workload",
+    "make_shared_prefix_workload", "make_trace", "make_workload",
+    "parse_spec_draft", "parse_tenant_classes", "run_load", "run_trace",
 ]

@@ -248,6 +248,11 @@ class ServeEngine:
                  spec_k: int | None = None,
                  spec_draft: str | None = None,
                  decode_quant: str | None = None,
+                 kv_tiers: int | None = None,
+                 kv_cold_dtype: str | None = None,
+                 hbm_blocks: int | None = None,
+                 cold_blocks: int | None = None,
+                 cp_prefill: str | None = None,
                  mesh=None,
                  metrics: MetricsLogger | None = None,
                  config=None):
@@ -272,8 +277,20 @@ class ServeEngine:
             num_blocks = self.num_slots * self.blocks_per_seq + 1
         cache_dtype = (cache_dtype if cache_dtype is not None
                        else config.serve_cache_dtype)
+        # Tiered KV (§27, TPU_DDP_KV_TIERS / TPU_DDP_KV_COLD_DTYPE):
+        # tiers == 1 is the round-12 pool bit-for-bit; tiers > 1 bounds
+        # HOT context by hbm_blocks while the logical pool (what the
+        # scheduler admits against) stays num_blocks.
+        self.kv_tiers = int(kv_tiers if kv_tiers is not None
+                            else getattr(config, "kv_tiers", 1))
+        self.kv_cold_dtype = str(
+            kv_cold_dtype if kv_cold_dtype is not None
+            else getattr(config, "kv_cold_dtype", "int8"))
         self.pool = PagedKVPool(model, num_blocks, self.block_size,
-                                cache_dtype)
+                                cache_dtype, tiers=self.kv_tiers,
+                                cold_dtype=self.kv_cold_dtype,
+                                hbm_blocks=hbm_blocks,
+                                cold_blocks=cold_blocks)
         # Tensor-parallel serving: params arrive pre-sharded over
         # ``mesh``'s model axis (parallel/tensor_parallel.py
         # shard_decode_params); the pool and every host-built input
@@ -284,6 +301,13 @@ class ServeEngine:
             rep = replicated_sharding(mesh)
             self.pool.k = jax.device_put(self.pool.k, rep)
             self.pool.v = jax.device_put(self.pool.v, rep)
+            if self.kv_tiers > 1:
+                self.pool.cold_k = jax.device_put(self.pool.cold_k, rep)
+                self.pool.cold_v = jax.device_put(self.pool.cold_v, rep)
+                self.pool.cold_sk = jax.device_put(self.pool.cold_sk,
+                                                   rep)
+                self.pool.cold_sv = jax.device_put(self.pool.cold_sv,
+                                                   rep)
         prefix_cache = (bool(prefix_cache) if prefix_cache is not None
                         else config.prefix_cache)
         self.prefix = None
@@ -313,6 +337,45 @@ class ServeEngine:
                                           self.blocks_per_seq)
         self._prefill = _build_prefill_step(model, self.block_size,
                                             self.blocks_per_seq)
+        # Long-context programs (§27). The tiered step twins replace
+        # the decode/prefill programs only when tiers > 1 — at the
+        # default they are never built and the round-12 programs run
+        # untouched. Context-parallel prefill (TPU_DDP_CP_PREFILL)
+        # swaps the prefill-chunk program for the sp-sharded one.
+        self.cp_prefill = str(cp_prefill if cp_prefill is not None
+                              else getattr(config, "cp_prefill", "off"))
+        if self.cp_prefill not in ("off", "ring", "ulysses"):
+            raise ValueError(
+                f"cp_prefill={self.cp_prefill!r}: expected 'off', "
+                "'ring' or 'ulysses' (TPU_DDP_CP_PREFILL)")
+        self._tiered_decode = self._tiered_prefill = None
+        if self.kv_tiers > 1:
+            from tpu_ddp.serve.long_context import (
+                build_tiered_decode_step, build_tiered_prefill_step)
+            self._tiered_decode = build_tiered_decode_step(
+                model, self.block_size, self.blocks_per_seq)
+            self._tiered_prefill = build_tiered_prefill_step(
+                model, self.block_size, self.blocks_per_seq)
+        if self.cp_prefill != "off":
+            if self.kv_tiers > 1:
+                raise ValueError(
+                    "cp_prefill requires the single-tier pool "
+                    "(TPU_DDP_KV_TIERS=1): the sharded chunk step "
+                    "scatters through the logical table directly")
+            if mesh is None or "sp" not in mesh.shape \
+                    or mesh.shape["sp"] < 2:
+                raise ValueError(
+                    "cp_prefill needs a mesh with an 'sp' axis of "
+                    "extent >= 2 (TPU_DDP_CP_PREFILL)")
+            sp = mesh.shape["sp"]
+            if self.prefill_chunk % sp:
+                raise ValueError(
+                    f"prefill_chunk={self.prefill_chunk} must divide "
+                    f"evenly over sp={sp} ranks (TPU_DDP_CP_PREFILL)")
+            from tpu_ddp.serve.long_context import build_cp_prefill_step
+            self._prefill = build_cp_prefill_step(
+                model, self.block_size, self.blocks_per_seq, mesh, sp,
+                self.cp_prefill)
         # Speculative decoding + quantized decode compute (§26,
         # TPU_DDP_SPEC_K / TPU_DDP_SPEC_DRAFT / TPU_DDP_DECODE_QUANT):
         # same knob convention as above — explicit arguments win over
@@ -452,6 +515,37 @@ class ServeEngine:
             sds((S, BPS), jnp.int32), sds((S,), jnp.int32),
             sds((S,), jnp.int32), sds((S,), jnp.float32),
             sds((S,), jnp.int32), sds((S,), jnp.int32))
+
+    def lower_tiered_decode_step(self):
+        """``jit.lower`` the tiered whole-bank decode step (§27 audit
+        surface). Raises at ``kv_tiers == 1`` — no tiered programs
+        exist there by construction."""
+        if self._tiered_decode is None:
+            raise ValueError("no tiered decode program: kv_tiers == 1")
+        S, BPS = self.num_slots, self.blocks_per_seq
+        sds = jax.ShapeDtypeStruct
+        return self._tiered_decode.lower(
+            self._decode_params, self.pool.k, self.pool.v,
+            self.pool.cold_k, self.pool.cold_v,
+            self.pool.cold_sk, self.pool.cold_sv,
+            sds((S, BPS), jnp.int32), sds((S, BPS), jnp.int32),
+            sds((S,), jnp.int32), sds((S,), jnp.int32),
+            sds((S,), jnp.float32), sds((S,), jnp.int32))
+
+    def lower_tiered_prefill_step(self):
+        """``jit.lower`` the tiered one-slot prefill-chunk step."""
+        if self._tiered_prefill is None:
+            raise ValueError("no tiered prefill program: kv_tiers == 1")
+        BPS = self.blocks_per_seq
+        sds = jax.ShapeDtypeStruct
+        return self._tiered_prefill.lower(
+            self._decode_params, self.pool.k, self.pool.v,
+            self.pool.cold_k, self.pool.cold_v,
+            self.pool.cold_sk, self.pool.cold_sv,
+            sds((BPS,), jnp.int32), sds((BPS,), jnp.int32),
+            sds((1, self.prefill_chunk), jnp.int32),
+            sds((), jnp.int32), sds((), jnp.int32),
+            sds((), jnp.float32), sds((), jnp.int32))
 
     @classmethod
     def from_checkpoint(cls, model, directory: str,
@@ -750,11 +844,32 @@ class ServeEngine:
         chunk = np.zeros((1, C), np.int32)
         piece = req.prompt[start:start + C]
         chunk[0, :piece.size] = piece
-        k, v, tok, lp = self._prefill(
-            self._decode_params, self.pool.k, self.pool.v,
-            jnp.asarray(self._table_for(s)), jnp.asarray(chunk),
-            jnp.int32(start), jnp.int32(req.prompt.size),
-            jnp.float32(req.temperature), jnp.int32(req.seed))
+        if self.pool.tiers > 1:
+            # This chunk's target blocks must be hot (the scatter
+            # addresses hot slots); earlier chunks' pages may have
+            # gone cold under hot pressure and are read through the
+            # dequant — which is exactly how a prompt larger than the
+            # hot tier prefills at all.
+            lastpos = min(start + C, int(req.prompt.size)) - 1
+            targets = s.blocks[start // self.block_size:
+                               lastpos // self.block_size + 1]
+            self.pool.ensure_device(s.blocks)
+            self.pool.ensure_hot(targets, keep=s.blocks)
+            ht, ct = self.pool.slot_tables(s.blocks,
+                                           self.blocks_per_seq)
+            k, v, tok, lp = self._tiered_prefill(
+                self._decode_params, self.pool.k, self.pool.v,
+                self.pool.cold_k, self.pool.cold_v,
+                self.pool.cold_sk, self.pool.cold_sv,
+                jnp.asarray(ht), jnp.asarray(ct), jnp.asarray(chunk),
+                jnp.int32(start), jnp.int32(req.prompt.size),
+                jnp.float32(req.temperature), jnp.int32(req.seed))
+        else:
+            k, v, tok, lp = self._prefill(
+                self._decode_params, self.pool.k, self.pool.v,
+                jnp.asarray(self._table_for(s)), jnp.asarray(chunk),
+                jnp.int32(start), jnp.int32(req.prompt.size),
+                jnp.float32(req.temperature), jnp.int32(req.seed))
         self.pool.commit(k, v)
         s.prefill_done = min(start + C, int(req.prompt.size))
         s.length = s.prefill_done
@@ -782,6 +897,12 @@ class ServeEngine:
         # CoW copy a prefix hit made) — never poison a block a prefix
         # cache shares with innocent requests.
         blk = s.blocks[-1]
+        if self.pool.tiers > 1:
+            # Poison the HOT copy; a later demote carries the NaN into
+            # the cold page (NaN survives both cold codecs), so the
+            # drill holds wherever the page ends up.
+            self.pool.ensure_hot([blk])
+            blk = self.pool.hot_slot(blk)
         self.pool.v = self.pool.v.at[:, blk].set(jnp.nan)
 
     def _run_decode_step(self, dslots: list[int]) -> None:
@@ -791,19 +912,50 @@ class ServeEngine:
         last = np.zeros(S, np.int32)
         temps = np.zeros(S, np.float32)
         seeds = np.zeros(S, np.int32)
+        tiered = self.pool.tiers > 1
+        if tiered:
+            # Residency before tables: poison first (its promote may
+            # shuffle tiers), then the whole read set on device, then
+            # every slot's write-frontier block hot — one batched call
+            # so no frontier evicts another.
+            self._maybe_poison(dslots)
+            allblocks, frontiers = [], []
+            for i in dslots:
+                self.sched.ensure_block(i)
+                s = self.sched.slots[i]
+                allblocks.extend(s.blocks)
+                frontiers.append(s.blocks[s.length // self.block_size])
+            self.pool.ensure_device(allblocks)
+            self.pool.ensure_hot(frontiers, keep=allblocks)
+            cold_tables = np.zeros((S, BPS), np.int32)
         for i in dslots:
-            self.sched.ensure_block(i)
+            if not tiered:
+                self.sched.ensure_block(i)
             s = self.sched.slots[i]
-            tables[i] = self._table_for(s)
+            if tiered:
+                tables[i], cold_tables[i] = self.pool.slot_tables(
+                    s.blocks, BPS)
+            else:
+                tables[i] = self._table_for(s)
             lengths[i] = s.length
             last[i] = s.pending_token
             temps[i] = s.request.temperature
             seeds[i] = s.request.seed
-        self._maybe_poison(dslots)
-        k, v, toks, lps, bad = self._decode(
-            self._decode_params, self.pool.k, self.pool.v,
-            jnp.asarray(tables), jnp.asarray(lengths),
-            jnp.asarray(last), jnp.asarray(temps), jnp.asarray(seeds))
+        if tiered:
+            k, v, toks, lps, bad = self._tiered_decode(
+                self._decode_params, self.pool.k, self.pool.v,
+                self.pool.cold_k, self.pool.cold_v,
+                self.pool.cold_sk, self.pool.cold_sv,
+                jnp.asarray(tables), jnp.asarray(cold_tables),
+                jnp.asarray(lengths), jnp.asarray(last),
+                jnp.asarray(temps), jnp.asarray(seeds))
+        else:
+            self._maybe_poison(dslots)
+            k, v, toks, lps, bad = self._decode(
+                self._decode_params, self.pool.k, self.pool.v,
+                jnp.asarray(tables), jnp.asarray(lengths),
+                jnp.asarray(last), jnp.asarray(temps),
+                jnp.asarray(seeds))
         self.pool.commit(k, v)
         toks, lps, bad = np.asarray(toks), np.asarray(lps), np.asarray(bad)
         for i in dslots:
@@ -855,16 +1007,37 @@ class ServeEngine:
         temps = np.zeros(S, np.float32)
         seeds = np.zeros(S, np.int32)
         remaining = np.zeros(S, np.int32)
+        tiered = self.pool.tiers > 1
+        if tiered:
+            self._maybe_poison(dslots)
         for i in dslots:
             self.sched.ensure_blocks(i, W)
+        if tiered:
+            # The whole write WINDOW must be hot (the W columns
+            # scatter with tables fixed for the window); older pages
+            # may sit cold and are read through the dequant.
+            allblocks, hotset = [], []
+            for i in dslots:
+                s = self.sched.slots[i]
+                allblocks.extend(s.blocks)
+                hotset.extend(s.blocks[s.length // self.block_size:])
+            self.pool.ensure_device(allblocks)
+            self.pool.ensure_hot(hotset, keep=allblocks)
+        cold_tables = np.zeros((S, BPS), np.int32)
+        for i in dslots:
             s = self.sched.slots[i]
-            tables[i] = self._table_for(s)
+            if tiered:
+                tables[i], cold_tables[i] = self.pool.slot_tables(
+                    s.blocks, BPS)
+            else:
+                tables[i] = self._table_for(s)
             lengths[i] = s.length
             last[i] = s.pending_token
             temps[i] = s.request.temperature
             seeds[i] = s.request.seed
             remaining[i] = s.request.max_new_tokens - s.generated
-        self._maybe_poison(dslots)
+        if not tiered:
+            self._maybe_poison(dslots)
         active = np.arange(W)[:, None] < remaining[None, :]  # (W, S)
         # Fast-path test per column: every LIVE slot still in budget
         # (idle rows are never active — judging them would force the
@@ -872,6 +1045,7 @@ class ServeEngine:
         # they just advance harmlessly into the null block).
         full = active[:, dslots].all(axis=1)                 # (W,)
         d_tables = jnp.asarray(tables)
+        d_cold = jnp.asarray(cold_tables)
         d_lengths = jnp.asarray(lengths)
         d_last = jnp.asarray(last)
         d_temps = jnp.asarray(temps)
@@ -897,14 +1071,23 @@ class ServeEngine:
                     # row, length/last 0).
                     act = jnp.asarray(active[c])
                     d_tables = jnp.where(act[:, None], d_tables, 0)
+                    d_cold = jnp.where(act[:, None], d_cold, 0)
                     d_lengths = jnp.where(act, d_lengths + 1, 0)
                     d_last = jnp.where(act, cols[-1][0], 0)
             # Thread the pool buffers column to column locally — each
             # dispatch consumes (donates) the previous column's output
             # buffers directly; one commit per window, not per column.
-            pk, pv, toks, lps, bad = self._decode(
-                self._decode_params, pk, pv,
-                d_tables, d_lengths, d_last, d_temps, d_seeds)
+            if tiered:
+                pk, pv, toks, lps, bad = self._tiered_decode(
+                    self._decode_params, pk, pv,
+                    self.pool.cold_k, self.pool.cold_v,
+                    self.pool.cold_sk, self.pool.cold_sv,
+                    d_tables, d_cold, d_lengths, d_last, d_temps,
+                    d_seeds)
+            else:
+                pk, pv, toks, lps, bad = self._decode(
+                    self._decode_params, pk, pv,
+                    d_tables, d_lengths, d_last, d_temps, d_seeds)
             cols.append((toks, lps, bad))
         self.pool.commit(pk, pv)
         toks = np.stack([np.asarray(t) for t, _, _ in cols])  # (W', S)
@@ -951,16 +1134,38 @@ class ServeEngine:
         temps = np.zeros(S, np.float32)
         seeds = np.zeros(S, np.int32)
         limits = np.zeros(S, np.int32)
+        tiered = self.pool.tiers > 1
+        if tiered:
+            self._maybe_poison(dslots)
         for i in dslots:
             self.sched.ensure_blocks(i, self.spec_k + 1)
+        if tiered:
+            # All-hot translation: the fused draft+verify program
+            # addresses ONE buffer, so every block it touches promotes
+            # first and the table carries HOT SLOT ids (hot_slot) in
+            # place of logical ids — the program itself is the
+            # untouched round-17 one, which is the exactness argument.
+            # The cost: a sequence's whole table must fit hot during
+            # its spec step (ensure_hot raises otherwise) — spec decode
+            # does not stream cold pages; the chain schedule does.
+            allb = []
+            for i in dslots:
+                allb.extend(self.sched.slots[i].blocks)
+            self.pool.ensure_hot(allb)
+        for i in dslots:
             s = self.sched.slots[i]
-            tables[i] = self._table_for(s)
+            if tiered:
+                row = [self.pool.hot_slot(b) for b in s.blocks]
+                tables[i, :len(row)] = row
+            else:
+                tables[i] = self._table_for(s)
             lengths[i] = s.length
             last[i] = s.pending_token
             temps[i] = s.request.temperature
             seeds[i] = s.request.seed
             limits[i] = len(s.request.prompt) + s.request.max_new_tokens
-        self._maybe_poison(dslots)
+        if not tiered:
+            self._maybe_poison(dslots)
         k, v, drafted, toks, lps, bad = self._spec(
             self._decode_params, self._draft_params,
             self.pool.k, self.pool.v,

@@ -113,6 +113,30 @@ def make_shared_prefix_workload(n: int, vocab_size: int, seed: int = 0,
     return specs
 
 
+def make_long_prompt_workload(n: int, vocab_size: int, seed: int = 0,
+                              prompt_len: int = 1024,
+                              max_new: tuple[int, int] = (4, 9),
+                              temperature: float = 0.0
+                              ) -> list[RequestSpec]:
+    """``n`` seeded requests all carrying one FIXED ``prompt_len`` —
+    the long-context axis (DESIGN.md §27). Where :func:`make_workload`
+    varies prompt length to stress the scheduler, this holds it
+    constant and lets the sweep vary it ACROSS cells: prompt length,
+    not arrival rate, is the independent variable, and TTFT-per-
+    prompt-token is the quantity scripts/long_context_sweep.py pins
+    against the fully-HBM-resident oracle."""
+    rng = np.random.default_rng(seed)
+    specs = []
+    for i in range(n):
+        prompt = tuple(int(t) for t in
+                       rng.integers(0, vocab_size, size=prompt_len))
+        specs.append(RequestSpec(
+            prompt=prompt,
+            max_new_tokens=int(rng.integers(*max_new)),
+            temperature=temperature, seed=i))
+    return specs
+
+
 def poisson_arrivals(n: int, rate: float, seed: int = 0) -> np.ndarray:
     """``n`` arrival offsets (seconds from run start) at ``rate``
     requests/second."""

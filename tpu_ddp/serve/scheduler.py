@@ -433,10 +433,19 @@ class Scheduler:
         tail blocks; freeing whole blocks restores
         ``free + Σ allocated == total`` with no new pool invariant.
         Keeps the block holding position ``length`` (the next write
-        target), so a kept block's garbage tail is causally masked."""
+        target), so a kept block's garbage tail is causally masked.
+
+        Tiered pools (§27): the kept frontier block must be PROMOTED
+        before the tail is trimmed. A deep rollback can land the write
+        frontier in a block whose pages were demoted or spilled while
+        the speculative window raced ahead; the next decode step
+        scatters into that block's hot slot, so leaving it cold would
+        silently drop the accepted prefix's most recent tokens."""
         s = self.slots[idx]
         keep = s.length // self.pool.block_size + 1
         if len(s.blocks) > keep:
+            if self.pool.tiers > 1:
+                self.pool.ensure_hot([s.blocks[keep - 1]])
             self.pool.free(s.blocks[keep:])
             del s.blocks[keep:]
 

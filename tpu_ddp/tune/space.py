@@ -346,6 +346,34 @@ KNOBS: tuple[Knob, ...] = (
              "projection, dequant fused into the matmul. Rounds the "
              "served logits (bounded by the sweep's 0.25% NLL drift "
              "bar), so the knob is semantic like publish_wire"),
+    # Long-context serving (serve/long_context.py, serve/kv_pool.py —
+    # DESIGN.md §27): tiered KV residency and context-parallel prefill,
+    # measured by scripts/long_context_sweep.py.
+    Knob("kv_tiers", "kv_tiers", "TPU_DDP_KV_TIERS",
+         values=(1, 2, 3), flag="--kv-tiers",
+         objective="goodput",
+         doc="KV residency tiers (serve/kv_pool.py): 1 = the flat "
+             "single-pool cache, 2 adds an in-HBM cold tier of "
+             "quantized pages behind an LRU hot set, 3 adds host-memory "
+             "spill with demand promotion so HBM bounds the HOT context "
+             "per step, not the TOTAL resident context"),
+    Knob("kv_cold_dtype", "kv_cold_dtype", "TPU_DDP_KV_COLD_DTYPE",
+         values=("int8", "bf16"), flag="--kv-cold-dtype",
+         objective="goodput", semantic=True,
+         doc="storage dtype for cold-tier KV pages "
+             "(parallel/compress.py page codec): 'int8' halves cold "
+             "bytes with per-token-row scales and rounds re-read "
+             "attention (semantic), 'bf16' is a lossless downcast when "
+             "the hot pool is already bf16. Inert at kv_tiers=1 — "
+             "there is no cold tier to store into"),
+    Knob("cp_prefill", "cp_prefill", "TPU_DDP_CP_PREFILL",
+         values=("off", "ring", "ulysses"), flag="--cp-prefill",
+         objective="goodput",
+         doc="context-parallel chunked prefill (serve/long_context.py): "
+             "shard each prefill chunk's query rows over the sp mesh "
+             "axis and run ring or Ulysses attention against the paged "
+             "cache, cutting TTFT on long prompts. Requires an sp>=2 "
+             "mesh and the single-tier pool (engine rejects tiers>1)"),
 )
 
 # Model-level knobs are baked into get_model() before the Trainer ever
@@ -569,6 +597,19 @@ def violations(assignment: Mapping, ctx: Workload) -> list[str]:
             "disaggregated decode tier runs the fused adopt+decode "
             "program only (fleet/disagg.py); speculation is a "
             "single-engine/router feature")
+    # Long-context serving knobs (serve/long_context.py §27).
+    if get("kv_cold_dtype", "int8") != "int8" and get("kv_tiers", 1) == 1:
+        bad.append(
+            f"kv_cold_dtype={get('kv_cold_dtype')!r} with kv_tiers=1 — "
+            "the flat pool has no cold tier, so the cold dtype is "
+            "inert and the cell duplicates the default")
+    if get("cp_prefill", "off") != "off" and get("kv_tiers", 1) > 1:
+        bad.append(
+            f"cp_prefill={get('cp_prefill')!r} with "
+            f"kv_tiers={get('kv_tiers')} — the context-parallel "
+            "prefill program gathers pages by flat slot id and the "
+            "engine rejects the combination (serve/engine.py); tiered "
+            "residency is a decode-side feature")
     return bad
 
 

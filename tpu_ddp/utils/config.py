@@ -275,6 +275,23 @@ class TrainConfig:
     # per-output-channel at engine construction (re-derived on each
     # weight hot-swap). Env: TPU_DDP_DECODE_QUANT.
     decode_quant: str = "none"
+    # Tiered KV pool (tpu_ddp/serve/kv_pool.py, docs/DESIGN.md §27):
+    # 1 = the single-tier pool unchanged; 2 adds an in-HBM quantized
+    # cold tier; 3 adds the host-memory spill tier behind it. Mirrors
+    # PagedKVPool (the source of truth, which re-validates at pool
+    # construction). Env: TPU_DDP_KV_TIERS.
+    kv_tiers: int = 1
+    # Cold-page codec for tiers >= 2: "int8" (per-token-row symmetric
+    # quantization, parallel/compress.py page_quantize) or "bf16"
+    # (plain downcast — lossless when the hot cache dtype is bf16).
+    # Inert at kv_tiers == 1. Env: TPU_DDP_KV_COLD_DTYPE.
+    kv_cold_dtype: str = "int8"
+    # Context-parallel chunked prefill (tpu_ddp/serve/long_context.py):
+    # "off", or shard each prefill chunk over the mesh's sp axis with
+    # "ring" (K/V rotation, cache-seeded online softmax) or "ulysses"
+    # (all-to-all head re-sharding). Needs a serving mesh with sp >= 2.
+    # Env: TPU_DDP_CP_PREFILL.
+    cp_prefill: str = "off"
 
     # Live train->serve weight streaming (tpu_ddp/publish/,
     # docs/DESIGN.md §24). Publish a versioned weight update to
@@ -627,6 +644,25 @@ class TrainConfig:
             raise ValueError(
                 f"decode_quant={self.decode_quant!r}: expected "
                 "none|int8 (TPU_DDP_DECODE_QUANT)")
+        self.kv_tiers = _env_num("TPU_DDP_KV_TIERS", int, self.kv_tiers)
+        if self.kv_tiers not in (1, 2, 3):
+            raise ValueError(
+                f"kv_tiers must be 1, 2 or 3, got {self.kv_tiers} "
+                "(TPU_DDP_KV_TIERS)")
+        env_cd = os.environ.get("TPU_DDP_KV_COLD_DTYPE")
+        if env_cd:
+            self.kv_cold_dtype = env_cd
+        if self.kv_cold_dtype not in ("int8", "bf16"):
+            raise ValueError(
+                f"kv_cold_dtype={self.kv_cold_dtype!r}: expected "
+                "int8|bf16 (TPU_DDP_KV_COLD_DTYPE)")
+        env_cp = os.environ.get("TPU_DDP_CP_PREFILL")
+        if env_cp:
+            self.cp_prefill = env_cp
+        if self.cp_prefill not in ("off", "ring", "ulysses"):
+            raise ValueError(
+                f"cp_prefill={self.cp_prefill!r}: expected "
+                "off|ring|ulysses (TPU_DDP_CP_PREFILL)")
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.
