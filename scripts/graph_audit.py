@@ -66,6 +66,14 @@ def _tiny_lm(**kw):
     return make_transformer("TransformerLM-tiny", **cfg)
 
 
+def _tiny_lm_moe(**kw):
+    import jax.numpy as jnp
+    from tpu_ddp.models.transformer import make_transformer
+    cfg = dict(max_seq_len=64, compute_dtype=jnp.float32)
+    cfg.update(kw)
+    return make_transformer("TransformerLM-moe-tiny", **cfg)
+
+
 def _abstract_state(trainer):
     """eval_shape of init_state where traceable, concrete otherwise
     (FSDP shards through host numpy)."""
@@ -296,6 +304,44 @@ def audit_publish_cells():
     ]
 
 
+def audit_moe_cells():
+    """The §28 MoE surfaces. The routed layer is the one place the repo
+    emits a PAIR of ``all_to_all``s inside a single program (token
+    dispatch to the expert shards and the combine back,
+    tpu_ddp/parallel/moe.py) — exactly the divergent-order deadlock
+    class the lockstep auditor hunts, so the dp x ep train step is
+    fingerprinted here alongside the cached-MoE decode and prefill
+    programs (which carry no collective: decode serves on one device,
+    capacity computed from the live bank size)."""
+    import jax
+
+    from tpu_ddp.parallel.mesh import make_mesh
+    from tpu_ddp.serve.engine import ServeEngine
+    from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+
+    model = _tiny_lm_moe()
+    cells = []
+    if len(jax.devices()) >= 4:
+        mesh = make_mesh(jax.devices()[:4], dp=2, ep=2)
+        trainer = LMTrainer(model, mesh)
+        state = trainer.init_state()
+        import numpy as np
+        toks = np.zeros((4, 33), np.int64)
+        batch = trainer.put_batch(*make_lm_batch(toks))
+        cell = _program_audit(
+            "train/moe-dp2ep2",
+            lambda: trainer.lower_train_step(state, *batch))
+        cell["dp"], cell["ep"] = trainer.dp, trainer.ep
+        cells.append(cell)
+    params = model.init(jax.random.key(0))
+    engine = ServeEngine(model, params, **GEOM)
+    cells.append(_program_audit("serve/moe-decode",
+                                engine.lower_decode_step))
+    cells.append(_program_audit("serve/moe-prefill",
+                                engine.lower_prefill_step))
+    return cells
+
+
 def audit_redistribute_cell():
     """Fingerprint the dp=4 source and dp=2 destination train programs
     around a LIVE redistribute: the two fleets' programs legitimately
@@ -342,6 +388,7 @@ def build_cells(only=None):
     specs.append(("long-context", audit_long_context_cells))
     specs.append(("fleet", audit_fleet_cell))
     specs.append(("publish", audit_publish_cells))
+    specs.append(("moe", audit_moe_cells))
     specs.append(("redistribute", audit_redistribute_cell))
     if only is not None:
         specs = [(n, t) for n, t in specs

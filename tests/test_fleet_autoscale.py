@@ -340,15 +340,17 @@ def test_scale_up_boots_current_version_no_new_compiles(model, params):
 
 
 def test_graph_audit_n_programs_pinned():
-    """Long-context serving added exactly FIVE jit surfaces
-    (tiered-decode, tiered-prefill, the demote/promote page-movement
-    pair, cp-prefill-ring; the ulysses mode shares the cp program
-    shape and chain speculation still adds none): 23 -> 28 programs."""
+    """MoE added exactly THREE jit surfaces (the dp x ep train step —
+    the one program with the paired expert all_to_alls — and the
+    cached-MoE decode/prefill twins; the sparse publish wire adds none,
+    EdgeCodec is host-side): 28 -> 31 programs. Long-context's five
+    (tiered-decode/prefill, demote/promote, cp-prefill-ring) before
+    that: 23 -> 28."""
     art = pathlib.Path(__file__).resolve().parents[1] / \
         "experiments" / "graph_audit.json"
     audit = json.loads(art.read_text())
-    assert audit["n_programs"] == 28
-    assert len(audit["cells"]) == 28
+    assert audit["n_programs"] == 31
+    assert len(audit["cells"]) == 31
 
 
 # ---------------------------------------------------------------------------

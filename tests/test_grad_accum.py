@@ -67,6 +67,9 @@ class TestGradAccum:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-5, atol=1e-6)
 
+    @pytest.mark.slow  # accum x sp adds only layout on the loop the
+    # plain parity above pins fast (accum=2); fsdp composition stays
+    # fast as the one sharded representative.
     def test_matches_under_sp(self, devices):
         p1, l1 = _step(devices, 1, dp=2, sp=2)
         pa, la = _step(devices, 2, dp=2, sp=2)

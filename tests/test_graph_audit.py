@@ -68,10 +68,15 @@ def test_committed_artifact_is_clean_and_complete():
                    "train/fused+overlap", "mpmd/stage0-fwd",
                    "serve/decode", "serve/prefill",
                    "fleet/adopt-decode", "redistribute/src-dp4",
-                   "redistribute/dst-dp2"):
+                   "redistribute/dst-dp2", "train/moe-dp2ep2",
+                   "serve/moe-decode", "serve/moe-prefill"):
         assert needle in programs, needle
     # Fingerprints are recorded (the lockstep baseline a future run
     # can diff against), and the dp rungs actually collect.
     cells = {c["program"]: c for c in art["cells"]}
     assert cells["train/fused"]["n_collectives"] > 0
+    # The MoE train step is the one program with the paired expert
+    # all_to_alls — it must actually collect (deadlock class needs a
+    # fingerprint to lockstep-check against).
+    assert cells["train/moe-dp2ep2"]["n_collectives"] > 0
     assert all("fingerprint" in c for c in art["cells"])

@@ -86,6 +86,44 @@ def test_memory_policy_knobs_registered():
     assert set(act.values) == {"compute", "bf16", "f32"}
 
 
+def test_moe_knobs_registered():
+    # The three MoE knobs (tpu_ddp/parallel/moe.py) carry the full
+    # 4-surface contract. All are semantic — each changes WHAT the
+    # model computes (a different architecture / routing distribution),
+    # so the default step_time search never wanders into them — and all
+    # stay under objective="step_time" so the goodput sweeps' exact
+    # field sets below are untouched.
+    from tpu_ddp.tune.space import Workload, violations
+
+    e = knob_by_field("moe_experts")
+    k = knob_by_field("moe_top_k")
+    c = knob_by_field("moe_capacity")
+    assert e is not None and k is not None and c is not None
+    assert e.env == "TPU_DDP_MOE_EXPERTS" and e.flag == "--moe-experts"
+    assert k.env == "TPU_DDP_MOE_TOP_K" and k.flag == "--moe-top-k"
+    assert c.env == "TPU_DDP_MOE_CAPACITY" and c.flag == "--moe-capacity"
+    for knob in (e, k, c):
+        assert knob.semantic and knob.objective == "step_time", knob.name
+    # Candidate sets include the dense defaults (the audit's
+    # keep-the-default rule) and the shipped presets' settings.
+    assert 0 in e.values and 1 in k.values and 1.25 in c.values
+    # Engine-mirrored violations: an ep mesh needs a MoE model whose
+    # expert count it divides; top_k beyond E is a topk_route reject;
+    # the routing knobs are inert duplicates of the dense default
+    # without experts.
+    ep2 = Workload(platform="cpu", ep=2)
+    assert violations({"moe_experts": 0}, ep2)
+    assert violations({"moe_experts": 5}, ep2)
+    assert violations({"moe_experts": 6}, ep2) == []
+    assert violations({"moe_experts": 4, "moe_top_k": 8},
+                      Workload(platform="cpu"))
+    assert violations({"moe_top_k": 2}, Workload(platform="cpu"))
+    assert violations({"moe_capacity": 2.0}, Workload(platform="cpu"))
+    assert violations({"moe_experts": 4, "moe_top_k": 2,
+                       "moe_capacity": 2.0},
+                      Workload(platform="cpu")) == []
+
+
 def test_serve_knobs_registered_under_goodput_objective():
     # The serving knobs (tpu_ddp/serve/) carry the same 4-surface
     # contract minus the launch flag (serving is not a launch.py

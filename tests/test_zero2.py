@@ -84,6 +84,8 @@ class TestZeRO2:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        rtol=2e-5, atol=1e-6)
 
+    @pytest.mark.slow  # zero2 x sp adds only layout on the gather the
+    # replicated/zero1 parity above pins fast.
     def test_composes_with_sp(self, devices):
         """dp2 x sp2: the non-dp sync applies elementwise to slices."""
         _, p_ref, l_ref = _run(devices, "replicated", dp=2, sp=2)

@@ -731,11 +731,13 @@ def main(argv=None) -> int:
                         "trainer steps (0 = off). Sets "
                         "TPU_DDP_PUBLISH_EVERY for every rank")
     p.add_argument("--publish-wire", default=None,
-                   choices=("none", "bf16", "int8"),
+                   choices=("none", "bf16", "int8", "sparse"),
                    help="wire format for pushed weight deltas "
-                        "(tpu_ddp/publish/): dense f32, bf16, or "
-                        "error-feedback int8. Sets TPU_DDP_PUBLISH_WIRE "
-                        "for every rank")
+                        "(tpu_ddp/publish/): dense f32, bf16, "
+                        "error-feedback int8, or lossless sparse "
+                        "(zero-chunk elision — the MoE expert-delta "
+                        "wire). Sets TPU_DDP_PUBLISH_WIRE for every "
+                        "rank")
     p.add_argument("--publish-max-staleness", type=int, default=None,
                    help="steps the trainer may run ahead of the "
                         "slowest subscriber before publishing blocks "
@@ -777,6 +779,19 @@ def main(argv=None) -> int:
                         "(tpu_ddp/serve/long_context.py): shard each "
                         "prefill chunk over the serving mesh's sp "
                         "axis. Sets TPU_DDP_CP_PREFILL for every rank")
+    p.add_argument("--moe-experts", type=int, default=None,
+                   help="experts per MoE MLP layer (0 = dense; "
+                        "tpu_ddp/parallel/moe.py). Sets "
+                        "TPU_DDP_MOE_EXPERTS for every rank")
+    p.add_argument("--moe-top-k", type=int, default=None,
+                   help="routed experts per token (1 = Switch, 2 = "
+                        "GShard). Sets TPU_DDP_MOE_TOP_K for every "
+                        "rank")
+    p.add_argument("--moe-capacity", type=float, default=None,
+                   help="expert capacity factor: slots per expert = "
+                        "ceil(T * capacity * top_k / E); higher = "
+                        "fewer dropped tokens, more padded compute. "
+                        "Sets TPU_DDP_MOE_CAPACITY for every rank")
     p.add_argument("--autotune", default=None,
                    choices=("off", "cached", "search"),
                    help="perf-knob autotuning (tpu_ddp/tune/): 'cached' "
@@ -895,6 +910,20 @@ def main(argv=None) -> int:
         env["TPU_DDP_KV_COLD_DTYPE"] = args.kv_cold_dtype
     if args.cp_prefill is not None:
         env["TPU_DDP_CP_PREFILL"] = args.cp_prefill
+    if args.moe_experts is not None:
+        if args.moe_experts < 0:
+            p.error(f"--moe-experts must be >= 0, got "
+                    f"{args.moe_experts}")
+        env["TPU_DDP_MOE_EXPERTS"] = str(args.moe_experts)
+    if args.moe_top_k is not None:
+        if args.moe_top_k < 1:
+            p.error(f"--moe-top-k must be >= 1, got {args.moe_top_k}")
+        env["TPU_DDP_MOE_TOP_K"] = str(args.moe_top_k)
+    if args.moe_capacity is not None:
+        if not args.moe_capacity > 0:
+            p.error(f"--moe-capacity must be > 0, got "
+                    f"{args.moe_capacity}")
+        env["TPU_DDP_MOE_CAPACITY"] = str(args.moe_capacity)
     if args.autotune is not None:
         env["TPU_DDP_AUTOTUNE"] = args.autotune
     if args.audit is not None:

@@ -41,19 +41,36 @@ def check_decodable(model) -> None:
             "dense model — dense_params_from_checkpoint(model, ckpt_dir)"
             " (tpu_ddp/models/decode.py) does exactly that via the "
             "canonical checkpoint path")
-    if model.moe_experts:
-        # Incremental decode cannot reproduce training-time MoE routing:
-        # capacity competition is over ALL positions in apply() but only
-        # over the new tokens per decode step, so the distributions
-        # diverge. Refusing keeps the exactness guarantee honest.
-        raise ValueError("decode does not support MoE models: "
-                         "per-step expert capacity cannot match "
-                         "apply()'s whole-sequence slot competition")
 
 
 def mlp(model, blk, y):
+    """Block MLP on a decode/prefill activation bank ``y`` (B, L, dm).
+
+    Dense models run the two qdot matmuls (fp or fused int8). MoE
+    models run the routed layer (tpu_ddp/parallel/moe.py) with the
+    expert axis UNSHARDED — serving params are dense — and capacity
+    computed by ``moe_mlp`` from the LIVE bank size T = B*L (the slot
+    bank for a decode step, the chunk for prefill), not the training
+    batch. Routing is per-token, so with capacity admitting every
+    token (the serve engine sizes ``moe_capacity_factor`` so the E
+    queues cover the bank; tests pin greedy-stream parity vs ``apply``)
+    each token's output is independent of its batch neighbors — the
+    property that makes incremental decode match the whole-sequence
+    forward despite capacity competition happening per step here and
+    per sequence there. At tight capacity the two CAN diverge (tokens
+    drop in one composition and not the other); that trade is the
+    operator's, surfaced as the dropped-token counter, never silent.
+    """
     from tpu_ddp.ops.quant import qdot
     cd = model.compute_dtype
+    if model.moe_experts:
+        from tpu_ddp.parallel.moe import moe_mlp
+        out, _ = moe_mlp(
+            y, blk["router"], blk["w1"], blk["w2"],
+            num_experts=model.moe_experts,
+            capacity_factor=model.moe_capacity_factor,
+            top_k=model.moe_top_k, ep_size=1)
+        return out.astype(cd)
     y = qdot(y, blk["w1"], cd)
     y = jax.nn.gelu(y.astype(jnp.float32)).astype(cd)
     return qdot(y, blk["w2"], cd).astype(cd)

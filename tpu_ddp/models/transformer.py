@@ -345,7 +345,7 @@ class TransformerLM:
         logits = qdot(x, params["head"], self.compute_dtype)
         return logits.astype(jnp.float32)
 
-    def trunk_with_aux(self, params, tokens, rng=None):
+    def trunk_with_aux(self, params, tokens, rng=None, stats=None):
         """Everything but the vocabulary projection: embed -> blocks ->
         final LayerNorm, returning ((B, L, dm) activations, aux). The
         split exists so the LM loss can fuse the head matmul into a
@@ -355,7 +355,10 @@ class TransformerLM:
         :meth:`apply_with_aux` wrap it, so validation lives here once.
 
         ``rng``: dropout key (pre-decorrelated across data shards by the
-        trainer); None disables dropout."""
+        trainer); None disables dropout. ``stats``: optional mutable
+        list collecting each MoE block's routing-health dict
+        (tpu_ddp/parallel/moe.py routing_stats) — forces the direct
+        block path (no remat), so pass it only on diagnostic runs."""
         cd = self.compute_dtype
         lc = tokens.shape[1]
         self.check_seq_len(lc)
@@ -366,8 +369,10 @@ class TransformerLM:
         aux = jnp.float32(0.0)
         from tpu_ddp.memory import cast_saved, effective_remat, wrap_stage
         remat = effective_remat(self.remat_policy, "attn")
-        if remat == "none" and self.act_dtype == "compute":
-            blk_fn = self.block_apply_aux
+        if stats is not None or (remat == "none"
+                                 and self.act_dtype == "compute"):
+            def blk_fn(blk, x, pos, r):
+                return self.block_apply_aux(blk, x, pos, r, stats=stats)
         else:
             # _block_entry re-enters compute_dtype, so the boundary
             # cast below only changes what autodiff SAVES.
@@ -429,7 +434,7 @@ class TransformerLM:
         return self.block_apply_aux(blk, x.astype(self.compute_dtype),
                                     pos, rng)
 
-    def block_apply_aux(self, blk, x, pos, rng=None):
+    def block_apply_aux(self, blk, x, pos, rng=None, stats=None):
         cd = self.compute_dtype
         b, lc = x.shape[0], x.shape[1]
         h_loc, hd = self.num_heads // self._tp, self.head_dim
@@ -464,7 +469,7 @@ class TransformerLM:
                 capacity_factor=self.moe_capacity_factor,
                 top_k=self.moe_top_k,
                 ep_axis=self.ep_axis or "ep", ep_size=self._ep,
-                tp_in=self._tp_in, tp_out=self._tp_out)
+                tp_in=self._tp_in, tp_out=self._tp_out, stats=stats)
             return x + self._dropout(y, r2), aux
         # Column-parallel up-projection (local d_ff slice) ...
         y = jnp.dot(self._tp_in(y), blk["w1"].astype(cd),
@@ -475,6 +480,22 @@ class TransformerLM:
             y, blk["w2"].astype(cd),
             preferred_element_type=jnp.float32)).astype(cd)
         return x + self._dropout(y, r2), jnp.float32(0.0)
+
+    def route_stats(self, params, tokens):
+        """Diagnostic routing-health probe: one deterministic trunk
+        pass (no dropout) collecting each MoE block's routing counters
+        — list of dicts with ``dropped_frac``, ``expert_load`` (E,),
+        and ``imbalance`` (tpu_ddp/parallel/moe.py routing_stats), one
+        per layer, [] for a dense model. Routing is per-token and
+        partition-independent, so callers holding sharded training
+        params strip the partition axes and run this on the canonical
+        tree (tpu_ddp/train/lm.py LMTrainer.route_stats does exactly
+        that)."""
+        if not self.moe_experts:
+            return []
+        stats: list = []
+        self.trunk_with_aux(params, tokens, rng=None, stats=stats)
+        return stats
 
     def head_apply(self, params, x):
         """Final LayerNorm + LM head: (B, L, dm) -> (B, L, V) float32."""
@@ -560,12 +581,31 @@ def make_transformer(name: str = "TransformerLM-small",
                                         d_model=512, d_ff=2048,
                                         vocab_size=32000,
                                         max_seq_len=32768),
+        # MoE zoo family (DESIGN.md §28): Switch (top-1) at the small
+        # end, GShard (top-2) at scale. d_ff is the PER-EXPERT hidden
+        # width, so param count grows ~linearly in moe_experts while
+        # per-token FLOPs track top_k — the capability-per-FLOP trade
+        # the family exists to buy (experiments/moe_sweep.json).
         "TransformerLM-moe-tiny": dict(num_layers=2, num_heads=4,
                                        d_model=128, d_ff=256,
-                                       vocab_size=1024, moe_experts=4),
+                                       vocab_size=1024, moe_experts=4,
+                                       moe_top_k=1,
+                                       moe_capacity_factor=1.25),
         "TransformerLM-moe-small": dict(num_layers=4, num_heads=8,
                                         d_model=512, d_ff=1024,
-                                        vocab_size=32000, moe_experts=8),
+                                        vocab_size=32000, moe_experts=8,
+                                        moe_top_k=2,
+                                        moe_capacity_factor=1.25),
+        # LM-large's sparse sibling: same trunk geometry, 16 experts of
+        # half the dense d_ff — ~4.3x the dense family's MLP params at
+        # top-2 per-token compute close to dense (cap algebra in
+        # DESIGN.md §28); remat="blocks" like its dense twin.
+        "TransformerLM-moe-large": dict(num_layers=12, num_heads=16,
+                                        d_model=2048, d_ff=4096,
+                                        vocab_size=32000,
+                                        moe_experts=16, moe_top_k=2,
+                                        moe_capacity_factor=1.25,
+                                        remat="blocks"),
     }
     if name not in presets:
         raise ValueError(f"unknown transformer preset {name!r}; "

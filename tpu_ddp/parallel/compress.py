@@ -14,6 +14,13 @@ any rung with a reduced wire format while keeping fp32 accumulation:
   generalized to 8 bits). ~4x the wire bytes back.
 - ``int8-noef`` — int8 without the residual (ablation: shows the drift
   error feedback removes; tests/test_compress.py pins it).
+- ``sparse`` — LOSSLESS zero-chunk elision (EdgeCodec only): the flat
+  payload is cut into ``block_size``-element chunks, a packed bitmap
+  marks the nonzero ones, and only those travel at fp32. Exact (no
+  error feedback to carry), and the natural wire for MoE expert
+  deltas, where one optimizer step touches only the routed-to experts
+  and every untouched expert row is an all-zero delta chunk
+  (tpu_ddp/publish/, experiments/moe_sweep.json).
 
 Wire scheme. A compressed all-reduce is built from dtype-PRESERVING
 movement collectives instead of an arithmetic ``psum``:
@@ -71,6 +78,12 @@ import numpy as np
 from jax import lax
 
 SPECS = ("none", "bf16", "int8", "int8-noef")
+
+# Point-to-point-only wires (EdgeCodec / the publish delta push). The
+# collective compressor cannot ship "sparse": its all_to_all phases
+# need static per-device payload shapes, while the sparse wire's whole
+# point is a data-dependent payload size — fine on a host-loop edge.
+EDGE_SPECS = SPECS + ("sparse",)
 
 # Replicated rungs the compressor can wrap (kind -> collective shape);
 # the ZeRO/FSDP rungs use scatter_mean instead.
@@ -453,10 +466,10 @@ class EdgeCodec:
 
     def __init__(self, spec: str = "none", block_size: int = 256,
                  seed: int = 0):
-        if spec not in SPECS:
+        if spec not in EDGE_SPECS:
             raise ValueError(
                 f"unknown edge codec spec {spec!r}; available: "
-                f"{list(SPECS)}")
+                f"{list(EDGE_SPECS)}")
         self.spec = spec
         self.is_int8 = spec.startswith("int8")
         self.error_feedback = spec == "int8"
@@ -500,10 +513,33 @@ class EdgeCodec:
             wire = {"kind": "bf16",
                     "payload": GradCompressor._to_wire_bf16(x)}
             nbytes = 2 * x.size
+        elif self.spec == "sparse":
+            wire, nbytes = self._encode_sparse(x)
         else:
             wire, nbytes = self._encode_int8(x)
         self.bytes_sent += nbytes
         return wire, nbytes
+
+    def _encode_sparse(self, x) -> tuple[dict, int]:
+        """Lossless zero-chunk elision: chunk the flat fp32 payload at
+        ``block_size``, packbits which chunks hold any nonzero, ship
+        only those. A host-side codec (the sparsity pattern sizes the
+        payload — exactly what a compiled collective cannot do), which
+        is where EdgeCodec already lives. Worst case (nothing zero)
+        costs the dense bytes + the ~size/8B bitmap; best case (an MoE
+        delta touching few experts) drops whole untouched expert rows.
+        """
+        b = self.block_size
+        flat = np.asarray(x, np.float32).reshape(-1)
+        n = max(1, -(-flat.size // b))
+        padded = np.zeros((n * b,), np.float32)
+        padded[:flat.size] = flat
+        rows = padded.reshape(n, b)
+        nz = np.any(rows != 0.0, axis=1)                    # (n,) bool
+        wire = {"kind": "sparse", "payload": jnp.asarray(rows[nz]),
+                "mask": np.packbits(nz), "chunks": n, "chunk": b,
+                "shape": tuple(np.shape(x))}
+        return wire, 4 * int(nz.sum()) * b + int(np.packbits(nz).size)
 
     def _encode_int8(self, x) -> tuple[dict, int]:
         flat = x.reshape(-1)
@@ -540,6 +576,17 @@ class EdgeCodec:
                                // wire["scale"].size)
             flat = k._dequant(wire["q"], wire["scale"])[:size]
             return flat.reshape(shape)
+        if kind == "sparse":
+            n, b = int(wire["chunks"]), int(wire["chunk"])
+            nz = np.unpackbits(np.asarray(wire["mask"]),
+                               count=n).astype(bool)
+            rows = np.zeros((n, b), np.float32)
+            if nz.any():
+                rows[nz] = np.asarray(wire["payload"],
+                                      np.float32).reshape(-1, b)
+            shape = wire["shape"]
+            size = int(np.prod(shape)) if shape else 1
+            return jnp.asarray(rows.reshape(-1)[:size].reshape(shape))
         raise ValueError(f"unknown edge wire kind {kind!r}")
 
 

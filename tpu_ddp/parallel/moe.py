@@ -104,17 +104,41 @@ def switch_route(router_logits, num_experts: int, capacity: int):
     return topk_route(router_logits, num_experts, capacity, top_k=1)
 
 
+def routing_stats(dispatch, top_k: int = 1):
+    """Routing-health counters from a (T, E, C) dispatch tensor.
+
+    ``dropped_frac``: fraction of the T*top_k routing assignments that
+    found no capacity slot (those branches contribute zero; the token
+    rides the residual stream). ``expert_load``: (E,) fraction of all
+    assignments each expert kept — sums to ``1 - dropped_frac``.
+    ``imbalance``: the hottest expert's load relative to the uniform
+    share (1.0 = perfectly balanced; ``E`` = total collapse onto one
+    expert). All float32, cheap enough to ride along every step.
+    """
+    t, e = dispatch.shape[0], dispatch.shape[1]
+    per_expert = jnp.sum(dispatch, axis=(0, 2))             # (E,) kept
+    total = jnp.float32(t * max(top_k, 1))
+    load = per_expert / total
+    return {"dropped_frac": 1.0 - jnp.sum(load),
+            "expert_load": load,
+            "imbalance": jnp.max(load) * e}
+
+
 def moe_mlp(y, router_w, w1, w2, *, num_experts: int,
             capacity_factor: float = 1.25, top_k: int = 1,
             ep_axis: str = EXPERT_AXIS,
             ep_size: int = 1, activation=None,
-            tp_in=None, tp_out=None):
+            tp_in=None, tp_out=None, stats=None):
     """Top-k routed MoE MLP: (B, L, dm) -> ((B, L, dm), aux).
 
     ``w1``: (E_local, dm, dff_local), ``w2``: (E_local, dff_local, dm) —
     stacked expert weights, already sharded over ``ep`` (and optionally
     ``mp`` via the ``tp_in``/``tp_out`` Megatron hooks). Must run inside
     a shard_map over ``ep_axis`` when ``ep_size > 1``.
+
+    ``stats``: optional mutable list; when given, this call appends its
+    :func:`routing_stats` dict (per-shard numbers under ep — diagnostic
+    callers run the dense configuration).
     """
     b, L, dm = y.shape
     T = b * L
@@ -132,6 +156,8 @@ def moe_mlp(y, router_w, w1, w2, *, num_experts: int,
     logits = jnp.dot(x, router_w.astype(cd),
                      preferred_element_type=jnp.float32)    # (T, E)
     dispatch, combine, aux = topk_route(logits, E, cap, top_k=top_k)
+    if stats is not None:
+        stats.append(routing_stats(dispatch, top_k=top_k))
 
     # (T, E, C) x (T, dm) -> (E, C, dm): gather each expert's slot queue.
     expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cd), x,

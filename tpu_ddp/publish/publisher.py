@@ -9,7 +9,7 @@ reconstruction, chunks the delta along :class:`BucketPlan` bucket
 boundaries (parallel/overlap.py — the same size-targeted partition the
 in-backward gradient sync uses), and compresses each bucket with the
 :class:`EdgeCodec` wire formats (parallel/compress.py, ``none`` /
-``bf16`` / ``int8``).
+``bf16`` / ``int8`` / lossless ``sparse``).
 
 Two invariants make lossy wires safe along a trajectory:
 
@@ -55,7 +55,11 @@ from tpu_ddp.parallel.compress import EdgeCodec
 from tpu_ddp.parallel.overlap import BucketPlan
 from tpu_ddp.publish.store import tree_digests
 
-PUBLISH_WIRES = ("none", "bf16", "int8")
+# "sparse" is the lossless zero-chunk-elision wire (EDGE_SPECS in
+# parallel/compress.py) — the natural fit for MoE expert deltas, where
+# a step touches only the routed-to experts and untouched expert rows
+# diff to all-zero chunks (experiments/moe_sweep.json measures it).
+PUBLISH_WIRES = ("none", "bf16", "int8", "sparse")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -121,7 +125,7 @@ class Publisher:
             raise ValueError("publish_every must be >= 0")
         if self.wire not in PUBLISH_WIRES:
             raise ValueError(f"publish_wire={self.wire!r}: expected "
-                             "none|bf16|int8")
+                             "none|bf16|int8|sparse")
         if self.max_staleness_steps < 0:
             raise ValueError("max_staleness_steps must be >= 0")
         self.bucket_mb = bucket_mb

@@ -400,6 +400,17 @@ class ServeEngine:
             raise ValueError(
                 f"decode_quant={self.decode_quant!r}: expected 'none'"
                 " or 'int8' (TPU_DDP_DECODE_QUANT)")
+        if model.moe_experts and (self.decode_quant == "int8"
+                                  or kind == "quant"):
+            # The routed MoE layer contracts stacked expert weights in
+            # raw einsums (tpu_ddp/parallel/moe.py), not qdot — int8
+            # QuantizedWeight leaves would not trace. Refuse loudly
+            # rather than serve a silently-dequantized tree.
+            raise ValueError(
+                "decode_quant='int8' (and the 'quant' draft family) "
+                "do not support MoE models yet: the routed expert "
+                "einsums bypass ops/quant.qdot; serve MoE with "
+                "decode_quant='none'")
         self._refresh_quant()
         self._spec = None
         if self.spec_k > 0 and kind != "chain":

@@ -70,6 +70,20 @@ def make_lm_batch(tokens: np.ndarray):
     return tokens[:, :-1], tokens[:, 1:]
 
 
+def format_route_stats(stats) -> str:
+    """One metrics-line fragment from :meth:`LMTrainer.route_stats`
+    output — ``" moe dropped=2.1%/0.0% imbalance=1.31/1.05"``, one slot
+    per routed layer — so training loops and bench probes print the
+    routing-health counters the same way. Empty string for dense models
+    (empty stats), so call sites append it unconditionally."""
+    if not stats:
+        return ""
+    drop = "/".join(f"{float(s['dropped_frac']) * 100:.1f}%"
+                    for s in stats)
+    imb = "/".join(f"{float(s['imbalance']):.2f}" for s in stats)
+    return f" moe dropped={drop} imbalance={imb}"
+
+
 def _is_spec(x):
     return isinstance(x, P)
 
@@ -119,6 +133,16 @@ class _MeshTrainer:
             state.params, state.opt_state, inputs, targets,
             *self._extra_args(state))
         return LMTrainState(params, opt_state, state.step + 1), loss
+
+    def lower_train_step(self, state: LMTrainState, inputs, targets):
+        """Lower (never run) the jitted train step — the graph_audit
+        surface (scripts/graph_audit.py): what the lockstep auditor
+        fingerprints is exactly the program ``train_step`` dispatches,
+        collective order included (the MoE step's two all_to_alls are
+        the divergent-order deadlock class it hunts)."""
+        return self._train_step.lower(
+            state.params, state.opt_state, inputs, targets,
+            *self._extra_args(state))
 
     def _clip_by_global_norm(self, grads, specs):
         """Scale ``grads`` so their GLOBAL L2 norm is <= clip_grad_norm
@@ -738,6 +762,30 @@ class LMTrainer(_MeshTrainer):
             params, grads, opt_state, decay_mask=self._decay_mask(params))
         # (1, 1) per shard -> (dp*ep, sp) global: each shard's chunk mean.
         return params, opt_state, local_mean.reshape(1, 1)
+
+    def route_stats(self, state: LMTrainState, tokens):
+        """Routing-health counters on the CURRENT weights: per MoE layer
+        a dict of ``dropped_frac`` (fraction of routed assignments that
+        overflowed expert capacity and rode the residual), ``expert_load``
+        (per-expert fraction of kept assignments — the load histogram)
+        and ``imbalance`` (max load x E; 1.0 = perfectly balanced).
+        ``[]`` for dense models.
+
+        Runs OUTSIDE the train step, on the canonical gathered params
+        with every partition axis stripped — one deterministic trunk
+        pass (no dropout), cheap at probe cadence and layout-independent:
+        a replicated, tp/ep-sharded, ZeRO or FSDP trainer reports the
+        same numbers for the same weights and tokens."""
+        if not self.model.moe_experts:
+            return []
+        params = self.params_to_host(state)
+        model = dataclasses.replace(
+            self.model, sp_axis=None, sp_size=1, tp_axis=None, tp_size=1,
+            ep_axis=None, ep_size=1)
+        stats = model.route_stats(
+            params, jnp.asarray(np.asarray(tokens), jnp.int32))
+        return [{k: np.asarray(v) for k, v in layer.items()}
+                for layer in stats]
 
     def put_batch(self, inputs, targets):
         inputs = np.ascontiguousarray(inputs, np.int32)

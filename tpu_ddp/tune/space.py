@@ -280,11 +280,13 @@ KNOBS: tuple[Knob, ...] = (
              "0 = off. More frequent pushes keep served weights "
              "fresher but spend decode-step time staging buckets"),
     Knob("publish_wire", "publish_wire", "TPU_DDP_PUBLISH_WIRE",
-         values=("none", "bf16", "int8"), flag="--publish-wire",
+         values=("none", "bf16", "int8", "sparse"),
+         flag="--publish-wire",
          objective="goodput", semantic=True,
          doc="wire format for pushed weight deltas (EdgeCodec "
              "vocabulary). Lossy wires round the served weights, so "
-             "the knob is semantic like kv_wire"),
+             "the knob is semantic like kv_wire; 'sparse' is lossless "
+             "zero-chunk elision (the MoE expert-delta wire)"),
     Knob("max_staleness_steps", "max_staleness_steps",
          "TPU_DDP_PUBLISH_MAX_STALENESS",
          values=(0, 2, 8), flag="--publish-max-staleness",
@@ -374,6 +376,28 @@ KNOBS: tuple[Knob, ...] = (
              "axis and run ring or Ulysses attention against the paged "
              "cache, cutting TTFT on long prompts. Requires an sp>=2 "
              "mesh and the single-tier pool (engine rejects tiers>1)"),
+    # Mixture-of-experts knobs (parallel/moe.py, DESIGN.md §28): all
+    # three change WHAT the model computes (a different architecture /
+    # routing distribution, not a schedule), so all are semantic —
+    # searched only under TPU_DDP_TUNE_SEMANTIC, like compute_dtype.
+    Knob("moe_experts", "moe_experts", "TPU_DDP_MOE_EXPERTS",
+         values=(0, 4, 8), flag="--moe-experts", semantic=True,
+         doc="experts per MoE MLP layer (0 = dense): param count grows "
+             "~linearly in E at per-token FLOPs tracking top_k — the "
+             "capability-per-FLOP axis (experiments/moe_sweep.json); "
+             "an ep>1 mesh must divide E"),
+    Knob("moe_top_k", "moe_top_k", "TPU_DDP_MOE_TOP_K",
+         values=(1, 2), flag="--moe-top-k", semantic=True,
+         doc="routed experts per token: 1 = Switch routing (raw-prob "
+             "gate), 2 = GShard (renormalized gates, shared capacity "
+             "queues); topk_route rejects top_k > experts"),
+    Knob("moe_capacity", "moe_capacity", "TPU_DDP_MOE_CAPACITY",
+         values=(1.0, 1.25, 2.0), flag="--moe-capacity", semantic=True,
+         doc="expert capacity factor: slots per expert = "
+             "ceil(T * capacity * top_k / E). Higher drops fewer "
+             "tokens (the dropped_frac train metric) at more padded "
+             "expert compute; changes which tokens the experts see, "
+             "so semantic"),
 )
 
 # Model-level knobs are baked into get_model() before the Trainer ever
@@ -415,6 +439,9 @@ class Workload:
     # needs both; pp <= 1 scopes the pipeline knobs out entirely.
     pp: int = 1
     model_layers: int = 0
+    # Expert-parallel extent on the mesh (round 19): the MoE knob
+    # rules need it — ep>1 requires a divisible moe_experts.
+    ep: int = 1
 
 
 def workload_for(cfg, strategy: str = "none", mesh=None) -> Workload:
@@ -424,13 +451,14 @@ def workload_for(cfg, strategy: str = "none", mesh=None) -> Workload:
 
     from tpu_ddp.parallel.sync import canonical_strategy
 
-    dp, pp = 1, 1
+    dp, pp, ep = 1, 1, 1
     if mesh is not None:
         try:
             dp = int(mesh.shape.get("dp", 1))
             pp = int(mesh.shape.get("pp", 1))
+            ep = int(mesh.shape.get("ep", 1))
         except Exception:  # noqa: BLE001 — a mesh without named axes
-            dp, pp = 1, 1
+            dp, pp, ep = 1, 1, 1
     from tpu_ddp.memory import family_for_model
 
     layers = 0
@@ -450,6 +478,7 @@ def workload_for(cfg, strategy: str = "none", mesh=None) -> Workload:
         model_family=family_for_model(cfg.model),
         pp=pp,
         model_layers=layers,
+        ep=ep,
     )
 
 
@@ -610,6 +639,37 @@ def violations(assignment: Mapping, ctx: Workload) -> list[str]:
             "prefill program gathers pages by flat slot id and the "
             "engine rejects the combination (serve/engine.py); tiered "
             "residency is a decode-side feature")
+    # MoE knobs (parallel/moe.py §28) — mirror the model layer's guards.
+    experts = get("moe_experts", 0)
+    if experts == 0:
+        if get("moe_top_k", 1) != 1:
+            bad.append(
+                f"moe_top_k={get('moe_top_k')} with moe_experts=0 — "
+                "no routed layer exists, the knob is inert and the "
+                "cell duplicates the dense default")
+        if get("moe_capacity", 1.25) != 1.25:
+            bad.append(
+                f"moe_capacity={get('moe_capacity')} with "
+                "moe_experts=0 — no routed layer exists, the knob is "
+                "inert and the cell duplicates the dense default")
+    else:
+        if get("moe_top_k", 1) > experts:
+            bad.append(
+                f"moe_top_k={get('moe_top_k')} > moe_experts="
+                f"{experts} — topk_route rejects it (beyond E the "
+                "fully-masked argmax would silently re-route to "
+                "expert 0)")
+    if ctx.ep > 1:
+        if experts == 0:
+            bad.append(
+                f"ep={ctx.ep} mesh with moe_experts=0 — expert "
+                "parallelism requires a MoE model "
+                "(with_expert_parallel rejects it)")
+        elif experts % ctx.ep:
+            bad.append(
+                f"moe_experts={experts} not divisible by ep={ctx.ep} "
+                "— with_expert_parallel rejects it (each device hosts "
+                "E/ep stacked experts)")
     return bad
 
 

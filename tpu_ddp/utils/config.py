@@ -307,6 +307,20 @@ class TrainConfig:
     # (0 = unbounded; fully async). Env: TPU_DDP_PUBLISH_MAX_STALENESS.
     max_staleness_steps: int = 0
 
+    # Mixture of experts (tpu_ddp/parallel/moe.py, docs/DESIGN.md §28).
+    # Experts per MoE MLP layer (0 = dense models; >0 selects/overrides
+    # the routed family — the moe presets in models/transformer.py set
+    # it per entry). Env: TPU_DDP_MOE_EXPERTS.
+    moe_experts: int = 0
+    # Routed experts per token: 1 = Switch, 2 = GShard. The model layer
+    # re-validates top_k <= experts where the expert count is known.
+    # Env: TPU_DDP_MOE_TOP_K.
+    moe_top_k: int = 1
+    # Expert capacity factor: slots per expert =
+    # ceil(T * capacity * top_k / E). Higher = fewer dropped tokens,
+    # more padded compute. Env: TPU_DDP_MOE_CAPACITY.
+    moe_capacity: float = 1.25
+
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
     max_iters: int | None = None
@@ -606,10 +620,10 @@ class TrainConfig:
             self.publish_wire = env_pw
         # Mirrors publish/publisher.py PUBLISH_WIRES (the publisher
         # re-validates at construction).
-        if self.publish_wire not in ("none", "bf16", "int8"):
+        if self.publish_wire not in ("none", "bf16", "int8", "sparse"):
             raise ValueError(
                 f"publish_wire={self.publish_wire!r}: expected "
-                "none|bf16|int8 (TPU_DDP_PUBLISH_WIRE)")
+                "none|bf16|int8|sparse (TPU_DDP_PUBLISH_WIRE)")
         self.max_staleness_steps = _env_num(
             "TPU_DDP_PUBLISH_MAX_STALENESS", int,
             self.max_staleness_steps)
@@ -663,6 +677,27 @@ class TrainConfig:
             raise ValueError(
                 f"cp_prefill={self.cp_prefill!r}: expected "
                 "off|ring|ulysses (TPU_DDP_CP_PREFILL)")
+        self.moe_experts = _env_num(
+            "TPU_DDP_MOE_EXPERTS", int, self.moe_experts)
+        if self.moe_experts < 0:
+            raise ValueError(
+                f"moe_experts must be >= 0 (0 = dense), got "
+                f"{self.moe_experts} (TPU_DDP_MOE_EXPERTS)")
+        self.moe_top_k = _env_num(
+            "TPU_DDP_MOE_TOP_K", int, self.moe_top_k)
+        if self.moe_top_k < 1:
+            raise ValueError(
+                f"moe_top_k must be >= 1, got {self.moe_top_k} "
+                "(TPU_DDP_MOE_TOP_K)")
+        # top_k <= experts needs both knobs; like the pp coupling above,
+        # cross-knob checks live in the model layer (topk_route) and in
+        # tune/space.py violations, never in the single-var parses.
+        self.moe_capacity = _env_num(
+            "TPU_DDP_MOE_CAPACITY", float, self.moe_capacity)
+        if not self.moe_capacity > 0:  # also rejects NaN
+            raise ValueError(
+                f"moe_capacity must be > 0, got {self.moe_capacity} "
+                "(TPU_DDP_MOE_CAPACITY)")
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.
