@@ -29,6 +29,13 @@ host-join      shrink epoch as above, then the departed worker
                rejoins a regrown epoch and restores from the
                survivors' state beacon (two reshard epochs, zero
                restarts)
+group-loss     a DiLoCo group is dropped mid-outer-round (via
+               scripts/diloco_sweep.py --only chaos, the one drill
+               that runs the two-level outer loop rather than a
+               rank cluster): the survivor reweights the outer
+               mean, training keeps converging, the lost group
+               rejoins digest-equal via Publisher.bootstrap, and
+               the sentinel keeps the fault one-shot
 =============  ======================================================
 
 Writes ``experiments/chaos_sweep.json`` — one cell per drill with
@@ -239,6 +246,36 @@ def drill_host_join(work: Path, cell: dict) -> bool:
     return ok
 
 
+def drill_group_loss(work: Path, cell: dict) -> bool:
+    """Drop DiLoCo group 1 mid-outer-round; recovery = elastic outer
+    membership. This drill delegates to the diloco sweep's chaos cell
+    (a real env-driven injector inside the outer loop) — the pass
+    evidence is its committed checks plus the injector's announce
+    line."""
+    import subprocess
+
+    out_json = work / "diloco_chaos.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "diloco_sweep.py"),
+         "--only", "chaos", "--out", str(out_json)],
+        capture_output=True, text=True, timeout=TIMEOUT)
+    output = proc.stdout + proc.stderr
+    ok = _check(cell, "run_ok", proc.returncode == 0, proc.returncode)
+    ok &= _check(cell, "injector_announced",
+                 "[chaos] rank 0: injecting group-loss at step 2"
+                 in output)
+    drill = {}
+    if out_json.exists():
+        drill = json.loads(out_json.read_text())["cells"].get(
+            "chaos_drill", {})
+    checks = drill.get("checks", {})
+    for name in ("group1_lost_round2", "survivor_reweighted",
+                 "rejoin_digest_equal", "rejoin_at_current_version",
+                 "fault_one_shot", "converging", "sentinel_written"):
+        ok &= _check(cell, name, checks.get(name, False))
+    return ok
+
+
 DRILLS = {
     "hard-exit": drill_hard_exit,
     "nan-grad": drill_nan_grad,
@@ -247,6 +284,7 @@ DRILLS = {
     "slow-rank": drill_slow_rank,
     "host-loss": drill_host_loss,
     "host-join": drill_host_join,
+    "group-loss": drill_group_loss,
 }
 assert set(DRILLS) == set(FAULT_KINDS), \
     "a fault kind exists without a sweep drill"

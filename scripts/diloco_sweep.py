@@ -1,0 +1,400 @@
+"""DiLoCo sweep — WAN bytes vs compressed DP, convergence grid, chaos.
+
+The outer-loop tentpole's claim is a NUMBER: training with H local
+steps per outer round must ship FAR fewer bytes across the WAN edge
+than running the same devices as one data-parallel cluster whose
+every-step all-reduce spans the datacenter cut — at a final loss that
+matches the synced baseline. This sweep measures both sides on the
+same tiny LM and batch schedule and commits the comparison:
+
+- ``baseline``     — the 4 devices as ONE dp=4 cluster, 32 synced
+                     steps. Its per-step collective volume is MEASURED
+                     from the compiled train-step HLO
+                     (tpu_ddp/analysis/hlo.py ring cost model); the
+                     compressed-DP wire cost models int8 gradient
+                     compression as dense/4 (1 byte vs 4 on the wire —
+                     favorable to the baseline, which really also pays
+                     scales + error feedback).
+- ``h{H}-{wire}``  — the same devices as TWO DiLoCo groups (dp=2
+                     each), H inner steps per round for the same
+                     64-step inner budget, outer wire in
+                     none/bf16/int8. WAN bytes come from the
+                     publishers' shipped ``WeightUpdate.nbytes`` (up
+                     pseudo-gradients + per-receiver down broadcasts);
+                     final loss is probed on a held-out batch.
+- ``chaos_drill``  — a REAL env-driven ``group-loss@2:group=1`` fault
+                     (resilience/chaos.py): group 1 is dropped
+                     mid-outer-round, the survivor reweights the outer
+                     mean, training keeps converging, and the lost
+                     group REJOINS via ``Publisher.bootstrap`` —
+                     digest-equal at the current outer version — then
+                     the sentinel proves the fault is one-shot.
+
+Pass criteria (enforced, exit 1): every convergence cell finite, no
+skipped rounds, groups digest-equal at the end, AND within MATCH_RTOL
+of the baseline's held-out final loss; WAN bytes strictly ordered
+int8 < bf16 < none within each H and strictly shrinking as H grows
+within each wire; the H=32 int8 headline cell at >= 10x fewer WAN
+bytes than compressed DP at that matched loss; the chaos drill's
+checks all green.
+
+Writes ``experiments/diloco_sweep.json``.
+
+Usage::
+
+    python scripts/diloco_sweep.py              # full sweep
+    python scripts/diloco_sweep.py --only chaos # just the drill
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import os  # noqa: E402
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+TOTAL_STEPS = 64          # inner-step budget per group (and baseline)
+GRID_H = (1, 8, 32)
+GRID_WIRE = ("none", "bf16", "int8")
+HEADLINE = (32, "int8")   # the cell the >= 10x claim is enforced on
+MIN_RATIO = 10.0
+MATCH_RTOL = 0.005        # matched final loss: <= 0.5 % relative
+# Outer knobs for the grid: Nesterov momentum 0.5 is stable down to
+# H=1 over a momentum-0.9 inner SGD (mu=0.9 outer on top of mu=0.9
+# inner compounds into an effective lr ~50x and diverges at small H —
+# the config default stays 0.9 because the intended regime is large
+# H, where the pseudo-gradient is already smoothed over H steps).
+OUTER_LR = 0.7
+OUTER_MU = 0.5
+
+
+def _setup():
+    """Two dp=2 group trainers + one dp=4 baseline trainer over the
+    same 4 virtual devices, a deterministic per-group batch schedule
+    (the baseline sees the concatenation), and a held-out probe."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_ddp.models.transformer import make_transformer
+    from tpu_ddp.ops.optim import SGD
+    from tpu_ddp.parallel.mesh import make_mesh
+    from tpu_ddp.train.lm import LMTrainer
+
+    model = make_transformer("TransformerLM-tiny", max_seq_len=64,
+                             compute_dtype=jnp.float32)
+    devs = jax.devices()
+
+    def trainer(dev_slice, dp):
+        return LMTrainer(model, make_mesh(dev_slice, dp=dp),
+                         optimizer=SGD(learning_rate=0.1, momentum=0.9))
+
+    tr = {0: trainer(devs[:2], 2), 1: trainer(devs[2:4], 2),
+          "baseline": trainer(devs[:4], 4)}
+    # tokens[t][gid]: group gid's batch for inner step t (disjoint data
+    # streams — the groups ARE the data parallelism of the outer
+    # level). Drawn from the low-128 slice of the 1024 vocab so the
+    # marginals are learnable and held-out loss actually falls —
+    # uniform-over-vocab noise would leave nothing to converge TO.
+    rng = np.random.default_rng(7)
+    tokens = [{gid: rng.integers(0, 128, size=(4, 33))
+               for gid in (0, 1)} for _ in range(TOTAL_STEPS)]
+    probe = np.random.default_rng(123).integers(0, 128, size=(8, 33))
+    return {"model": model, "tr": tr, "tokens": tokens, "probe": probe}
+
+
+def _probe_loss(trainer, state, tokens) -> float:
+    """Loss at ``state``'s params on the probe batch. The jitted step
+    donates the input state, so only call this once training with that
+    state is over."""
+    import numpy as np
+
+    from tpu_ddp.train.lm import make_lm_batch
+
+    x, y = trainer.put_batch(*make_lm_batch(tokens))
+    _, loss = trainer.train_step(state, x, y)
+    return float(np.mean(np.asarray(loss)))
+
+
+def _make_group(ctx, gid):
+    from tpu_ddp.train.outer import DilocoGroup
+
+    trainer = ctx["tr"][gid]
+    return DilocoGroup(gid, trainer, trainer.init_state(seed=3))
+
+
+def _batch_fn(ctx):
+    """next_batch(group): the group's own stream, advanced per call."""
+    from tpu_ddp.train.lm import make_lm_batch
+
+    cursor = {}
+
+    def next_batch(group):
+        t = cursor.get(group.gid, 0)
+        cursor[group.gid] = t + 1
+        toks = ctx["tokens"][t % TOTAL_STEPS][group.gid]
+        return group.trainer.put_batch(*make_lm_batch(toks))
+
+    return next_batch
+
+
+def cell_baseline(ctx) -> dict:
+    """32 synced dp=4 steps on the combined batch stream; the WAN cost
+    is the MEASURED per-step collective volume (every step's all-reduce
+    spans the datacenter cut in this deployment) and its int8
+    compressed-DP model."""
+    import numpy as np
+
+    from tpu_ddp.analysis.hlo import collective_volume
+    from tpu_ddp.train.lm import make_lm_batch
+
+    tr = ctx["tr"]["baseline"]
+    state = tr.init_state(seed=3)
+    x, y = tr.put_batch(*make_lm_batch(
+        np.vstack([ctx["tokens"][0][0], ctx["tokens"][0][1]])))
+    vol = collective_volume(
+        tr.lower_train_step(state, x, y).compile().as_text(), 4)
+    dense_step = vol["total_wire_bytes_per_device"] * 4
+    for t in range(TOTAL_STEPS):
+        toks = np.vstack([ctx["tokens"][t][0], ctx["tokens"][t][1]])
+        x, y = tr.put_batch(*make_lm_batch(toks))
+        state, _ = tr.train_step(state, x, y)
+    final = _probe_loss(tr, state, ctx["probe"])
+    return {"ok": bool(np.isfinite(final)),
+            "final_loss": round(final, 6),
+            "steps": TOTAL_STEPS,
+            "dense_bytes_per_step": int(dense_step),
+            "dense_bytes_total": int(dense_step * TOTAL_STEPS),
+            "compressed_dp_bytes_total":
+                int(dense_step * TOTAL_STEPS / 4),
+            "collectives_per_step": vol["total_collectives"]}
+
+
+def cell_convergence(ctx, h: int, wire: str, base: dict) -> dict:
+    """Two DiLoCo groups, ``TOTAL_STEPS`` inner steps each in rounds of
+    ``h``; WAN bytes + final probe loss vs the synced baseline."""
+    import numpy as np
+
+    from tpu_ddp.train.outer import OuterLoop
+
+    g0, g1 = _make_group(ctx, 0), _make_group(ctx, 1)
+    loop = OuterLoop([g0, g1], diloco_h=h, outer_lr=OUTER_LR,
+                     outer_momentum=OUTER_MU, outer_wire=wire)
+    nb = _batch_fn(ctx)
+    skipped = 0
+    for _ in range(TOTAL_STEPS // h):
+        skipped += int(loop.round(nb)["skipped"])
+    if not loop.digest_equal(g0) or not loop.digest_equal(g1):
+        return {"ok": False, "error": "groups not digest-equal after "
+                                      "the final down flip"}
+    final = _probe_loss(ctx["tr"][0], g0.state, ctx["probe"])
+    wan = loop.cross_group_bytes()
+    rel = abs(final - base["final_loss"]) / abs(base["final_loss"])
+    ratio = base["compressed_dp_bytes_total"] / max(wan, 1)
+    matched = rel <= MATCH_RTOL
+    return {"ok": bool(np.isfinite(final) and skipped == 0
+                       and matched),
+            "h": h, "wire": wire,
+            "outer_lr": OUTER_LR, "outer_momentum": OUTER_MU,
+            "loss_matched": bool(matched),
+            "rounds": TOTAL_STEPS // h, "skipped_rounds": skipped,
+            "final_loss": round(final, 6),
+            "loss_rel_vs_baseline": round(rel, 6),
+            "wan_bytes": int(wan),
+            "bytes_ratio_vs_compressed_dp": round(ratio, 2)}
+
+
+def cell_chaos_drill(ctx) -> dict:
+    """group-loss through the REAL injector: env-configured fault drops
+    group 1 on outer round 2, the survivor reweights, the round-trip
+    rejoin bootstraps digest-equal, and the sentinel keeps the fault
+    one-shot for the remaining rounds."""
+    import numpy as np
+
+    from tpu_ddp.train.outer import OuterLoop
+
+    checks = {}
+    with tempfile.TemporaryDirectory() as sentinels:
+        saved = {k: os.environ.get(k) for k in
+                 ("TPU_DDP_CHAOS_FAULTS", "TPU_DDP_CHAOS_SENTINEL")}
+        os.environ["TPU_DDP_CHAOS_FAULTS"] = "group-loss@2:group=1"
+        os.environ["TPU_DDP_CHAOS_SENTINEL"] = sentinels
+        try:
+            g0, g1 = _make_group(ctx, 0), _make_group(ctx, 1)
+            loop = OuterLoop([g0, g1], diloco_h=4, outer_lr=OUTER_LR,
+                             outer_momentum=OUTER_MU,
+                             outer_wire="int8")
+            nb = _batch_fn(ctx)
+            checks["injector_armed"] = loop.injector is not None
+            st1 = loop.round(nb)
+            checks["round1_both_groups"] = st1["groups"] == [0, 1]
+            st2 = loop.round(nb)   # chaos fires: group 1 lost mid-round
+            checks["group1_lost_round2"] = (st2["groups"] == [0]
+                                            and 1 in loop.removed)
+            checks["survivor_round_applied"] = not st2["skipped"]
+            st3 = loop.round(nb)   # survivor-only round: mean over ONE
+            checks["survivor_reweighted"] = (st3["groups"] == [0]
+                                             and not st3["skipped"])
+            rejoiner = loop.removed[1]
+            loop.add_group(rejoiner)
+            checks["rejoin_digest_equal"] = loop.digest_equal(rejoiner)
+            checks["rejoin_at_current_version"] = (
+                rejoiner.sub.applied_version == loop.down.version)
+            st4 = loop.round(nb)
+            st5 = loop.round(nb)   # sentinel blocks a second firing
+            checks["fault_one_shot"] = (st4["groups"] == [0, 1]
+                                        and st5["groups"] == [0, 1])
+            # Held-out probes beat per-round training-loss noise:
+            # compare the end-of-drill params against the shared init.
+            # (The probe step donates the state it reads — only safe
+            # once the drill's rounds are over.)
+            tr0 = ctx["tr"][0]
+            start_loss = _probe_loss(tr0, tr0.init_state(seed=3),
+                                     ctx["probe"])
+            end_loss = _probe_loss(tr0, g0.state, ctx["probe"])
+            checks["converging"] = bool(np.isfinite(end_loss)
+                                        and end_loss < start_loss)
+            sent = sorted(p.name for p in Path(sentinels).iterdir())
+            checks["sentinel_written"] = any(
+                s.startswith("group-loss") for s in sent)
+            checks = {k: bool(v) for k, v in checks.items()}
+            return {"ok": all(checks.values()), "checks": checks,
+                    "probe_loss_at_init": round(start_loss, 6),
+                    "probe_loss_at_end": round(end_loss, 6),
+                    "sentinels": sent}
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--only", default=None,
+                    help="comma-separated substring filter over cells")
+    ap.add_argument("--out", default=str(REPO / "experiments"
+                                         / "diloco_sweep.json"))
+    args = ap.parse_args(argv)
+    only = args.only.split(",") if args.only else None
+
+    def wanted(name):
+        return only is None or any(o in name for o in only)
+
+    import jax
+    ctx = _setup()
+    dev = jax.devices()[0]
+    results = {
+        "note": ("DiLoCo vs compressed DP on the same 4 virtual "
+                 "devices and batch schedule: the baseline's per-step "
+                 "WAN cost is measured from the dp=4 train step's "
+                 "compiled HLO (ring cost model, every all-reduce "
+                 "spans the datacenter cut) with int8 compressed DP "
+                 "modeled as dense/4 — favorable to the baseline; "
+                 "DiLoCo WAN bytes are the publishers' actually-"
+                 "shipped WeightUpdate payloads (up pseudo-gradients "
+                 "+ per-receiver down broadcasts, including the "
+                 "initial full sync). Convergence cells must match "
+                 f"the baseline's held-out loss within {MATCH_RTOL:.1%}"
+                 " relative; the >= 10x bytes claim is enforced on "
+                 "the H=32 int8 headline cell. Wall clocks are host-"
+                 "dependent; the RATIOS and the loss match are the "
+                 "committed claims."),
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "total_inner_steps": TOTAL_STEPS,
+        "n_groups": 2,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "cells": {},
+    }
+
+    names = ["baseline"] + [f"h{h}-{w}" for h in GRID_H
+                            for w in GRID_WIRE] + ["chaos_drill"]
+    base = None
+    for name in names:
+        needs_base = name != "chaos_drill" and name != "baseline"
+        if not wanted(name) and not (name == "baseline"
+                                     and any(wanted(n) for n in names
+                                             if n.startswith("h"))):
+            continue
+        print(f"[diloco-sweep] {name}...", flush=True)
+        t0 = time.monotonic()
+        try:
+            if name == "baseline":
+                cell = base = cell_baseline(ctx)
+            elif name == "chaos_drill":
+                cell = cell_chaos_drill(ctx)
+            else:
+                h, wire = name[1:].split("-")
+                cell = cell_convergence(ctx, int(h), wire, base)
+        except Exception as e:  # noqa: BLE001 — record, keep going
+            cell = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        cell["wall_s"] = round(time.monotonic() - t0, 2)
+        results["cells"][name] = cell
+        print(f"[diloco-sweep] {name}: "
+              f"{'PASS' if cell.get('ok') else 'FAIL'} "
+              f"({cell['wall_s']}s)", flush=True)
+
+    cells = results["cells"]
+    head = cells.get(f"h{HEADLINE[0]}-{HEADLINE[1]}", {})
+    conv = [c for n, c in cells.items() if n.startswith("h")]
+
+    def wan(h, w):
+        return cells.get(f"h{h}-{w}", {}).get("wan_bytes", -1)
+
+    full_grid = all(wan(h, w) > 0 for h in GRID_H for w in GRID_WIRE)
+    claims = {
+        "headline_cell": f"h{HEADLINE[0]}-{HEADLINE[1]}",
+        "headline_bytes_ratio":
+            head.get("bytes_ratio_vs_compressed_dp"),
+        "ge_10x_fewer_wan_bytes_than_compressed_dp_at_matched_loss":
+            bool(head.get("bytes_ratio_vs_compressed_dp", 0)
+                 >= MIN_RATIO and head.get("loss_matched")),
+        "all_cells_match_baseline_loss": bool(conv) and all(
+            c.get("loss_matched") for c in conv),
+        "wire_ladder_int8_lt_bf16_lt_none": full_grid and all(
+            wan(h, "int8") < wan(h, "bf16") < wan(h, "none")
+            for h in GRID_H),
+        "bytes_shrink_as_h_grows": full_grid and all(
+            wan(1, w) > wan(8, w) > wan(32, w) for w in GRID_WIRE),
+        "all_cells_converged":
+            bool(conv) and all(c.get("ok") for c in conv),
+        "group_loss_drill_green":
+            bool(cells.get("chaos_drill", {}).get("ok")),
+    }
+    results["claims"] = claims
+    enforced = [
+        claims["all_cells_converged"],
+        claims["all_cells_match_baseline_loss"],
+        claims["ge_10x_fewer_wan_bytes_than_compressed_dp_at_matched_loss"],
+        claims["wire_ladder_int8_lt_bf16_lt_none"],
+        claims["bytes_shrink_as_h_grows"],
+        claims["group_loss_drill_green"],
+    ]
+    if only is not None:
+        # Partial runs (e.g. chaos_sweep's drill mode) enforce only
+        # what actually ran.
+        enforced = [c.get("ok", False) for c in cells.values()]
+    results["all_passed"] = all(enforced)
+    out = Path(args.out)
+    out.parent.mkdir(exist_ok=True)
+    out.write_text(json.dumps(results, indent=1))
+    print(f"[diloco-sweep] wrote {out} "
+          f"(all_passed={results['all_passed']})")
+    return 0 if results["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,8 +21,9 @@ fsdp) on a tiny VGG at dp=4, the compressed fused rungs (bf16/int8),
 the bucketized-overlap rung, both MPMD stage programs at pp=2, the
 serving engine's decode + prefill steps, the fleet's adopt-decode
 repack, both weight-streaming programs (the publisher's delta pack and
-the subscriber's donating apply), and a live dp4->dp2 redistribute
-bracketed by fingerprints of both trainers' programs.
+the subscriber's donating apply), the DiLoCo outer-step program, and a
+live dp4->dp2 redistribute bracketed by fingerprints of both trainers'
+programs.
 
 All claims are compiled-HLO claims, valid on any backend; CI runs a
 reduced subset (tests/test_graph_audit.py). Exit 1 on ANY finding.
@@ -342,6 +343,26 @@ def audit_moe_cells():
     return cells
 
 
+def audit_diloco_cell():
+    """The §29 DiLoCo outer-step surface: the guarded Nesterov program
+    every coordinator runs once per outer round
+    (tpu_ddp/parallel/diloco.py). It carries no collective — agreement
+    is by construction over the digest-pinned down edge — so the cell's
+    teeth are donation (start params + outer momentum are donated;
+    unaliased donation would copy the whole global tree every round)
+    and the lockstep fingerprint of the same (lr, mu) lowered twice."""
+    import jax
+
+    from tpu_ddp.parallel.diloco import lower_outer_step
+
+    model = _tiny_lm()
+    params = model.init(jax.random.key(0))
+    return [_program_audit(
+        "diloco/outer-step",
+        lambda: lower_outer_step(params, outer_lr=0.7,
+                                 outer_momentum=0.9))]
+
+
 def audit_redistribute_cell():
     """Fingerprint the dp=4 source and dp=2 destination train programs
     around a LIVE redistribute: the two fleets' programs legitimately
@@ -389,6 +410,7 @@ def build_cells(only=None):
     specs.append(("fleet", audit_fleet_cell))
     specs.append(("publish", audit_publish_cells))
     specs.append(("moe", audit_moe_cells))
+    specs.append(("diloco", audit_diloco_cell))
     specs.append(("redistribute", audit_redistribute_cell))
     if only is not None:
         specs = [(n, t) for n, t in specs

@@ -1,0 +1,417 @@
+"""DiLoCo outer loop: the cross-datacenter rung (DESIGN.md §29).
+
+The pins the module docstrings promise, in test form:
+
+- ``diloco_h=0`` is INERT — the existing sync path traces
+  byte-for-byte as if ``train/outer.py`` did not exist;
+- ``H=1, outer_lr=1, zero momentum, wire=none`` matches plain synced
+  training bitwise (the identity outer optimizer adopts ``mean_end``
+  structurally, the lossless wire ships full pushes that decode
+  bitwise);
+- the int8 outer wire's error-feedback residual lifecycle: carried
+  across rounds, reset WITH a warning on a group-count change, and
+  untouched when the StepGuard skip protocol fires (flags are
+  collected BEFORE any codec encodes);
+- elastic membership: a lost group reweights the outer mean, a
+  rejoiner boots digest-equal at the current outer version;
+- the chaos grammar (``group-loss@N:group=G``) parses, validates, and
+  one-shots via the sentinel like every other fault kind.
+"""
+
+import dataclasses
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.models.transformer import make_transformer
+from tpu_ddp.ops.optim import SGD
+from tpu_ddp.parallel.diloco import (UpdateEdge, decode_update,
+                                     lower_outer_step, mean_end_leaves,
+                                     outer_program)
+from tpu_ddp.parallel.mesh import make_mesh
+from tpu_ddp.parallel.overlap import BucketPlan
+from tpu_ddp.train.lm import LMTrainer, make_lm_batch
+from tpu_ddp.train.outer import DilocoGroup, OuterLoop
+
+_MODEL = None
+
+
+def _model():
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = make_transformer("TransformerLM-tiny", max_seq_len=32,
+                                  compute_dtype=jnp.float32)
+    return _MODEL
+
+
+def _make_group(devices, gid, lo, hi):
+    mesh = make_mesh(devices[lo:hi], dp=hi - lo)
+    trainer = LMTrainer(_model(), mesh,
+                        optimizer=SGD(learning_rate=0.1, momentum=0.9))
+    return DilocoGroup(gid, trainer, trainer.init_state(seed=3))
+
+
+def _batch_fn():
+    rng = np.random.default_rng(0)
+    batches = [rng.integers(0, 128, size=(4, 17)) for _ in range(64)]
+    cursor = {}
+
+    def next_batch(group):
+        i = cursor.get(group.gid, 0)
+        cursor[group.gid] = i + 1
+        return group.trainer.put_batch(
+            *make_lm_batch(batches[i % len(batches)]))
+
+    return next_batch
+
+
+# ---------------------------------------------------------------------------
+# Knob surfaces (construction validation + env junk rejection).
+# ---------------------------------------------------------------------------
+
+
+def test_outer_loop_validates_knobs():
+    with pytest.raises(ValueError, match="diloco_h"):
+        OuterLoop([], diloco_h=-1)
+    with pytest.raises(ValueError, match="outer_lr"):
+        OuterLoop([], diloco_h=0, outer_lr=0.0)
+    with pytest.raises(ValueError, match="outer_momentum"):
+        OuterLoop([], diloco_h=0, outer_momentum=1.0)
+    with pytest.raises(ValueError, match="outer_wire"):
+        OuterLoop([], diloco_h=0, outer_wire="zstd")
+    with pytest.raises(ValueError, match="at least one group"):
+        OuterLoop([], diloco_h=4)
+
+
+def test_env_junk_rejected(monkeypatch):
+    from tpu_ddp.utils.config import TrainConfig
+    for env, junk in [("TPU_DDP_DILOCO_H", "many"),
+                      ("TPU_DDP_DILOCO_H", "-2"),
+                      ("TPU_DDP_DILOCO_OUTER_LR", "fast"),
+                      ("TPU_DDP_DILOCO_OUTER_LR", "0"),
+                      ("TPU_DDP_DILOCO_OUTER_LR", "nan"),
+                      ("TPU_DDP_DILOCO_OUTER_MOMENTUM", "heavy"),
+                      ("TPU_DDP_DILOCO_OUTER_MOMENTUM", "1.0"),
+                      ("TPU_DDP_DILOCO_OUTER_WIRE", "zstd")]:
+        monkeypatch.setenv(env, junk)
+        with pytest.raises(ValueError, match=env):
+            TrainConfig()
+        monkeypatch.delenv(env)
+    monkeypatch.setenv("TPU_DDP_DILOCO_H", "8")
+    monkeypatch.setenv("TPU_DDP_DILOCO_OUTER_LR", "0.4")
+    monkeypatch.setenv("TPU_DDP_DILOCO_OUTER_MOMENTUM", "0.5")
+    monkeypatch.setenv("TPU_DDP_DILOCO_OUTER_WIRE", "int8")
+    cfg = TrainConfig()
+    assert (cfg.diloco_h, cfg.outer_lr, cfg.outer_momentum,
+            cfg.outer_wire) == (8, 0.4, 0.5, "int8")
+
+
+# ---------------------------------------------------------------------------
+# The jitted outer program (in-graph guard + identity shortcut).
+# ---------------------------------------------------------------------------
+
+
+def test_outer_program_guard_is_exact_noop():
+    start = (np.full((4,), 2.0, np.float32),
+             np.full((2, 3), -1.0, np.float32))
+    momentum = tuple(np.full(s.shape, 0.25, np.float32) for s in start)
+    poisoned = (np.full((4,), np.nan, np.float32),
+                np.full((2, 3), -1.1, np.float32))
+    new, m_out, bad = outer_program(0.7, 0.9)(
+        tuple(np.copy(s) for s in start), poisoned,
+        tuple(np.copy(m) for m in momentum))
+    assert bool(np.asarray(bad))
+    # select_update keeps the OLD params and momentum bitwise on EVERY
+    # leaf — the non-finite round is an exact in-graph no-op.
+    for got, want in zip(new, start):
+        assert np.asarray(got).tobytes() == want.tobytes()
+    for got, want in zip(m_out, momentum):
+        assert np.asarray(got).tobytes() == want.tobytes()
+
+
+def test_outer_program_identity_adopts_mean_end_bitwise():
+    start = (np.linspace(0, 1, 8).astype(np.float32),)
+    end = (np.linspace(3, 7, 8).astype(np.float32),)
+    new, m_out, bad = outer_program(1.0, 0.0)(
+        (np.copy(start[0]),), (np.copy(end[0]),),
+        (np.zeros((8,), np.float32),))
+    assert not bool(np.asarray(bad))
+    # lr=1 + mu=0 adopts mean_end STRUCTURALLY (no delta arithmetic),
+    # so the result is bitwise the input — not just close.
+    assert np.asarray(new[0]).tobytes() == end[0].tobytes()
+
+
+def test_outer_program_nesterov_math():
+    s, e = np.float32(1.0), np.float32(0.6)
+    m0 = np.float32(0.2)
+    lr, mu = 0.5, 0.9
+    new, m_out, _ = outer_program(lr, mu)(
+        (np.full((2,), s),), (np.full((2,), e),), (np.full((2,), m0),))
+    g = s - e
+    m1 = mu * m0 + g
+    want = s - lr * (g + mu * m1)
+    np.testing.assert_allclose(np.asarray(new[0]),
+                               np.full((2,), want), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(m_out[0]),
+                               np.full((2,), m1), rtol=1e-6)
+
+
+def test_mean_end_reweights_by_live_count():
+    a = [np.full((3,), 2.0, np.float32)]
+    b = [np.full((3,), 4.0, np.float32)]
+    np.testing.assert_array_equal(mean_end_leaves([a, b])[0],
+                                  np.full((3,), 3.0, np.float32))
+    # A lost group is simply absent from the divisor.
+    np.testing.assert_array_equal(mean_end_leaves([a])[0], a[0])
+    with pytest.raises(ValueError, match="zero groups"):
+        mean_end_leaves([])
+
+
+# ---------------------------------------------------------------------------
+# The h=0 inert pin: the sync path cannot tell this module exists.
+# ---------------------------------------------------------------------------
+
+
+def test_h0_inert_traces_sync_path_byte_for_byte(devices):
+    g = _make_group(devices, 0, 0, 2)
+    x, y = g.trainer.put_batch(*make_lm_batch(
+        np.zeros((4, 17), np.int64)))
+    before = g.trainer.lower_train_step(g.state, x, y).as_text()
+    loop = OuterLoop([g], diloco_h=0, outer_wire="int8")
+    assert not loop.active and loop.down is None and loop.plan is None
+    with pytest.raises(RuntimeError, match="inert"):
+        loop.round(_batch_fn())
+    assert g.sub is None and g.up_pub is None
+    # The exact HLO the sync path lowers, with the inert loop
+    # constructed: byte-for-byte unchanged.
+    after = g.trainer.lower_train_step(g.state, x, y).as_text()
+    assert before == after
+
+
+# ---------------------------------------------------------------------------
+# The bitwise identity pin: H=1 / lr=1 / mu=0 / wire=none == plain sync.
+# ---------------------------------------------------------------------------
+
+
+def test_identity_outer_matches_plain_training_bitwise(devices):
+    T = 3
+    g = _make_group(devices, 0, 0, 2)
+    loop = OuterLoop([g], diloco_h=1, outer_lr=1.0, outer_momentum=0.0,
+                     outer_wire="none")
+    nb = _batch_fn()
+    for _ in range(T):
+        st = loop.round(nb)
+        assert not st["skipped"]
+
+    plain = _make_group(devices, 0, 0, 2)
+    nb2 = _batch_fn()
+    for _ in range(T):
+        plain.run_inner(1, nb2)
+
+    la = jax.tree.leaves(g.host_params())
+    lb = jax.tree.leaves(plain.host_params())
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        assert a.dtype == b.dtype and a.tobytes() == b.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# int8 EF residual lifecycle + skip protocol + elastic membership.
+# ---------------------------------------------------------------------------
+
+
+def _residual_bytes(group):
+    return [np.asarray(c._residual).tobytes()
+            for c in group.up_pub._codecs
+            if getattr(c, "_residual", None) is not None]
+
+
+def test_int8_residual_lifecycle_skip_and_membership(devices):
+    g0 = _make_group(devices, 0, 0, 2)
+    g1 = _make_group(devices, 1, 2, 4)
+    loop = OuterLoop([g0, g1], diloco_h=1, outer_lr=0.7,
+                     outer_momentum=0.9, outer_wire="int8")
+    nb = _batch_fn()
+
+    st = loop.round(nb)
+    assert not st["skipped"] and st["groups"] == [0, 1]
+    res1 = _residual_bytes(g0)
+    # int8 quantization of a real pseudo-gradient leaves a residual.
+    assert res1 and any(np.frombuffer(r, np.float32).any()
+                        for r in res1)
+
+    st = loop.round(nb)
+    assert not st["skipped"]
+    res2 = _residual_bytes(g0)
+    # Carried ACROSS rounds: round 2 encoded residual+delta and left a
+    # new remainder — the state persists, it is not reset per round.
+    assert len(res2) == len(res1) and res2 != res1
+    assert loop.digest_equal(g0) and loop.digest_equal(g1)
+
+    # --- skip protocol: flags are collected BEFORE any publish -------
+    before = [np.copy(x) for x in loop.global_leaves]
+    mom_before = [np.copy(m) for m in loop.momentum]
+    bad = jax.tree.map(
+        lambda x: (x * np.float32("nan")).astype(x.dtype),
+        g1.state.params)
+    g1.state = dataclasses.replace(g1.state, params=bad)
+    g1.last_loss = float("nan")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        st = loop.round(nb)
+    assert st["skipped"] and st["bad_groups"] == [1]
+    assert any("skipped" in str(x.message) for x in w)
+    assert any("optimizer state reset" in str(x.message) for x in w)
+    # Nothing was published: EF residuals, global params and outer
+    # momentum are all bitwise untouched; every group is back at the
+    # round's agreed start.
+    assert _residual_bytes(g0) == res2
+    for a, b in zip(before, loop.global_leaves):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    for a, b in zip(mom_before, loop.momentum):
+        assert a.tobytes() == np.asarray(b).tobytes()
+    assert loop.digest_equal(g0) and loop.digest_equal(g1)
+    st = loop.round(nb)
+    assert not st["skipped"], "skip protocol must recover next round"
+
+    # --- membership change: residuals reset WITH a warning -----------
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loop.remove_group(1, reason="lost heartbeat")
+    msgs = [str(x.message) for x in w]
+    assert any("reweight" in m for m in msgs)
+    assert any("error-feedback residuals reset" in m for m in msgs)
+    assert not _residual_bytes(g0), "survivor residuals must reset"
+    st = loop.round(nb)
+    assert not st["skipped"] and st["groups"] == [0]
+
+    rejoiner = loop.removed[1]
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loop.add_group(rejoiner)
+    msgs = [str(x.message) for x in w]
+    assert any("joined at outer version" in m for m in msgs)
+    assert any("error-feedback residuals reset" in m for m in msgs)
+    # Rejoiner boots digest-equal at the CURRENT outer version.
+    assert loop.digest_equal(rejoiner)
+    assert rejoiner.sub.applied_version == loop.down.version
+    st = loop.round(nb)
+    assert not st["skipped"] and st["groups"] == [0, 1]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("wire", ["bf16", "sparse"])
+def test_other_wires_converge_digest_equal(devices, wire):
+    g0 = _make_group(devices, 0, 0, 2)
+    g1 = _make_group(devices, 1, 2, 4)
+    loop = OuterLoop([g0, g1], diloco_h=2, outer_lr=0.7,
+                     outer_momentum=0.9, outer_wire=wire)
+    nb = _batch_fn()
+    for _ in range(2):
+        st = loop.round(nb)
+        assert not st["skipped"]
+    assert np.isfinite(st["loss"])
+    assert loop.digest_equal(g0) and loop.digest_equal(g1)
+    assert loop.cross_group_bytes() > 0
+
+
+# ---------------------------------------------------------------------------
+# The DCN hop + host-side decode verification.
+# ---------------------------------------------------------------------------
+
+
+def _host_tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.standard_normal((64, 8)).astype(np.float32),
+            "b": rng.standard_normal((8,)).astype(np.float32)}
+
+
+def test_update_edge_ships_weight_updates_framed():
+    from tpu_ddp.publish.publisher import Publisher
+    tree = _host_tree()
+    pub = Publisher(publish_every=1, wire="int8",
+                    max_staleness_steps=0, bucket_mb=0.25)
+    update = pub.publish(params=tree, step=0)
+    edge = UpdateEdge()
+    edge.send(update)
+    got = edge.recv()
+    assert got.kind == update.kind and got.version == update.version
+    assert got.digests == update.digests
+    import pickle
+    assert pickle.dumps(got.wires) == pickle.dumps(update.wires)
+    st = edge.stats()
+    assert st["messages"] == 1 and st["wire_bytes"] > update.nbytes
+
+
+def test_decode_update_rejects_layout_and_digest_mismatch():
+    from tpu_ddp.publish.publisher import Publisher
+    tree = _host_tree()
+    pub = Publisher(publish_every=1, wire="bf16",
+                    max_staleness_steps=0, bucket_mb=0.25)
+    full = pub.publish(params=tree, step=0)
+    plan = BucketPlan(pub.reconstruction(), 0.25)
+    leaves, recon = decode_update(full, plan)
+    for a, b in zip(leaves, jax.tree.leaves(pub.reconstruction())):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    wrong_plan = BucketPlan({"w": np.zeros((4, 4), np.float32)}, 0.25)
+    with pytest.raises(ValueError, match="layout"):
+        decode_update(full, wrong_plan)
+
+    moved = jax.tree.map(lambda x: x + 0.125, tree)
+    delta = pub.publish(params=moved, step=1)
+    assert delta.kind == "delta"
+    with pytest.raises(ValueError, match="last_leaves"):
+        decode_update(delta, plan)
+    # Decoding a delta against the WRONG baseline reconstructs a
+    # different tree — the digest check refuses it.
+    bad_base = [np.zeros_like(x) for x in leaves]
+    with pytest.raises(ValueError, match="digest mismatch"):
+        decode_update(delta, plan, bad_base)
+    good, _ = decode_update(delta, plan, leaves)
+    for a, b in zip(good, jax.tree.leaves(pub.reconstruction())):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_lower_outer_step_is_a_graph_audit_surface():
+    lowered = lower_outer_step(_host_tree(), outer_lr=0.7,
+                               outer_momentum=0.9)
+    txt = lowered.as_text()
+    assert "diloco_outer_apply" in txt
+
+
+# ---------------------------------------------------------------------------
+# Chaos grammar: group-loss@N[:group=G].
+# ---------------------------------------------------------------------------
+
+
+def test_group_loss_parse_and_validation():
+    from tpu_ddp.resilience.chaos import parse_faults
+    (spec,) = parse_faults("group-loss@3:group=2")
+    assert spec.kind == "group-loss" and spec.step == 3
+    assert spec.group == 2 and spec.key.endswith(".group2")
+    (spec,) = parse_faults("group-loss@1")
+    assert spec.group is None
+    with pytest.raises(ValueError, match="group-loss"):
+        parse_faults("preempt@2:group=1")     # group= is ours alone
+    with pytest.raises(ValueError, match=">= 0"):
+        parse_faults("group-loss@2:group=-1")
+    with pytest.raises(ValueError, match="unknown option"):
+        parse_faults("group-loss@2:gruop=1")
+
+
+def test_group_loss_fires_once_via_sentinel(tmp_path):
+    from tpu_ddp.resilience.chaos import FaultInjector, parse_faults
+    inj = FaultInjector(parse_faults("group-loss@2:group=1"), seed=0,
+                        sentinel_dir=str(tmp_path), rank=0)
+    assert inj.group_loss_fires(1) is None
+    assert inj.group_loss_fires(2) == 1
+    # One-shot: the sentinel blocks a replay of the same ordinal.
+    assert inj.group_loss_fires(2) is None
+    assert inj.group_loss_fires(3) is None
+    default = FaultInjector(parse_faults("group-loss@1"), seed=0,
+                            sentinel_dir=None, rank=0)
+    assert default.group_loss_fires(1) == 0   # default lost gid

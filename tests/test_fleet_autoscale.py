@@ -345,12 +345,14 @@ def test_graph_audit_n_programs_pinned():
     cached-MoE decode/prefill twins; the sparse publish wire adds none,
     EdgeCodec is host-side): 28 -> 31 programs. Long-context's five
     (tiered-decode/prefill, demote/promote, cp-prefill-ring) before
-    that: 23 -> 28."""
+    that: 23 -> 28. DiLoCo adds exactly ONE (the guarded outer Nesterov
+    step; the wire reuses the publish codecs, which are host-side):
+    31 -> 32."""
     art = pathlib.Path(__file__).resolve().parents[1] / \
         "experiments" / "graph_audit.json"
     audit = json.loads(art.read_text())
-    assert audit["n_programs"] == 31
-    assert len(audit["cells"]) == 31
+    assert audit["n_programs"] == 32
+    assert len(audit["cells"]) == 32
 
 
 # ---------------------------------------------------------------------------

@@ -124,6 +124,46 @@ def test_moe_knobs_registered():
                       Workload(platform="cpu")) == []
 
 
+def test_diloco_knobs_registered():
+    # The four DiLoCo knobs (tpu_ddp/train/outer.py, DESIGN.md §29)
+    # carry the full 4-surface contract. All are semantic — H local
+    # steps between syncs is a different training algorithm, not a
+    # schedule — and all stay under objective="step_time" so the
+    # goodput sweeps' exact field sets are untouched.
+    from tpu_ddp.tune.space import Workload, violations
+
+    h = knob_by_field("diloco_h")
+    lr = knob_by_field("outer_lr")
+    mu = knob_by_field("outer_momentum")
+    wire = knob_by_field("outer_wire")
+    assert h is not None and lr is not None
+    assert mu is not None and wire is not None
+    assert h.env == "TPU_DDP_DILOCO_H" and h.flag == "--diloco-h"
+    assert lr.env == "TPU_DDP_DILOCO_OUTER_LR"
+    assert lr.flag == "--diloco-outer-lr"
+    assert mu.env == "TPU_DDP_DILOCO_OUTER_MOMENTUM"
+    assert mu.flag == "--diloco-outer-momentum"
+    assert wire.env == "TPU_DDP_DILOCO_OUTER_WIRE"
+    assert wire.flag == "--diloco-outer-wire"
+    for knob in (h, lr, mu, wire):
+        assert knob.semantic and knob.objective == "step_time", knob.name
+    # Candidate sets include the off defaults (keep-the-default rule)
+    # and the publish wire vocabulary verbatim — the outer wire IS the
+    # publish codec, so the sets must not drift apart.
+    assert 0 in h.values and 0.7 in lr.values and 0.9 in mu.values
+    assert set(wire.values) == {"none", "bf16", "int8", "sparse"}
+    # Engine-mirrored violations: the outer knobs are inert duplicates
+    # of the plain-sync default without diloco_h, and DiLoCo groups
+    # assume the canonical params_to_host layout — pp inside a group
+    # is rejected.
+    cpu = Workload(platform="cpu")
+    assert violations({"outer_lr": 1.0}, cpu)
+    assert violations({"outer_momentum": 0.0}, cpu)
+    assert violations({"outer_wire": "int8"}, cpu)
+    assert violations({"diloco_h": 8, "outer_wire": "int8"}, cpu) == []
+    assert violations({"diloco_h": 8}, Workload(platform="cpu", pp=2))
+
+
 def test_serve_knobs_registered_under_goodput_objective():
     # The serving knobs (tpu_ddp/serve/) carry the same 4-surface
     # contract minus the launch flag (serving is not a launch.py

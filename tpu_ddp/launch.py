@@ -792,6 +792,27 @@ def main(argv=None) -> int:
                         "ceil(T * capacity * top_k / E); higher = "
                         "fewer dropped tokens, more padded compute. "
                         "Sets TPU_DDP_MOE_CAPACITY for every rank")
+    p.add_argument("--diloco-h", type=int, default=None,
+                   help="DiLoCo inner steps per outer round (0 = off; "
+                        "tpu_ddp/train/outer.py): each group runs H "
+                        "local steps, only the outer pseudo-gradient "
+                        "exchange crosses groups. Sets "
+                        "TPU_DDP_DILOCO_H for every rank")
+    p.add_argument("--diloco-outer-lr", type=float, default=None,
+                   help="outer Nesterov-momentum learning rate over "
+                        "pseudo-gradients (1 with zero momentum = "
+                        "plain parameter averaging). Sets "
+                        "TPU_DDP_DILOCO_OUTER_LR for every rank")
+    p.add_argument("--diloco-outer-momentum", type=float, default=None,
+                   help="outer Nesterov momentum coefficient in "
+                        "[0, 1). Sets TPU_DDP_DILOCO_OUTER_MOMENTUM "
+                        "for every rank")
+    p.add_argument("--diloco-outer-wire", default=None,
+                   choices=("none", "bf16", "int8", "sparse"),
+                   help="cross-group pseudo-gradient wire format (the "
+                        "publish/ delta codec vocabulary; 'none' ships "
+                        "bitwise full tensors). Sets "
+                        "TPU_DDP_DILOCO_OUTER_WIRE for every rank")
     p.add_argument("--autotune", default=None,
                    choices=("off", "cached", "search"),
                    help="perf-knob autotuning (tpu_ddp/tune/): 'cached' "
@@ -924,6 +945,23 @@ def main(argv=None) -> int:
             p.error(f"--moe-capacity must be > 0, got "
                     f"{args.moe_capacity}")
         env["TPU_DDP_MOE_CAPACITY"] = str(args.moe_capacity)
+    if args.diloco_h is not None:
+        if args.diloco_h < 0:
+            p.error(f"--diloco-h must be >= 0, got {args.diloco_h}")
+        env["TPU_DDP_DILOCO_H"] = str(args.diloco_h)
+    if args.diloco_outer_lr is not None:
+        if not args.diloco_outer_lr > 0:
+            p.error(f"--diloco-outer-lr must be > 0, got "
+                    f"{args.diloco_outer_lr}")
+        env["TPU_DDP_DILOCO_OUTER_LR"] = str(args.diloco_outer_lr)
+    if args.diloco_outer_momentum is not None:
+        if not 0.0 <= args.diloco_outer_momentum < 1.0:
+            p.error(f"--diloco-outer-momentum must be in [0, 1), got "
+                    f"{args.diloco_outer_momentum}")
+        env["TPU_DDP_DILOCO_OUTER_MOMENTUM"] = str(
+            args.diloco_outer_momentum)
+    if args.diloco_outer_wire is not None:
+        env["TPU_DDP_DILOCO_OUTER_WIRE"] = args.diloco_outer_wire
     if args.autotune is not None:
         env["TPU_DDP_AUTOTUNE"] = args.autotune
     if args.audit is not None:

@@ -1,0 +1,274 @@
+"""DiLoCo: the cross-datacenter rung of the gradient-sync ladder.
+
+The ladder so far trades gradient-sync strategies inside one cluster
+(none -> gather/scatter -> all_reduce -> bucketed/fused DDP -> ZeRO /
+FSDP / overlap). Its missing rung is the one where the link between
+replica GROUPS is WAN-grade and a per-step all_reduce is unaffordable.
+DiLoCo-style training climbs it with a TWO-LEVEL hierarchy (the
+map/reduce-over-groups structure of *DrJAX*, arXiv 2403.07128):
+
+- **inner**: each group runs ``H`` local optimizer steps with ANY
+  existing rung — fused DDP, ZeRO, FSDP, overlap all compose inside a
+  group, because the only thing the outer level ever sees is the
+  group's canonical ``params_to_host`` snapshot.
+- **outer**: once per round the groups exchange *pseudo-gradients*
+  (``params_start - params_end``) and a Nesterov-momentum outer step
+  updates the shared global params. Cross-group bytes drop by ~H×
+  before compression even starts.
+
+The outer wire is NOT a new delta path: the pseudo-gradient IS a
+:class:`~tpu_ddp.publish.publisher.WeightUpdate`. Each group's end-of-
+round params go through a round-17 ``publish/`` Publisher whose delta
+baseline was re-anchored (``Publisher.rebase``) at the agreed global
+params both ends already hold — so the bucketed, compressed, digest-
+verified wire delta is *exactly* ``end - start``, i.e. the negated
+pseudo-gradient, with per-bucket int8 error feedback carried across
+rounds. Transport rides the same DCN channel class as the MPMD
+pipeline edges (:class:`UpdateEdge` below, the ``parallel/mpmd.py``
+framing), so a cross-process deployment reuses ``SocketEdge``
+machinery unchanged.
+
+Bitwise policy (what the pins in tests/test_diloco.py claim): on a
+COMPRESSING wire (bf16/int8/sparse) both edges ship rebased deltas —
+the pseudo-gradient's small dynamic range is what makes int8 viable.
+On the lossless dense wire (``none``) a delta and a full tensor cost
+IDENTICAL bytes, but ``start + (end - start)`` is not ``end`` in f32 —
+so the ``none`` wire ships FULL pushes (``Publisher.force_full``),
+which decode bitwise. That is what makes ``H=1, outer_lr=1, zero
+momentum, wire=none`` match plain synced training bit for bit.
+
+Agreement model: the outer apply is ONE jitted program
+(:func:`outer_program`) run by the coordinator; ``nonfinite_flag`` +
+``select_update`` make a non-finite pseudo-gradient an exact in-graph
+no-op (the psum-agreed skip of the SPMD rungs — here agreement is by
+construction, since every group receives the same digest-pinned result
+over the down edge). Group (re)placement on join/loss needs no
+parameter reshuffle beyond one bootstrap transfer (cf. *Memory-
+efficient array redistribution*, arXiv 2112.01075): the global params
+are already in canonical host form, so a joiner lands with one
+``Publisher.bootstrap`` full push at the current outer version.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import pickle
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_ddp.parallel.compress import EdgeCodec
+from tpu_ddp.parallel.mpmd import InProcessEdge
+from tpu_ddp.publish.store import tree_digests
+from tpu_ddp.resilience.guard import nonfinite_flag, select_update
+
+__all__ = [
+    "GroupEndpoint", "UpdateEdge", "decode_update", "finite_leaves",
+    "lower_outer_step", "mean_end_leaves", "outer_program",
+]
+
+
+# ---------------------------------------------------------------------------
+# The outer-step jitted program (the graph_audit surface).
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def outer_program(outer_lr: float, outer_momentum: float):
+    """The jitted outer Nesterov step for static ``(lr, mu)``.
+
+    ``diloco_outer_apply(start, mean_end, momentum)`` over leaf tuples:
+
+    - pseudo-gradient ``g = start - mean_end`` (f32),
+    - ``m_new = mu * m + g``; Nesterov update ``start - lr*(g + mu*m_new)``,
+    - in-graph guard: a non-finite pseudo-gradient selects the OLD
+      params and momentum per leaf (``select_update`` — exact identity
+      on a healthy round), returning the ``bad`` flag.
+
+    ``lr == 1 and mu == 0`` is the identity outer optimizer: the
+    program adopts ``mean_end`` STRUCTURALLY (no delta arithmetic), so
+    the bitwise pin holds by construction instead of by float luck.
+    ``start`` and ``momentum`` are donated — round t+1's buffers are
+    round t's.
+    """
+    lr = float(outer_lr)
+    mu = float(outer_momentum)
+    identity = lr == 1.0 and mu == 0.0
+
+    def diloco_outer_apply(start, mean_end, momentum):
+        g = tuple(s.astype(jnp.float32) - e.astype(jnp.float32)
+                  for s, e in zip(start, mean_end))
+        bad = nonfinite_flag(jnp.float32(0.0), g)
+        m_new = tuple(mu * m + gi for m, gi in zip(momentum, g))
+        if identity:
+            new = tuple(e.astype(s.dtype)
+                        for s, e in zip(start, mean_end))
+        else:
+            new = tuple(
+                (s.astype(jnp.float32) - lr * (gi + mu * mi))
+                .astype(s.dtype)
+                for s, gi, mi in zip(start, g, m_new))
+        new = select_update(bad, tuple(start), new)
+        m_out = select_update(bad, tuple(momentum), m_new)
+        return new, m_out, bad
+
+    return jax.jit(diloco_outer_apply, donate_argnums=(0, 2))
+
+
+def lower_outer_step(params, *, outer_lr: float = 0.7,
+                     outer_momentum: float = 0.9):
+    """``jit.lower`` the outer apply at ``params``'s leaf shapes — the
+    outer-step graph-audit surface (scripts/graph_audit.py): groups in
+    lockstep must dispatch THIS program identically, which is exactly
+    the divergent-collective-order class the auditor fingerprints."""
+    leaves = jax.tree.leaves(params)
+    starts = tuple(jax.ShapeDtypeStruct(np.shape(x), jnp.result_type(x))
+                   for x in leaves)
+    f32s = tuple(jax.ShapeDtypeStruct(np.shape(x), jnp.float32)
+                 for x in leaves)
+    return outer_program(outer_lr, outer_momentum).lower(
+        starts, f32s, f32s)
+
+
+# ---------------------------------------------------------------------------
+# Host-side wire decode (the coordinator's end of the up edge).
+# ---------------------------------------------------------------------------
+
+
+def decode_update(update, plan, last_leaves=None):
+    """Decode one :class:`WeightUpdate` against ``last_leaves`` on the
+    host — the coordinator's (engine-free) mirror of the subscriber
+    flip. Returns ``(leaves, tree)`` of the reconstruction; raises on a
+    layout or digest mismatch (a silently-wrong outer mean is the one
+    failure mode this edge must never have)."""
+    if plan.fingerprint() != update.layout:
+        raise ValueError(
+            "diloco: update layout does not match the outer plan "
+            "(group and coordinator disagree on the model)")
+    if update.kind != "full" and last_leaves is None:
+        raise ValueError("diloco: delta decode needs last_leaves")
+    recon = [None] * len(plan.metas)
+    for b, idxs in enumerate(plan.buckets):
+        dec = np.asarray(EdgeCodec.decode(update.wires[b]), np.float32)
+        off = 0
+        for i in idxs:
+            m = plan.metas[i]
+            d = dec[off:off + m.size].reshape(m.shape)
+            off += m.size
+            if update.kind == "full":
+                recon[i] = d.astype(m.dtype)
+            else:
+                recon[i] = (np.asarray(last_leaves[i], np.float32)
+                            + d).astype(m.dtype)
+    tree = jax.tree.unflatten(plan.treedef, recon)
+    if tree_digests(tree) != update.digests:
+        raise ValueError(
+            f"diloco: digest mismatch on version {update.version} — "
+            "refusing to fold a corrupt pseudo-gradient into the "
+            "outer mean")
+    return recon, tree
+
+
+def finite_leaves(leaves) -> bool:
+    """Host-side all-finite check over a leaf list (the pre-publish
+    flag collection: a bad group must be known BEFORE any codec
+    consumes its delta, so a skipped round leaves every error-feedback
+    residual untouched)."""
+    return all(bool(np.isfinite(np.asarray(x)).all()) for x in leaves)
+
+
+def mean_end_leaves(ends: list) -> list:
+    """Equal-weight f32 mean over groups' decoded end leaves — the
+    reduce of the two-level hierarchy, and the reweighting point: a
+    lost group is simply absent from ``ends`` and the divisor. For a
+    single group this is ``end / 1.0``, which is exact."""
+    if not ends:
+        raise ValueError("diloco: outer mean over zero groups")
+    inv = np.float32(1.0 / len(ends))
+    out = []
+    for parts in zip(*ends):
+        acc = np.asarray(parts[0], np.float32)
+        for p in parts[1:]:
+            acc = acc + np.asarray(p, np.float32)
+        out.append(acc * inv)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The group's engine adapter (publish/subscriber protocol).
+# ---------------------------------------------------------------------------
+
+
+class GroupEndpoint:
+    """One DiLoCo group's end of the down (broadcast) edge.
+
+    Satisfies the ``publish/subscriber.py`` engine protocol — ``params``
+    (live device tree), ``swap_params``, ``param_version``, ``step()``
+    — over any trainer whose state is a dataclass with a ``params``
+    field (LMTrainState, TrainState). A subscriber flip therefore lands
+    in the group's REAL training state: the delta path donates the old
+    live params and the group trains on from the flipped tree.
+
+    Call :meth:`sync` before pumping the subscriber — inner steps
+    donate their input state, so the live tree must be re-read from the
+    group's current state, never cached across steps.
+    """
+
+    def __init__(self, group):
+        self._group = group
+        self.params = group.state.params
+        self.param_version = 0
+        self.subscriber = None
+
+    def sync(self) -> None:
+        self.params = self._group.state.params
+
+    def swap_params(self, new_live, version: int) -> None:
+        self.params = new_live
+        self.param_version = version
+        g = self._group
+        g.state = dataclasses.replace(g.state, params=new_live)
+
+    def step(self) -> None:
+        if self.subscriber is not None:
+            self.subscriber.on_engine_step()
+
+
+# ---------------------------------------------------------------------------
+# The DCN hop: WeightUpdates over the MPMD edge machinery.
+# ---------------------------------------------------------------------------
+
+
+class UpdateEdge(InProcessEdge):
+    """A cross-group DCN channel carrying whole ``WeightUpdate``s.
+
+    Same framing as :class:`~tpu_ddp.parallel.mpmd.SocketEdge` — 4-byte
+    big-endian length + pickle — held in the in-process deque, so the
+    single-process tests and sweeps exercise byte-for-byte the blobs a
+    socket deployment would ship (``WeightUpdate`` wires are already
+    host numpy). The payload is pre-compressed by the publisher's
+    codecs; this edge's own codec stays ``none``.
+    """
+
+    def __init__(self):
+        super().__init__(EdgeCodec("none"))
+        self.wire_bytes = 0
+
+    def send(self, update) -> None:
+        blob = pickle.dumps(update, protocol=pickle.HIGHEST_PROTOCOL)
+        self._q.append(struct.pack(">I", len(blob)) + blob)
+        self.messages += 1
+        self.wire_bytes += 4 + len(blob)
+
+    def recv(self):
+        frame = self._q.popleft()
+        (n,) = struct.unpack(">I", frame[:4])
+        return pickle.loads(frame[4:4 + n])
+
+    def stats(self) -> dict:
+        return {"transport": type(self).__name__,
+                "messages": self.messages,
+                "wire_bytes": int(self.wire_bytes)}

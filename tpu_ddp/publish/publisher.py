@@ -375,6 +375,42 @@ class Publisher:
         subscriber.deliver(update)
         return update
 
+    def force_full(self) -> None:
+        """Make the NEXT publish ship full tensors: drop the delta
+        baseline (codecs reset at the full push, as always). The resync
+        lever the subscriber rejection paths point at, and the per-round
+        mode of the DiLoCo ``none`` outer wire — on a lossless dense
+        wire a full costs the same bytes as a delta and decodes
+        bitwise."""
+        self._last = None
+
+    def rebase(self, params) -> None:
+        """Re-anchor the delta baseline at ``params`` WITHOUT shipping
+        anything. Only valid when both ends of the edge already hold
+        ``params`` (the DiLoCo outer edge: every group holds the
+        digest-pinned post-round global tree, so moving the baseline
+        there is free) — the next delta is then exactly ``new - params``,
+        i.e. the round's pseudo-gradient. Codec state is NOT touched:
+        int8 error-feedback residuals carry across rounds by design."""
+        host = jax.tree.map(np.asarray, params)
+        self.ensure_plan(host)
+        self._last = list(jax.tree.leaves(host))
+
+    def reconstruction(self):
+        """The current published reconstruction as a host tree — bitwise
+        what every in-sync subscriber holds (None before any push)."""
+        if self._last is None or self._treedef is None:
+            return None
+        return jax.tree.unflatten(self._treedef, list(self._last))
+
+    def reset_codecs(self) -> None:
+        """Drop per-bucket codec state (error-feedback residuals + byte
+        counters) without touching the delta baseline — the membership-
+        change semantics of the DiLoCo outer edge (mirrors the round-7
+        dp-change reset in parallel/compress.py)."""
+        for c in self._codecs or ():
+            c.reset()
+
     def _deliver(self, update) -> None:
         for s in self.subscribers:
             s.deliver(update)

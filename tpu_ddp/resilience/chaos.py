@@ -18,6 +18,14 @@ fault kind                recovery path it drills
                           restart when resharding is off/impossible)
 ``host-join``             live reshard both ways: shrink, then regrow
                           when the host returns and joins mid-run
+``group-loss``            DiLoCo outer round loses a replica group
+                          mid-round (train/outer.py): survivors
+                          reweight the outer mean, the rejoiner
+                          bootstraps digest-equal at the current
+                          outer version. ``step`` is the 1-based
+                          OUTER-ROUND ordinal (like the publish kinds
+                          count pushes); ``:group=G`` picks the lost
+                          group (default 0)
 ========================  =============================================
 
 ``host-loss`` and ``host-join`` are *graceful* preemptions: when the
@@ -58,7 +66,7 @@ import numpy as np
 FAULT_EXIT_CODE = 13
 
 FAULT_KINDS = ("hard-exit", "nan-grad", "stalled-step", "corrupt-ckpt",
-               "slow-rank", "host-loss", "host-join")
+               "slow-rank", "host-loss", "host-join", "group-loss")
 
 # Serve-side fault kinds (tpu_ddp/fleet/resilience.ServeFaultInjector):
 # the decode-path analog of the training kinds above, riding the same
@@ -118,6 +126,7 @@ class FaultSpec:
     prob: float | None = None
     rank: int = 0
     tenant: str | None = None
+    group: int | None = None
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS + SERVE_FAULT_KINDS:
@@ -139,12 +148,22 @@ class FaultSpec:
             raise ValueError(
                 f"fault {self.kind!r} does not take tenant= "
                 "(only tenant-storm)")
+        if self.group is not None:
+            if self.kind != "group-loss":
+                raise ValueError(
+                    f"fault {self.kind!r} does not take group= "
+                    "(only group-loss)")
+            if self.group < 0:
+                raise ValueError(
+                    f"group= must be >= 0, got {self.group}")
 
     @property
     def key(self) -> str:
         """Stable sentinel-file name for this spec."""
         trig = f"p{self.prob}" if self.step is None else str(self.step)
         suffix = f".tenant{self.tenant}" if self.tenant else ""
+        if self.group is not None:
+            suffix += f".group{self.group}"
         return f"{self.kind}@{trig}.rank{self.rank}{suffix}"
 
 
@@ -164,22 +183,26 @@ def parse_faults(spec: str) -> list[FaultSpec]:
                              f"kind@step or kind@p<prob>")
         rank = 0
         tenant = None
-        if tail:
-            if tail.startswith("rank="):
-                rank = int(tail[len("rank="):])
-            elif tail.startswith("tenant="):
-                tenant = tail[len("tenant="):]
-            else:
-                raise ValueError(f"bad fault spec {entry!r}: unknown "
-                                 f"option {tail!r} (rank=R or "
-                                 f"tenant=NAME)")
+        group = None
         try:
+            if tail:
+                if tail.startswith("rank="):
+                    rank = int(tail[len("rank="):])
+                elif tail.startswith("tenant="):
+                    tenant = tail[len("tenant="):]
+                elif tail.startswith("group="):
+                    group = int(tail[len("group="):])
+                else:
+                    raise ValueError(f"unknown option {tail!r} "
+                                     "(rank=R, tenant=NAME or "
+                                     "group=G)")
             if trigger.startswith("p"):
                 out.append(FaultSpec(kind, prob=float(trigger[1:]),
-                                     rank=rank, tenant=tenant))
+                                     rank=rank, tenant=tenant,
+                                     group=group))
             else:
                 out.append(FaultSpec(kind, step=int(trigger), rank=rank,
-                                     tenant=tenant))
+                                     tenant=tenant, group=group))
         except ValueError as e:
             raise ValueError(f"bad fault spec {entry!r}: {e}") from None
     return out
@@ -328,6 +351,20 @@ class FaultInjector:
                 self._graceful_preemption(spec)
         # Legacy knob (TPU_DDP_FAIL_AT_STEP) rides the same hook.
         maybe_inject_failure(step)
+
+    def group_loss_fires(self, round_n: int) -> int | None:
+        """DiLoCo hook: does a ``group-loss`` fault fire on outer round
+        ``round_n`` (1-based ordinal, like the publish kinds count
+        pushes)? Returns the lost group id (``:group=G``, default 0) or
+        None. One-shot via the sentinel like every other kind — a
+        restarted run does not lose the group twice."""
+        for spec in self.specs:
+            if spec.kind != "group-loss" or not self._fires(spec, round_n):
+                continue
+            self._announce(spec, round_n)
+            self._mark_sentinel(spec, round_n)
+            return spec.group if spec.group is not None else 0
+        return None
 
     def _graceful_preemption(self, spec: FaultSpec) -> None:
         """Die like a preempted host: departure notice first (when the

@@ -1,0 +1,450 @@
+"""The DiLoCo outer loop: H inner steps per group, one outer round.
+
+``OuterLoop`` is the coordinator of the two-level hierarchy built in
+``parallel/diloco.py``. Each :class:`DilocoGroup` wraps one trainer
+(any rung — the group's internals are invisible to the outer level)
+on its own device subset; the loop owns:
+
+- the **down edge**: ONE ``publish/`` Publisher broadcasting the
+  global params to every group's Subscriber (digest-verified atomic
+  flips into the groups' real training state via ``GroupEndpoint``);
+- the **up edges**: one Publisher per group whose delta baseline is
+  re-anchored (``Publisher.rebase``) at the agreed global params every
+  round, so the wire delta IS the round's pseudo-gradient; transported
+  as whole ``WeightUpdate``s over :class:`UpdateEdge` (the MPMD DCN
+  framing) and decoded host-side with digest verification;
+- the **outer step**: the jitted guarded Nesterov program
+  (``parallel.diloco.outer_program``);
+- **membership**: :meth:`remove_group` drops a group from the outer
+  mean with reweighting (survivor error-feedback residuals reset with
+  a warning — the dp-change semantics), :meth:`add_group` boots a
+  joiner digest-equal from ``Publisher.bootstrap`` at the current
+  outer version.
+
+Skip protocol (why a skipped round is EXACTLY a no-op): every group's
+end-of-round params and loss are flag-checked on the host BEFORE any
+publisher encodes anything. A non-finite group makes the whole round a
+no-op — nothing is published, so no int8 error-feedback residual and
+no reconstruction baseline moves (the "rollback" is that nothing was
+consumed), the global params and outer momentum are untouched, and
+every group re-places its subscriber's retained last-flip tree as its
+live params. ``StepGuard`` accounts the streak and raises
+``TrainingDivergedError`` after K consecutive bad rounds. The jitted
+outer program carries the same guard in-graph (``nonfinite_flag`` +
+``select_update``) as defense in depth — that is the program the
+graph audit fingerprints.
+
+``diloco_h == 0`` leaves the loop INERT: no publishers, no broadcast,
+no hook into any trainer — the existing sync path traces byte-for-byte
+as if this module did not exist (pinned in tests/test_diloco.py).
+
+Chaos: ``group-loss@N:group=G`` (resilience/chaos.py) drops group G
+mid-outer-round N — after its inner steps, before the reduce — so the
+drill exercises exactly the survivors-reweight + rejoin-bootstrap
+path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+
+import jax
+import numpy as np
+
+from tpu_ddp.parallel.diloco import (GroupEndpoint, UpdateEdge,
+                                     decode_update, finite_leaves,
+                                     mean_end_leaves, outer_program)
+from tpu_ddp.parallel.overlap import BucketPlan
+from tpu_ddp.publish.publisher import PUBLISH_WIRES, Publisher
+from tpu_ddp.publish.store import tree_digests
+from tpu_ddp.publish.subscriber import Subscriber
+from tpu_ddp.resilience.chaos import FaultInjector, chaos_env_active
+from tpu_ddp.resilience.guard import StepGuard
+
+__all__ = ["DilocoGroup", "OuterLoop"]
+
+
+class DilocoGroup:
+    """One replica group: a trainer + its state on its device subset.
+
+    ``trainer`` is anything with ``train_step(state, x, y) ->
+    (state, loss)``, ``params_to_host(state)`` and ``init_state(seed)``
+    whose state is a dataclass with ``params``/``opt_state`` fields —
+    every LM trainer rung qualifies, so fused/ZeRO/FSDP/overlap compose
+    inside a group.
+    """
+
+    def __init__(self, gid: int, trainer, state):
+        self.gid = int(gid)
+        self.trainer = trainer
+        self.state = state
+        self.endpoint = GroupEndpoint(self)
+        self.sub = None          # down-edge Subscriber (attached by the loop)
+        self.up_pub = None       # up-edge Publisher (attached by the loop)
+        self.edge = UpdateEdge()
+        self.inner_steps = 0
+        self.last_loss = float("nan")
+
+    def run_inner(self, h: int, next_batch) -> float:
+        """``h`` local steps; ``next_batch(group) -> (inputs, targets)``."""
+        loss = None
+        for _ in range(h):
+            x, y = next_batch(self)
+            self.state, loss = self.trainer.train_step(self.state, x, y)
+            self.inner_steps += 1
+        if loss is not None:
+            # Per-device loss on dp>1 meshes — scalarize like the rungs'
+            # own tests do.
+            self.last_loss = float(np.mean(np.asarray(loss)))
+        return self.last_loss
+
+    def host_params(self):
+        return self.trainer.params_to_host(self.state)
+
+    def drain(self) -> None:
+        """Pump the down subscriber until this group applied every
+        delivered update (one bucket per pump, like a serving engine)."""
+        if self.sub is None:
+            return
+        self.endpoint.sync()
+        pending = list(self.sub._inbox)
+        if self.sub._staging is not None:
+            pending.append(self.sub._staging[0])
+        if any(u.kind == "delta" for u in pending):
+            # A delta flip adds the wire delta to the PREVIOUS flip's
+            # params — a serving engine's live tree never moves between
+            # flips, but this group just ran H inner steps. Re-place
+            # the subscriber's retained last-applied host tree as live
+            # so the donating apply lands on the operand the publisher
+            # diffed against.
+            self.restore_flip()
+        while self.sub.lag:
+            self.endpoint.step()
+
+    def restore_flip(self) -> None:
+        """Re-place the subscriber's retained last-applied host tree as
+        the live params — bitwise the tree of the last down flip (it is
+        the digest-verified committed reconstruction). Used before a
+        delta flip (above) and as the skipped-round restore: no
+        publisher involved, no version bump."""
+        self.endpoint.sync()
+        base = jax.tree.map(
+            lambda h, l: jax.device_put(np.asarray(h), l.sharding),
+            self.sub.store.host, self.endpoint.params)
+        self.endpoint.swap_params(base, self.sub.applied_version)
+
+
+class OuterLoop:
+    """The outer-level coordinator (see module docstring).
+
+    Knob defaults come from ``TrainConfig`` (``TPU_DDP_DILOCO_H`` /
+    ``TPU_DDP_DILOCO_OUTER_LR`` / ``TPU_DDP_DILOCO_OUTER_MOMENTUM`` /
+    ``TPU_DDP_DILOCO_OUTER_WIRE``, registered in tune/space.py);
+    explicit arguments win. ``diloco_h == 0`` leaves the loop inert.
+    """
+
+    def __init__(self, groups, *, diloco_h: int | None = None,
+                 outer_lr: float | None = None,
+                 outer_momentum: float | None = None,
+                 outer_wire: str | None = None,
+                 bucket_mb: float = 4.0, max_bad_rounds: int = 3,
+                 global_params=None, injector=None, config=None):
+        if config is None:
+            from tpu_ddp.utils.config import TrainConfig
+            config = TrainConfig()
+        self.h = int(diloco_h if diloco_h is not None
+                     else config.diloco_h)
+        self.outer_lr = float(outer_lr if outer_lr is not None
+                              else config.outer_lr)
+        self.outer_momentum = float(
+            outer_momentum if outer_momentum is not None
+            else config.outer_momentum)
+        self.wire = str(outer_wire if outer_wire is not None
+                        else config.outer_wire)
+        if self.h < 0:
+            raise ValueError("diloco_h must be >= 0")
+        if not self.outer_lr > 0:
+            raise ValueError("outer_lr must be > 0")
+        if not 0.0 <= self.outer_momentum < 1.0:
+            raise ValueError("outer_momentum must be in [0, 1)")
+        if self.wire not in PUBLISH_WIRES:
+            raise ValueError(f"outer_wire={self.wire!r}: expected "
+                             "none|bf16|int8|sparse")
+        self.bucket_mb = float(bucket_mb)
+        self.groups: dict = {g.gid: g for g in groups}
+        if len(self.groups) != len(groups):
+            raise ValueError("duplicate group ids")
+        self.removed: dict = {}
+        self.guard = StepGuard(max_bad_steps=max_bad_rounds)
+        if injector is not None:
+            self.injector = injector
+        else:
+            self.injector = (FaultInjector.from_env(rank=0)
+                             if chaos_env_active() else None)
+        self.round_n = 0
+        self.skipped_rounds = 0
+        self.bytes_up = 0
+        self.bytes_down = 0
+        self.active = self.h > 0
+        if not self.active:
+            # Inert: NOTHING is built or touched — the h=0 bit-exactness
+            # pin is that the existing sync path cannot tell we exist.
+            self.down = None
+            self.plan = None
+            return
+        if not self.groups:
+            raise ValueError("diloco needs at least one group")
+        init = (global_params if global_params is not None
+                else next(iter(sorted(self.groups.items())))[1]
+                .host_params())
+        self.down = Publisher(publish_every=1, wire=self.wire,
+                              max_staleness_steps=0,
+                              bucket_mb=self.bucket_mb)
+        for g in self.groups.values():
+            self._attach_down(g)
+        # Initial broadcast: version 1 is always a full push, so every
+        # group starts from the SAME decoded tree (bitwise the raw init
+        # on the lossless wire; the canonical recon on lossy wires).
+        update = self.down.publish(params=init, step=0)
+        # WAN unicast: a down broadcast is shipped once per receiving
+        # group (nothing multicasts across datacenters).
+        self.bytes_down += update.nbytes * len(self.groups)
+        for g in self.groups.values():
+            g.drain()
+        self.global_tree = self.down.reconstruction()
+        self.global_leaves = list(jax.tree.leaves(self.global_tree))
+        self.plan = BucketPlan(self.global_tree, self.bucket_mb)
+        self.momentum = [np.zeros(np.shape(x), np.float32)
+                         for x in self.global_leaves]
+        for g in self.groups.values():
+            self._attach_up(g)
+
+    # ---- wiring --------------------------------------------------------
+
+    def _attach_down(self, g: DilocoGroup) -> None:
+        g.endpoint.sync()
+        g.sub = Subscriber(g.endpoint, name=f"group{g.gid}")
+        g.endpoint.subscriber = g.sub
+        self.down.connect(g.sub)
+
+    def _attach_up(self, g: DilocoGroup) -> None:
+        g.up_pub = Publisher(publish_every=1, wire=self.wire,
+                             max_staleness_steps=0,
+                             bucket_mb=self.bucket_mb)
+        g.up_pub.ensure_plan(self.global_tree)
+        if self.wire != "none":
+            # Compressing wires ship rebased deltas: baseline = the
+            # agreed global tree the group just flipped to, so the next
+            # wire delta is exactly the pseudo-gradient.
+            g.up_pub.rebase(self.global_tree)
+
+    # ---- one outer round -----------------------------------------------
+
+    def round(self, next_batch) -> dict:
+        """H inner steps on every group, then one guarded outer step.
+        Returns the round's stats dict (``skipped`` marks the agreed
+        no-op). Raises ``TrainingDivergedError`` after K consecutive
+        skipped rounds (StepGuard)."""
+        if not self.active:
+            raise RuntimeError(
+                "diloco_h=0: the outer loop is inert — training runs "
+                "the plain sync path")
+        self.round_n += 1
+        rn = self.round_n
+        for g in list(self.groups.values()):
+            g.run_inner(self.h, next_batch)
+        if self.injector is not None:
+            lost = self.injector.group_loss_fires(rn)
+            if lost is not None and lost in self.groups:
+                self.remove_group(lost, reason="chaos group-loss")
+        if not self.groups:
+            raise RuntimeError("diloco: every group was lost")
+        # Flags BEFORE any publish: a bad group must not consume codec
+        # state (see module docstring skip protocol).
+        ends, bad_groups = {}, []
+        for gid, g in sorted(self.groups.items()):
+            host = g.host_params()
+            ends[gid] = host
+            if not np.isfinite(g.last_loss) \
+                    or not finite_leaves(jax.tree.leaves(host)):
+                bad_groups.append(gid)
+        losses = [self.groups[gid].last_loss for gid in sorted(ends)]
+        if bad_groups:
+            return self._skip_round(rn, bad_groups, losses)
+        # Up edges: publish ends (delta = pseudo-gradient on
+        # compressing wires; bitwise full on the lossless wire), ship
+        # the WeightUpdate over the DCN hop, decode with digest check.
+        end_leaves = []
+        for gid, g in sorted(self.groups.items()):
+            if self.wire == "none":
+                g.up_pub.force_full()
+            update = g.up_pub.publish(params=ends[gid], step=rn)
+            g.edge.send(update)
+            update = g.edge.recv()
+            self.bytes_up += update.nbytes
+            leaves, _ = decode_update(update, self.plan,
+                                      self.global_leaves)
+            end_leaves.append(leaves)
+        mean = mean_end_leaves(end_leaves)
+        new, m_new, bad = outer_program(
+            self.outer_lr, self.outer_momentum)(
+            tuple(self.global_leaves), tuple(mean),
+            tuple(self.momentum))
+        if bool(np.asarray(bad)):
+            # Defense in depth: reachable only through f32 overflow of
+            # a finite-ends pseudo-gradient. The up codecs already
+            # encoded this round, so on a compressing wire the EF state
+            # is re-anchored instead of rolled back.
+            warnings.warn(
+                f"diloco: outer round {rn} non-finite IN-GRAPH after "
+                "finite host flags; skipping (up codecs were already "
+                "consumed — baselines re-anchor at the unchanged "
+                "global params)", stacklevel=2)
+            if self.wire != "none":
+                for g in self.groups.values():
+                    g.up_pub.rebase(self.global_tree)
+            return self._skip_round(rn, sorted(self.groups), losses)
+        self.momentum = [np.asarray(m) for m in m_new]
+        new_tree = jax.tree.unflatten(
+            self.plan.treedef, [np.asarray(x) for x in new])
+        # Down edge: broadcast the post-step global tree; adopt the
+        # RECONSTRUCTION (what the groups hold) as the next round's
+        # agreed start, and re-anchor every up baseline there.
+        if self.wire == "none":
+            self.down.force_full()
+        update = self.down.publish(params=new_tree, step=rn)
+        self.bytes_down += update.nbytes * len(self.groups)
+        for g in self.groups.values():
+            g.drain()
+        self.global_tree = self.down.reconstruction()
+        self.global_leaves = list(jax.tree.leaves(self.global_tree))
+        if self.wire != "none":
+            for g in self.groups.values():
+                g.up_pub.rebase(self.global_tree)
+        mean_loss = float(np.mean(losses))
+        self.guard.record(rn, False, mean_loss)
+        return {"round": rn, "skipped": False, "loss": mean_loss,
+                "groups": sorted(ends), "version": self.down.version,
+                "bytes_up": self.bytes_up,
+                "bytes_down": self.bytes_down}
+
+    def _skip_round(self, rn: int, bad_groups: list,
+                    losses: list) -> dict:
+        """The agreed no-op: restore every group to the round's start
+        (each re-places its subscriber's retained last-flip tree —
+        publisher codecs and version untouched), keep global params +
+        momentum, account the streak."""
+        self.skipped_rounds += 1
+        warnings.warn(
+            f"diloco: outer round {rn} skipped (non-finite "
+            f"contribution from group(s) {bad_groups}); groups restored "
+            "to the round start, nothing published", stacklevel=3)
+        for g in self.groups.values():
+            g.restore_flip()
+        for gid in bad_groups:
+            g = self.groups.get(gid)
+            if g is None:
+                continue
+            # The bad group's inner optimizer state was accumulated
+            # through the non-finite trajectory — restoring params
+            # alone would re-diverge from the poisoned momentum.
+            warnings.warn(
+                f"diloco: group {gid} inner optimizer state reset "
+                "(it rode the non-finite trajectory)", stacklevel=3)
+            fresh = g.trainer.init_state(seed=0)
+            g.state = dataclasses.replace(g.state,
+                                          opt_state=fresh.opt_state)
+        finite = [ls for ls in losses if np.isfinite(ls)]
+        loss = float(np.mean(finite)) if finite else float("nan")
+        self.guard.record(rn, True, loss)
+        return {"round": rn, "skipped": True, "loss": loss,
+                "bad_groups": list(bad_groups),
+                "groups": sorted(self.groups),
+                "version": self.down.version,
+                "bytes_up": self.bytes_up,
+                "bytes_down": self.bytes_down}
+
+    # ---- elastic membership --------------------------------------------
+
+    def remove_group(self, gid: int, reason: str = "lost") -> DilocoGroup:
+        """Drop group ``gid`` from the outer mean. Survivors reweight
+        automatically (the mean's divisor is the live-group count);
+        their int8 error-feedback residuals reset WITH a warning — the
+        error they carry was accumulated toward a different group
+        count, the same reason the round-7 compressor resets on a dp
+        change."""
+        if gid not in self.groups:
+            raise KeyError(f"no group {gid}")
+        g = self.groups.pop(gid)
+        self.removed[gid] = g
+        if g.sub in self.down.subscribers:
+            self.down.subscribers.remove(g.sub)
+        warnings.warn(
+            f"diloco: group {gid} {reason} at outer round "
+            f"{self.round_n}; {len(self.groups)} survivor(s) reweight "
+            "the outer mean", stacklevel=2)
+        self._reset_up_codecs(f"group count changed ({gid} left)")
+        return g
+
+    def add_group(self, group: DilocoGroup) -> DilocoGroup:
+        """Admit ``group`` (a joiner or a rejoiner): boot it digest-
+        equal from ``Publisher.bootstrap`` at the CURRENT outer version,
+        then give it a fresh rebased up edge. Survivor residuals reset
+        (count change, as in :meth:`remove_group`)."""
+        if not self.active:
+            raise RuntimeError("diloco_h=0: the outer loop is inert")
+        if group.gid in self.groups:
+            raise ValueError(f"group {group.gid} already live")
+        self._attach_down(group)
+        self.down.bootstrap(group.sub)
+        group.drain()
+        self._attach_up(group)
+        self.removed.pop(group.gid, None)
+        self.groups[group.gid] = group
+        warnings.warn(
+            f"diloco: group {group.gid} joined at outer version "
+            f"{self.down.version}; {len(self.groups)} group(s) in the "
+            "outer mean", stacklevel=2)
+        self._reset_up_codecs(
+            f"group count changed ({group.gid} joined)")
+        return group
+
+    def _reset_up_codecs(self, why: str) -> None:
+        warnings.warn(
+            f"diloco: {why}; outer-wire error-feedback residuals reset "
+            "(mirrors the dp-change compressor semantics)",
+            stacklevel=3)
+        for g in self.groups.values():
+            if g.up_pub is not None:
+                g.up_pub.reset_codecs()
+
+    # ---- introspection -------------------------------------------------
+
+    def digest_equal(self, group: DilocoGroup) -> bool:
+        """True iff ``group``'s live params digest-match the agreed
+        global tree — the rejoin drill's acceptance check."""
+        return (tree_digests(group.host_params())
+                == tree_digests(self.global_tree))
+
+    def cross_group_bytes(self) -> int:
+        """Payload bytes shipped across the WAN edge so far: up
+        pseudo-gradients (one per group per round) plus down broadcasts
+        (counted once per receiving group — WAN unicast)."""
+        return int(self.bytes_up + self.bytes_down)
+
+    def stats(self) -> dict:
+        return {
+            "active": self.active, "h": self.h, "wire": self.wire,
+            "outer_lr": self.outer_lr,
+            "outer_momentum": self.outer_momentum,
+            "rounds": self.round_n,
+            "skipped_rounds": self.skipped_rounds,
+            "groups": sorted(self.groups),
+            "removed": sorted(self.removed),
+            "bytes_up": int(self.bytes_up),
+            "bytes_down": int(self.bytes_down),
+            "version": self.down.version if self.down else 0,
+            "inner_steps": sum(g.inner_steps
+                               for g in self.groups.values()),
+        }

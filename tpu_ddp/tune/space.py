@@ -398,6 +398,36 @@ KNOBS: tuple[Knob, ...] = (
              "tokens (the dropped_frac train metric) at more padded "
              "expert compute; changes which tokens the experts see, "
              "so semantic"),
+    # DiLoCo outer-loop knobs (train/outer.py, DESIGN.md §29): all
+    # four change the training trajectory (H local steps between
+    # syncs is a different algorithm, not a schedule), so all are
+    # semantic — searched only under TPU_DDP_TUNE_SEMANTIC.
+    Knob("diloco_h", "diloco_h", "TPU_DDP_DILOCO_H",
+         values=(0, 8, 32), flag="--diloco-h", semantic=True,
+         doc="DiLoCo inner steps per outer round (0 = off): each "
+             "group runs H local steps, only the outer "
+             "pseudo-gradient exchange crosses groups — cross-group "
+             "bytes drop ~H x before compression "
+             "(experiments/diloco_sweep.json)"),
+    Knob("outer_lr", "outer_lr", "TPU_DDP_DILOCO_OUTER_LR",
+         values=(0.4, 0.7, 1.0), flag="--diloco-outer-lr",
+         semantic=True,
+         doc="outer Nesterov learning rate over pseudo-gradients; "
+             "1.0 with zero momentum is plain parameter averaging"),
+    Knob("outer_momentum", "outer_momentum",
+         "TPU_DDP_DILOCO_OUTER_MOMENTUM",
+         values=(0.0, 0.9), flag="--diloco-outer-momentum",
+         semantic=True,
+         doc="outer Nesterov momentum in [0, 1); 0.9 is the DiLoCo "
+             "setting that recovers most of the synced-baseline "
+             "quality at H-fold fewer syncs"),
+    Knob("outer_wire", "outer_wire", "TPU_DDP_DILOCO_OUTER_WIRE",
+         values=("none", "bf16", "int8", "sparse"),
+         flag="--diloco-outer-wire", semantic=True,
+         doc="cross-group pseudo-gradient wire (publish/ delta codec "
+             "vocabulary): 'none' ships bitwise full tensors, "
+             "bf16/int8 quantize the rebased delta (int8 with "
+             "per-bucket error feedback carried across rounds)"),
 )
 
 # Model-level knobs are baked into get_model() before the Trainer ever
@@ -670,6 +700,31 @@ def violations(assignment: Mapping, ctx: Workload) -> list[str]:
                 f"moe_experts={experts} not divisible by ep={ctx.ep} "
                 "— with_expert_parallel rejects it (each device hosts "
                 "E/ep stacked experts)")
+    diloco_h = get("diloco_h", 0)
+    if diloco_h == 0:
+        if get("outer_lr", 0.7) != 0.7:
+            bad.append(
+                f"outer_lr={get('outer_lr')} with diloco_h=0 — the "
+                "outer loop is inert, the knob does nothing and the "
+                "cell duplicates the plain-sync default")
+        if get("outer_momentum", 0.9) != 0.9:
+            bad.append(
+                f"outer_momentum={get('outer_momentum')} with "
+                "diloco_h=0 — the outer loop is inert, the knob does "
+                "nothing and the cell duplicates the plain-sync "
+                "default")
+        if get("outer_wire", "none") != "none":
+            bad.append(
+                f"outer_wire={get('outer_wire')!r} with diloco_h=0 — "
+                "no outer exchange exists to put on a wire; the cell "
+                "duplicates the plain-sync default")
+    elif ctx.pp > 1:
+        bad.append(
+            f"diloco_h={diloco_h} on a pp={ctx.pp} mesh — a pipeline "
+            "group's params live stage-sharded and the outer "
+            "pseudo-gradient exchange assumes the canonical "
+            "params_to_host layout per group; run DiLoCo groups over "
+            "dp/fsdp rungs (pp inside a group is future work)")
     return bad
 
 

@@ -321,6 +321,23 @@ class TrainConfig:
     # more padded compute. Env: TPU_DDP_MOE_CAPACITY.
     moe_capacity: float = 1.25
 
+    # DiLoCo low-communication outer loop (tpu_ddp/train/outer.py,
+    # docs/DESIGN.md §29). Inner steps per outer round (0 = off: the
+    # outer loop is inert and training traces the plain sync path
+    # byte-for-byte). Env: TPU_DDP_DILOCO_H.
+    diloco_h: int = 0
+    # Outer Nesterov-momentum optimizer over pseudo-gradients
+    # (params_start - params_end). lr=1 + momentum=0 is the identity
+    # outer optimizer (plain parameter averaging).
+    # Envs: TPU_DDP_DILOCO_OUTER_LR / TPU_DDP_DILOCO_OUTER_MOMENTUM.
+    outer_lr: float = 0.7
+    outer_momentum: float = 0.9
+    # Wire format of the cross-group pseudo-gradient exchange — the
+    # round-17 publish/ delta codec vocabulary ("none" ships bitwise
+    # full tensors; bf16/int8/sparse ship rebased deltas, int8 with
+    # per-bucket error feedback). Env: TPU_DDP_DILOCO_OUTER_WIRE.
+    outer_wire: str = "none"
+
     # Test/CI hook: cap iterations per epoch (None = full epoch). Settable
     # via env TPU_DDP_MAX_ITERS so part CLIs can be smoke-tested quickly.
     max_iters: int | None = None
@@ -698,6 +715,35 @@ class TrainConfig:
             raise ValueError(
                 f"moe_capacity must be > 0, got {self.moe_capacity} "
                 "(TPU_DDP_MOE_CAPACITY)")
+        self.diloco_h = _env_num(
+            "TPU_DDP_DILOCO_H", int, self.diloco_h)
+        if self.diloco_h < 0:
+            raise ValueError(
+                f"diloco_h must be >= 0 (0 = off), got "
+                f"{self.diloco_h} (TPU_DDP_DILOCO_H)")
+        self.outer_lr = _env_num(
+            "TPU_DDP_DILOCO_OUTER_LR", float, self.outer_lr)
+        if not self.outer_lr > 0:  # also rejects NaN
+            raise ValueError(
+                f"outer_lr must be > 0, got {self.outer_lr} "
+                "(TPU_DDP_DILOCO_OUTER_LR)")
+        self.outer_momentum = _env_num(
+            "TPU_DDP_DILOCO_OUTER_MOMENTUM", float, self.outer_momentum)
+        if not 0.0 <= self.outer_momentum < 1.0:  # also rejects NaN
+            raise ValueError(
+                f"outer_momentum must be in [0, 1), got "
+                f"{self.outer_momentum} (TPU_DDP_DILOCO_OUTER_MOMENTUM)")
+        env_ow = os.environ.get("TPU_DDP_DILOCO_OUTER_WIRE")
+        if env_ow:
+            self.outer_wire = env_ow
+        # Mirrors publish/publisher.py PUBLISH_WIRES (train/outer.py
+        # re-validates at OuterLoop construction). diloco_h x pp
+        # coupling is a cross-knob rule and lives in tune/space.py
+        # violations, like the other couplings above.
+        if self.outer_wire not in ("none", "bf16", "int8", "sparse"):
+            raise ValueError(
+                f"outer_wire={self.outer_wire!r}: expected "
+                "none|bf16|int8|sparse (TPU_DDP_DILOCO_OUTER_WIRE)")
 
     def per_node_batch_size(self, world_size: int) -> int:
         # int(256 / world_size), as in reference part2/part2b/main.py:177.
